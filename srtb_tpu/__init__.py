@@ -1,0 +1,28 @@
+"""srtb_tpu — a TPU-native radio-telescope transient-search backend.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+fxzjshm/simple-radio-telescope-backend (C++/SYCL): streaming coherent
+dedispersion of raw baseband voltage data with RFI mitigation, single-pulse
+detection, baseband capture and spectrum-waterfall output — plus a new
+distributed layer (DM-trial fan-out and frequency-sharded FFT over a
+``jax.sharding.Mesh``) that the reference does not have.
+
+Layer map (mirrors reference layers L0-L7, see SURVEY.md):
+
+- ``srtb_tpu.config``    — runtime configuration (ref: config.hpp, program_options.hpp)
+- ``srtb_tpu.utils``     — logging, expression parsing, small helpers (ref: log/, util/)
+- ``srtb_tpu.ops``       — device kernels as jittable functions / Pallas kernels
+  (ref: unpack.hpp, coherent_dedispersion.hpp, spectrum/, signal_detect.hpp, fft/)
+- ``srtb_tpu.pipeline``  — the fused segment processor + streaming runtime
+  (ref: pipeline/)
+- ``srtb_tpu.io``        — baseband file reader, UDP ingest, packet formats, writers
+  (ref: io/)
+- ``srtb_tpu.parallel``  — mesh helpers, multi-chip DM-trial grid, sharded FFT
+  (no reference equivalent; reference is single-device)
+- ``srtb_tpu.gui``       — waterfall pixmap service (ref: gui/, without Qt)
+- ``srtb_tpu.tools``     — CLI entry points (ref: src/main.cpp, correlator.cpp, ...)
+"""
+
+__version__ = "0.1.0"
+
+from srtb_tpu.config import Config  # noqa: F401

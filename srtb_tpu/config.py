@@ -1,0 +1,212 @@
+"""Runtime configuration.
+
+TPU-native re-design of the reference's two-tier config system
+(ref: config.hpp:80-249 runtime struct; program_options.hpp:34-309 parsing
+with precedence CLI > config file > defaults; arithmetic expressions in
+values, e.g. ``2 ** 30``; comma-split lists for multi-receiver options).
+
+Differences from the reference, by design:
+- a frozen-ish dataclass passed explicitly instead of a mutable global
+  (jit-friendly: derived static quantities hang off this object);
+- TPU-specific knobs (`devices`, `dm_list` for multi-chip DM trials).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from dataclasses import dataclass, field
+
+from srtb_tpu.utils.expression import parse_number
+from srtb_tpu.utils.logging import log
+
+BITS_PER_BYTE = 8
+
+
+@dataclass
+class Config:
+    """Runtime configuration (ref: config.hpp:80-249, same option names)."""
+
+    config_file_name: str = "srtb_config.cfg"
+
+    # count of samples per segment transferred to the device; power of 2
+    baseband_input_count: int = 1 << 28
+    # bit width of one input sample; negative = signed integer
+    baseband_input_bits: int = 8
+    # baseband format: simple, interleaved_samples_2 (alias naocpsr_roach2),
+    # naocpsr_snap1, gznupsr_a1, gznupsr_a1_v2_1 (ref: io/backend_registry.hpp)
+    baseband_format_type: str = "simple"
+    # lowest frequency of received baseband signal, MHz
+    baseband_freq_low: float = 1000.0
+    # bandwidth, MHz (may be negative for inverted bands)
+    baseband_bandwidth: float = 500.0
+    # samples / second
+    baseband_sample_rate: float = 1000e6
+    # overlap consecutive segments by nsamps_reserved to mask dedispersion edges
+    baseband_reserve_sample: bool = True
+    # target dispersion measure, pc cm^-3
+    dm: float = 0.0
+    # DM trial list for multi-chip DM search (TPU extension; empty = single dm)
+    dm_list: list = field(default_factory=list)
+
+    udp_receiver_address: list = field(default_factory=lambda: ["10.0.1.2"])
+    udp_receiver_port: list = field(default_factory=lambda: [12004])
+    udp_receiver_cpu_preferred: list = field(default_factory=lambda: [0])
+
+    input_file_path: str = ""
+    input_file_offset_bytes: int = 0
+    baseband_output_file_prefix: str = "srtb_baseband_output_"
+    baseband_write_all: bool = False
+
+    log_level: int = 3
+
+    mitigate_rfi_average_method_threshold: float = 10.0
+    mitigate_rfi_spectral_kurtosis_threshold: float = 1.1
+    # "11-12, 15-90" style frequency pairs to zap
+    mitigate_rfi_freq_list: str = ""
+
+    spectrum_sum_count: int = 1
+    # count of complex channels in spectrum waterfall
+    spectrum_channel_count: int = 1 << 15
+
+    signal_detect_signal_noise_threshold: float = 6.0
+    signal_detect_channel_threshold: float = 0.9
+    signal_detect_max_boxcar_length: int = 1024
+
+    thread_query_work_wait_time: int = 1000
+
+    gui_enable: bool = False
+    gui_pixmap_width: int = 1920
+    gui_pixmap_height: int = 1080
+
+    # ---- TPU-specific options (no reference equivalent) ----
+    # number of devices to use; 0 = all local devices
+    n_devices: int = 0
+    # use two-float (df64) on-device chirp generation instead of host f64
+    use_emulated_fp64: bool = False
+
+    # ------------------------------------------------------------------
+    # derived quantities
+    # ------------------------------------------------------------------
+
+    @property
+    def bytes_per_sample(self) -> float:
+        return abs(self.baseband_input_bits) / BITS_PER_BYTE
+
+    @property
+    def baseband_freq_high(self) -> float:
+        return self.baseband_freq_low + self.baseband_bandwidth
+
+    def segment_bytes(self, data_stream_count: int = 1) -> int:
+        """Bytes of one input segment (all interleaved streams)."""
+        return int(self.baseband_input_count * self.bytes_per_sample
+                   * data_stream_count)
+
+    # ------------------------------------------------------------------
+    # loading
+    # ------------------------------------------------------------------
+
+    _INT_FIELDS = frozenset({
+        "baseband_input_count", "baseband_input_bits",
+        "input_file_offset_bytes", "spectrum_sum_count",
+        "spectrum_channel_count", "signal_detect_max_boxcar_length",
+        "thread_query_work_wait_time", "gui_pixmap_width",
+        "gui_pixmap_height", "n_devices", "log_level",
+    })
+    _FLOAT_FIELDS = frozenset({
+        "baseband_freq_low", "baseband_bandwidth", "baseband_sample_rate",
+        "dm", "mitigate_rfi_average_method_threshold",
+        "mitigate_rfi_spectral_kurtosis_threshold",
+        "signal_detect_signal_noise_threshold",
+        "signal_detect_channel_threshold",
+    })
+    _BOOL_FIELDS = frozenset({
+        "baseband_reserve_sample", "baseband_write_all", "gui_enable",
+        "use_emulated_fp64",
+    })
+    _LIST_FIELDS = frozenset({
+        "udp_receiver_address", "udp_receiver_port",
+        "udp_receiver_cpu_preferred", "dm_list",
+    })
+
+    def set_option(self, key: str, value: str) -> bool:
+        """Set one option from its string form, with expression evaluation
+        (ref: program_options.hpp:197-263).  Returns False for unknown keys."""
+        key = key.strip()
+        if not hasattr(self, key):
+            return False
+        if key in self._INT_FIELDS:
+            setattr(self, key, int(parse_number(value)))
+        elif key in self._FLOAT_FIELDS:
+            setattr(self, key, float(parse_number(value)))
+        elif key in self._BOOL_FIELDS:
+            setattr(self, key, bool(int(parse_number(value))))
+        elif key in self._LIST_FIELDS:
+            items = [s.strip() for s in value.split(",") if s.strip()]
+            if key == "udp_receiver_address":
+                setattr(self, key, items)
+            elif key == "dm_list":
+                setattr(self, key, [float(parse_number(s)) for s in items])
+            else:
+                setattr(self, key, [int(parse_number(s)) for s in items])
+        else:
+            setattr(self, key, value.strip())
+        return True
+
+    def load_file(self, path: str) -> None:
+        """Load ``key = value`` lines; ``#`` comments; unknown keys warn with
+        file/line pointer (ref: program_options.hpp:290-295)."""
+        with open(path) as f:
+            for lineno, line in enumerate(f, start=1):
+                line = line.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                if "=" not in line:
+                    log.warning(f"{path}:{lineno}: cannot parse {line!r}")
+                    continue
+                key, value = line.split("=", 1)
+                if not self.set_option(key, value):
+                    log.warning(
+                        f"{path}:{lineno}: unknown option {key.strip()!r}")
+
+    @classmethod
+    def from_args(cls, argv: list[str] | None = None) -> "Config":
+        """Build a config with precedence CLI > config file > defaults
+        (ref: program_options.hpp:148-179).
+
+        CLI syntax: ``--key=value`` or ``--key value``.
+        """
+        if argv is None:
+            argv = sys.argv[1:]
+        cli: dict[str, str] = {}
+        i = 0
+        while i < len(argv):
+            arg = argv[i]
+            if not arg.startswith("--"):
+                raise SystemExit(f"unexpected argument: {arg}")
+            body = arg[2:]
+            if "=" in body:
+                key, value = body.split("=", 1)
+            else:
+                key = body
+                if i + 1 >= len(argv):
+                    raise SystemExit(f"missing value for --{key}")
+                i += 1
+                value = argv[i]
+            cli[key.replace("-", "_")] = value
+            i += 1
+
+        cfg = cls()
+        config_file = cli.get("config_file_name", cfg.config_file_name)
+        import os
+        if os.path.exists(config_file):
+            cfg.config_file_name = config_file
+            cfg.load_file(config_file)
+        for key, value in cli.items():
+            if not cfg.set_option(key, value):
+                log.warning(f"unknown command-line option --{key}")
+        log.level = cfg.log_level
+        return cfg
+
+    def replace(self, **kwargs) -> "Config":
+        return dataclasses.replace(self, **kwargs)

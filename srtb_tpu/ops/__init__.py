@@ -1,0 +1,7 @@
+"""Device kernels as jittable JAX functions (+ Pallas where it pays off).
+
+Each op mirrors one device kernel of the reference (see table in SURVEY.md
+§2.4) and is tested against a numpy golden model in ``tests/``.
+"""
+
+from srtb_tpu.ops import unpack, window, dedisperse, rfi, detect, fft, spectrum  # noqa: F401

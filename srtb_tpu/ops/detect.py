@@ -1,0 +1,144 @@
+"""Single-pulse signal detection.
+
+Mirrors signal_detect_pipe_2 (ref: pipeline/signal_detect_pipe.hpp:244-443)
+and count_signal (ref: signal_detect.hpp:32-72), re-shaped for jit: instead
+of data-dependent host branching and dynamic result lists, everything is
+computed with static shapes — a ``[n_boxcars]`` vector of detection counts
+plus the (fixed-size) candidate time series — and the host decides what to
+write out.  This is the "count then conditionally copy" pattern of the
+reference made jit-clean (SURVEY.md §7 hard part #5).
+
+Pipeline per segment, waterfall ``[freq, time]``:
+1. zapped-channel count: channels whose time-0 sample is exactly zero
+   (ref: signal_detect_pipe.hpp:262-284);
+2. trim the reserved tail: T = time - nsamps_reserved/freq_bins
+   (ref: signal_detect_pipe.hpp:287-299);
+3. time series = sum over frequency of |x|^2 (ref: 305-316);
+4. subtract mean (ref: 321-334);
+5. sigma-threshold count at boxcar length 1 (ref: 347-366);
+6. boxcar matched filtering: prefix sum, sliding-window difference for
+   lengths 2, 4, ..., max_boxcar_length, re-detect each (ref: 368-424).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _norm(c):
+    return jnp.real(c) ** 2 + jnp.imag(c) ** 2
+
+
+class DetectResult(NamedTuple):
+    """Static-shape detection result for one segment / one data stream."""
+    zero_count: jnp.ndarray          # [] int32: zapped frequency channels
+    time_series: jnp.ndarray         # [T] f32, mean-subtracted, boxcar 1
+    boxcar_lengths: tuple            # static: (1, 2, 4, ..., max)
+    signal_counts: jnp.ndarray       # [n_boxcars] int32: samples over threshold
+    boxcar_series: jnp.ndarray       # [n_boxcars, T] f32 (rows zero-padded at tail)
+    snr_peaks: jnp.ndarray           # [n_boxcars] f32: max SNR per boxcar
+
+
+def boxcar_lengths(max_boxcar_length: int, time_series_count: int) -> tuple:
+    """Static list of boxcar lengths: 1 then 2,4,... while <= max and < T
+    (ref: signal_detect_pipe.hpp:387-389)."""
+    lengths = [1]
+    b = 2
+    while b <= max_boxcar_length and b < time_series_count:
+        lengths.append(b)
+        b *= 2
+    return tuple(lengths)
+
+
+def count_signal(x: jnp.ndarray, snr_threshold: float):
+    """Count samples with x > threshold*sqrt(mean(x^2)), assuming mean(x)=0
+    (ref: signal_detect.hpp:32-72).  Returns (count, peak_snr)."""
+    n = x.shape[-1]
+    sigma = jnp.sqrt(jnp.mean(x * x, axis=-1))
+    thr = snr_threshold * sigma
+    count = jnp.sum((x > thr).astype(jnp.int32), axis=-1)
+    peak_snr = jnp.max(x, axis=-1) / jnp.maximum(sigma, jnp.float32(1e-30))
+    del n
+    return count, peak_snr
+
+
+def detect(waterfall: jnp.ndarray, time_reserved_count: int,
+           snr_threshold: float, max_boxcar_length: int) -> DetectResult:
+    """Full detection chain on a frequency-major dynamic spectrum."""
+    freq_bins, time_samples = waterfall.shape[-2], waterfall.shape[-1]
+    if time_samples <= time_reserved_count:
+        t = time_samples  # ref: signal_detect_pipe.hpp:291-296 warns, keeps all
+    else:
+        t = time_samples - time_reserved_count
+
+    # zapped channels: first time sample exactly zero (ref: 262-284)
+    zero_count = jnp.sum(
+        (_norm(waterfall[..., 0]) == 0).astype(jnp.int32), axis=-1)
+
+    # time series: sum power over frequency for the first t samples (ref: 305-316)
+    ts = jnp.sum(_norm(waterfall[..., :t]), axis=-2)
+    ts = ts - jnp.mean(ts, axis=-1, keepdims=True)  # ref: 321-334
+
+    lengths = boxcar_lengths(max_boxcar_length, t)
+    n_box = len(lengths)
+
+    # prefix sum once, sliding-window differences per length (ref: 368-399)
+    acc = jnp.cumsum(ts, axis=-1)
+
+    counts = []
+    peaks = []
+    series_rows = []
+    for b in lengths:
+        if b == 1:
+            series = ts
+        else:
+            # d_accumulated[i + b] - d_accumulated[i] for i in [0, t-b)
+            series = acc[..., b:] - acc[..., :-b]
+        c, p = count_signal(series, snr_threshold)
+        counts.append(c)
+        peaks.append(p)
+        pad = t - series.shape[-1]
+        if pad:
+            series = jnp.pad(series,
+                             [(0, 0)] * (series.ndim - 1) + [(0, pad)])
+        series_rows.append(series)
+    del n_box
+    return DetectResult(
+        zero_count=zero_count,
+        time_series=ts,
+        boxcar_lengths=lengths,
+        signal_counts=jnp.stack(counts, axis=-1),
+        boxcar_series=jnp.stack(series_rows, axis=-2),
+        snr_peaks=jnp.stack(peaks, axis=-1),
+    )
+
+
+# ----------------------------------------------------------------
+# numpy golden model
+# ----------------------------------------------------------------
+
+def detect_oracle(waterfall: np.ndarray, time_reserved_count: int,
+                  snr_threshold: float, max_boxcar_length: int):
+    """Reference-faithful numpy recomputation (for tests)."""
+    time_samples = waterfall.shape[-1]
+    t = time_samples - time_reserved_count \
+        if time_samples > time_reserved_count else time_samples
+    power = np.abs(waterfall) ** 2
+    zero_count = int(np.sum(power[..., 0] == 0))
+    ts = power[:, :t].sum(axis=0)
+    ts = ts - ts.mean()
+    lengths = boxcar_lengths(max_boxcar_length, t)
+    acc = np.cumsum(ts)
+    counts = []
+    for b in lengths:
+        if b == 1:
+            series = ts
+        else:
+            series = acc[b:] - acc[:-b]
+            series = series[: t - b]
+        thr = snr_threshold * np.sqrt(np.mean(series * series))
+        counts.append(int(np.sum(series > thr)))
+    return zero_count, ts, lengths, counts

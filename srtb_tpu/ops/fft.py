@@ -1,0 +1,146 @@
+"""FFT layer.
+
+Replaces the reference's vendor FFT dispatcher (ref: fft/fft.hpp:54-160 with
+cuFFT/hipFFT/muFFT/FFTW/naive wrappers) with XLA's TPU FFT behind the same
+conventions, plus a four-step (Bailey) decomposition for sizes where a
+single monolithic 1-D FFT is slow or unsupported.
+
+Conventions reproduced from the reference:
+- forward transforms are unnormalized (cuFFT style);
+- "backward" C2C means unnormalized inverse, i.e. numpy's
+  ``ifft(..., norm="forward")``;
+- the R2C output drops the Nyquist bin so the usable spectrum has exactly
+  n/2 channels (ref: fft_pipe.hpp:75-77);
+- the waterfall FFT reshapes the n/2-channel dedispersed spectrum to
+  ``[spectrum_channel_count, watfft_len]`` (each row = one coarse frequency
+  sub-band, contiguous) and runs an unnormalized backward C2C per row
+  (ref: fft_pipe.hpp:295-311), giving a frequency-major dynamic spectrum.
+
+The plan cache of the reference (fft_wrapper.hpp set_size / shared work
+area) maps to the XLA compilation cache: a given (shape, kind) compiles
+once.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def rfft_drop_nyquist(x: jnp.ndarray) -> jnp.ndarray:
+    """R2C FFT of the whole segment, highest bin dropped: n real samples ->
+    n/2 complex channels (ref: fft_pipe.hpp:44-78)."""
+    return jnp.fft.rfft(x)[..., :-1]
+
+
+def c2c_forward(x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    return jnp.fft.fft(x, axis=axis)
+
+
+def c2c_backward(x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """Unnormalized inverse C2C (cuFFT BACKWARD semantics)."""
+    return jnp.fft.ifft(x, axis=axis, norm="forward")
+
+
+def waterfall_c2c(spectrum: jnp.ndarray, channel_count: int) -> jnp.ndarray:
+    """Dedispersed spectrum (n/2 complex) -> dynamic spectrum
+    ``[channel_count, watfft_len]`` via per-row unnormalized backward C2C
+    (ref: fft_pipe.hpp:285-372).  Rows are coarse frequency channels; columns
+    are time samples within the segment."""
+    n = spectrum.shape[-1]
+    watfft_len = n // channel_count
+    x = spectrum[..., :channel_count * watfft_len]
+    x = x.reshape(*spectrum.shape[:-1], channel_count, watfft_len)
+    return c2c_backward(x, axis=-1)
+
+
+# ----------------------------------------------------------------
+# four-step (Bailey) decomposition for very large 1-D FFTs
+# ----------------------------------------------------------------
+#
+# FFT_n = transpose . FFT_rows(n2) . twiddle . FFT_cols(n1) with n = n1*n2.
+# On TPU this turns one huge 1-D FFT (which XLA may refuse or handle with a
+# poor plan) into two large *batched* FFTs plus elementwise twiddles —
+# exactly the shape XLA tiles well.  This is hard part #1 of SURVEY.md §7.
+
+def _twiddle(n1: int, n2: int, inverse: bool) -> np.ndarray:
+    """w[j1, j2] = exp(+-2*pi*i*j1*j2/n), computed in f64 on host."""
+    j1 = np.arange(n1, dtype=np.float64)[:, None]
+    j2 = np.arange(n2, dtype=np.float64)[None, :]
+    sign = 2.0j if inverse else -2.0j
+    # exact phase reduction: phase = j1*j2/n mod 1 computed in f64 is accurate
+    # enough for n <= 2^32 given j1*j2 < 2^53
+    return np.exp(sign * np.pi * ((j1 * j2) % (n1 * n2)) / (n1 * n2)).astype(
+        np.complex64)
+
+
+def _split_factor(n: int) -> int:
+    """Pick n1 ~ sqrt(n), a power of two (n must be a power of two)."""
+    log2n = n.bit_length() - 1
+    return 1 << (log2n // 2)
+
+
+def four_step_fft(x: jnp.ndarray, inverse: bool = False) -> jnp.ndarray:
+    """1-D C2C FFT of power-of-two length via the four-step algorithm.
+    Unnormalized in both directions (matching c2c_forward / c2c_backward)."""
+    n = x.shape[-1]
+    if n & (n - 1):
+        raise ValueError("four_step_fft requires power-of-two length")
+    n1 = _split_factor(n)
+    n2 = n // n1
+    tw = jnp.asarray(_twiddle(n1, n2, inverse))
+    # view as [n1, n2] row-major: x[j1*n2 + j2]
+    a = x.reshape(*x.shape[:-1], n1, n2)
+    # FFT over the n1 axis (columns)
+    if inverse:
+        a = jnp.fft.ifft(a, axis=-2, norm="forward")
+    else:
+        a = jnp.fft.fft(a, axis=-2)
+    a = a * tw
+    if inverse:
+        a = jnp.fft.ifft(a, axis=-1, norm="forward")
+    else:
+        a = jnp.fft.fft(a, axis=-1)
+    # result index k = k2*n1 + k1 -> transpose to linear order
+    a = jnp.swapaxes(a, -1, -2)
+    return a.reshape(*x.shape[:-1], n)
+
+
+def rfft_via_c2c(x: jnp.ndarray, use_four_step: bool = False) -> jnp.ndarray:
+    """R2C FFT of 2m reals via one m-point C2C plus Hermitian post-process,
+    returning m+1 bins (like rfft).  This is the half-size C2C trick the
+    reference implements in fft/fft_1d_r2c_post_process.hpp:33-82 and
+    naive_fft.hpp:219-261; combined with four_step_fft it covers segment
+    sizes beyond what a monolithic XLA R2C handles."""
+    n = x.shape[-1]
+    if n % 2:
+        raise ValueError("even length required")
+    m = n // 2
+    z = x.reshape(*x.shape[:-1], m, 2)
+    z = jax.lax.complex(z[..., 0], z[..., 1])
+    zf = four_step_fft(z) if use_four_step else jnp.fft.fft(z)
+    # Hermitian split: X[k] = F[k] + conj(F[m-k]) pieces
+    k = jnp.arange(m + 1)
+    zf_ext = jnp.concatenate([zf, zf[..., :1]], axis=-1)  # F[m] = F[0]
+    f_k = zf_ext[..., k]
+    f_mk = jnp.conj(zf_ext[..., (m - k) % m])
+    even = 0.5 * (f_k + f_mk)
+    odd = -0.5j * (f_k - f_mk)
+    w = jnp.exp(jnp.asarray(-2j * np.pi, dtype=zf.dtype)
+                * k.astype(jnp.float32) / n)
+    return even + w * odd
+
+
+# Threshold above which the segment R2C switches to the chunked four-step
+# path.  2^27 complex C2C is well within one v5e chip; tune with bench.py.
+LARGE_FFT_THRESHOLD = 1 << 27
+
+
+def segment_rfft(x: jnp.ndarray) -> jnp.ndarray:
+    """The segment-sized R2C with the drop-Nyquist convention, choosing the
+    monolithic or four-step path by size."""
+    n = x.shape[-1]
+    if n // 2 > LARGE_FFT_THRESHOLD:
+        return rfft_via_c2c(x, use_four_step=True)[..., :-1]
+    return rfft_drop_nyquist(x)

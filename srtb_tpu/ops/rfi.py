@@ -1,0 +1,146 @@
+"""RFI mitigation kernels.
+
+Three methods, mirroring the reference:
+- stage 1: average-intensity threshold zap with normalization fused in
+  (ref: pipeline/rfi_mitigation_pipe.hpp:50-80);
+- manual frequency-range zap from a "a-b, c-d" config string
+  (ref: spectrum/rfi_mitigation.hpp:63-158);
+- stage 2: spectral-kurtosis zap over the dynamic spectrum
+  (ref: spectrum/rfi_mitigation.hpp:290-341,
+  mitigate_rfi_spectral_kurtosis_method_2).
+
+All are pure jittable functions over the whole spectrum — the reference's
+map_average / multi_mapreduce reductions become jnp.mean/sum that XLA maps
+onto the VPU reduction trees.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from srtb_tpu.utils.logging import log
+
+
+def _norm(c: jnp.ndarray) -> jnp.ndarray:
+    """|c|^2 like srtb::norm (ref: math.hpp:58-70)."""
+    return jnp.real(c) ** 2 + jnp.imag(c) ** 2
+
+
+def mitigate_rfi_average_and_normalize(
+        spectrum: jnp.ndarray, threshold: float,
+        normalization_coefficient) -> jnp.ndarray:
+    """Zap channels whose power exceeds ``threshold * mean power``; scale the
+    survivors by the normalization coefficient
+    (ref: rfi_mitigation_pipe.hpp:50-80).
+
+    The coefficient is ``(N^2 / spectrum_channel_count)^(-1/2)`` computed by
+    the caller — it undoes the two unnormalized FFTs' N-growth
+    (ref: rfi_mitigation_pipe.hpp:61-65).
+    """
+    power = _norm(spectrum)
+    mean_power = jnp.mean(power)
+    zap = power > threshold * mean_power
+    return jnp.where(zap, jnp.zeros((), dtype=spectrum.dtype),
+                     spectrum * normalization_coefficient)
+
+
+def normalization_coefficient(n_channels: int,
+                              spectrum_channel_count: int) -> float:
+    """(N^2/spectrum_channel_count)^-0.5 in f32, matching the reference's
+    float evaluation (ref: rfi_mitigation_pipe.hpp:61-65)."""
+    n = np.float32(n_channels)
+    return float(np.power(n * n / np.float32(spectrum_channel_count),
+                          np.float32(-0.5)))
+
+
+# ----------------------------------------------------------------
+# manual frequency-range zap
+# ----------------------------------------------------------------
+
+def eval_rfi_ranges(mitigate_rfi_freq_list: str) -> list[tuple[float, float]]:
+    """Parse "11-12, 15-90" into (low, high) MHz pairs
+    (ref: spectrum/rfi_mitigation.hpp:63-88)."""
+    ranges = []
+    text = mitigate_rfi_freq_list.strip()
+    if not text:
+        return ranges
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        pieces = [p for p in part.split("-") if p.strip()]
+        if len(pieces) != 2:
+            log.warning(f"[eval_rfi_ranges] cannot parse {part!r}")
+            continue
+        ranges.append((float(pieces[0]), float(pieces[1])))
+    return ranges
+
+
+def rfi_ranges_to_mask(ranges, n_channels: int, baseband_freq_low: float,
+                       baseband_bandwidth: float) -> np.ndarray | None:
+    """Host-side: turn frequency ranges into a boolean zap mask over bins.
+
+    Bin mapping matches the reference: bin = round((f - f_low) / bw * (N-1)),
+    inclusive on both ends, with range order flipped when the band is
+    inverted (ref: spectrum/rfi_mitigation.hpp:102-143).  Returns None when
+    there is nothing to zap (lets jit skip the multiply).
+    """
+    if not ranges:
+        return None
+    mask = np.zeros(n_channels, dtype=bool)
+    bw_sign = np.signbit(baseband_bandwidth)
+    freq_high = baseband_freq_low + baseband_bandwidth
+    any_zap = False
+    for rfi_low, rfi_high in ranges:
+        if np.signbit(rfi_high - rfi_low) != bw_sign:
+            rfi_low, rfi_high = rfi_high, rfi_low
+        lo = int(round((rfi_low - baseband_freq_low) / baseband_bandwidth
+                       * (n_channels - 1)))
+        hi = int(round((rfi_high - baseband_freq_low) / baseband_bandwidth
+                       * (n_channels - 1)))
+        if 0 <= lo <= hi < n_channels:
+            mask[lo:hi + 1] = True
+            any_zap = True
+        else:
+            log.warning(
+                f"[mitigate_rfi_manual] RFI range {rfi_low} - {rfi_high} MHz "
+                f"out of baseband range {baseband_freq_low} - {freq_high} MHz")
+    return mask if any_zap else None
+
+
+def mitigate_rfi_manual(spectrum: jnp.ndarray,
+                        zap_mask: jnp.ndarray | None) -> jnp.ndarray:
+    """Apply a precomputed zap mask (ref: rfi_mitigation.hpp:97-158)."""
+    if zap_mask is None:
+        return spectrum
+    return jnp.where(zap_mask, jnp.zeros((), dtype=spectrum.dtype), spectrum)
+
+
+# ----------------------------------------------------------------
+# spectral kurtosis (stage 2)
+# ----------------------------------------------------------------
+
+def mitigate_rfi_spectral_kurtosis(waterfall: jnp.ndarray,
+                                   sk_threshold: float) -> jnp.ndarray:
+    """Zap frequency rows of the dynamic spectrum whose spectral kurtosis
+    falls outside [2 - thr, thr] rescaled by (M-1)/(M+1)
+    (ref: spectrum/rfi_mitigation.hpp:290-341).
+
+    ``waterfall`` is frequency-major ``[..., freq, time]``; SK is computed
+    per frequency row over the M time samples.
+    """
+    m = waterfall.shape[-1]
+    thr_high = max(sk_threshold, 2.0 - sk_threshold)
+    thr_low = min(sk_threshold, 2.0 - sk_threshold)
+    scale = (m - 1.0) / (m + 1.0)
+    thr_high_ = np.float32(thr_high * scale + 1.0)
+    thr_low_ = np.float32(thr_low * scale + 1.0)
+
+    x2 = _norm(waterfall)
+    s2 = jnp.sum(x2, axis=-1)
+    s4 = jnp.sum(x2 * x2, axis=-1)
+    sk = m * s4 / (s2 * s2)
+    zap = (sk > thr_high_) | (sk < thr_low_)
+    return jnp.where(zap[..., None], jnp.zeros((), dtype=waterfall.dtype),
+                     waterfall)

@@ -1,0 +1,134 @@
+"""Spectrum waterfall simplification: resample + normalize + colormap.
+
+Re-design of the reference's resample_spectrum kernels
+(ref: spectrum/simplify_spectrum.hpp:137-230 v1 math; 423-620 v3 is the
+same math with GPU work-group tuning) for the MXU: the 2-D downsample is
+area-weighted along frequency and linearly interpolated along time, which
+is exactly two banded weight matrices — so the whole resample becomes
+
+    out[H, W] = W_freq[H, in_h] @ power[in_h, in_w] @ W_time[in_w, W]
+
+two matmuls that XLA tiles onto the systolic array, instead of the
+reference's one-work-group-per-output-pixel tree reduction.
+
+Normalization (ref: simplify_spectrum.hpp:627-644) and the ARGB colormap
+(ref: simplify_spectrum.hpp:652-731, colors config.hpp:60-68) follow.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# GUI colors (ref: config.hpp:60-68)
+OPAQUE = 0xFF000000
+COLOR_0 = 0x1F1E33 | OPAQUE
+COLOR_1 = 0x33E1F1 | OPAQUE
+COLOR_OVERFLOW = 0xE0E1CC | OPAQUE
+
+
+def time_interp_weights(in_w: int, out_w: int,
+                        dtype=np.float32) -> np.ndarray:
+    """[in_w, out_w] linear-interpolation weights along the time axis
+    (ref: simplify_spectrum.hpp:152-181: x1 = x2/out_w*in_w, split between
+    floor(x1) and floor(x1)+1)."""
+    w = np.zeros((in_w, out_w), dtype=np.float64)
+    for x2 in range(out_w):
+        x1 = x2 / out_w * in_w
+        left = int(np.floor(x1))
+        right = left + 1
+        left_portion = (left + 1) - x1
+        right_portion = x1 - left
+        w[min(left, in_w - 1), x2] += left_portion
+        w[min(right, in_w - 1), x2] += right_portion
+    return w.astype(dtype)
+
+
+def freq_area_weights(in_h: int, out_h: int,
+                      dtype=np.float32) -> np.ndarray:
+    """[out_h, in_h] area-sum weights along the frequency axis
+    (ref: simplify_spectrum.hpp:183-225: output row y2 sums input rows in
+    [y2/out_h*in_h, (y2+1)/out_h*in_h) with fractional edge weights)."""
+    w = np.zeros((out_h, in_h), dtype=np.float64)
+    for y2 in range(out_h):
+        up_acc = y2 / out_h * in_h
+        down_acc = (y2 + 1) / out_h * in_h
+        up = int(np.ceil(up_acc))
+        down = int(np.floor(down_acc))
+        if up > up_acc:
+            w[y2, up - 1] += up - up_acc
+        w[y2, up:down] += 1.0
+        if down_acc > down and down < in_h:
+            w[y2, down] += down_acc - down
+    return w.astype(dtype)
+
+
+def resample_spectrum(power: jnp.ndarray, w_freq: jnp.ndarray,
+                      w_time: jnp.ndarray) -> jnp.ndarray:
+    """power [in_h(freq), in_w(time)] -> [out_h, out_w] via two matmuls."""
+    return (w_freq @ power) @ w_time
+
+
+def normalize_by_average(img: jnp.ndarray) -> jnp.ndarray:
+    """Scale so the average maps to 0.5 (ref: simplify_spectrum.hpp:627-644);
+    skipped when the average is ~0."""
+    avg = jnp.mean(img)
+    coeff = jnp.where(avg > jnp.finfo(img.dtype).eps, 1.0 / (2.0 * avg), 1.0)
+    return img * coeff
+
+
+def _argb_components(argb: int):
+    return ((argb >> 24) & 0xFF, (argb >> 16) & 0xFF,
+            (argb >> 8) & 0xFF, argb & 0xFF)
+
+
+def generate_pixmap(intensity: jnp.ndarray, color_0: int = COLOR_0,
+                    color_1: int = COLOR_1,
+                    color_overflow: int = COLOR_OVERFLOW) -> jnp.ndarray:
+    """Map intensities in [0,1] to ARGB32 by per-channel lerp; out-of-range
+    values get the overflow color (ref: simplify_spectrum.hpp:652-731)."""
+    comps_0 = _argb_components(color_0)
+    comps_1 = _argb_components(color_1)
+    in_range = (intensity >= 0) & (intensity <= 1)
+    x = jnp.clip(intensity, 0.0, 1.0)
+    out = jnp.zeros(intensity.shape, dtype=jnp.uint32)
+    for shift, c0, c1 in zip((24, 16, 8, 0), comps_0, comps_1):
+        chan = ((1.0 - x) * c0 + x * c1).astype(jnp.uint32)
+        out = out | (chan << shift)
+    overflow = jnp.uint32(color_overflow)
+    return jnp.where(in_range, out, overflow)
+
+
+# ----------------------------------------------------------------
+# numpy golden model of the reference kernel (for tests)
+# ----------------------------------------------------------------
+
+def resample_oracle(power: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
+    """Direct per-pixel transliteration of the v1 kernel semantics."""
+    in_h, in_w = power.shape
+    out = np.zeros((out_h, out_w), dtype=np.float64)
+    for y2 in range(out_h):
+        for x2 in range(out_w):
+            x1 = x2 / out_w * in_w
+            left = int(np.floor(x1))
+            right = left + 1
+            lp = (left + 1) - x1
+            rp = x1 - left
+
+            def sample(y):
+                r = power[y, min(right, in_w - 1)]
+                return lp * power[y, left] + rp * r
+
+            up_acc = y2 / out_h * in_h
+            down_acc = (y2 + 1) / out_h * in_h
+            up = int(np.ceil(up_acc))
+            down = int(np.floor(down_acc))
+            s = 0.0
+            if up > up_acc:
+                s += (up - up_acc) * sample(up - 1)
+            for y in range(up, down):
+                s += sample(y)
+            if down_acc > down and down < in_h:
+                s += (down_acc - down) * sample(down)
+            out[y2, x2] = s
+    return out

@@ -1,0 +1,22 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+This mirrors the reference's CI strategy of testing multi-backend code on
+CPU-only runners (ref: .circleci/config.yml, SURVEY.md §4): CPU JAX is the
+"fake backend"; multi-chip sharding logic is validated on
+``--xla_force_host_platform_device_count=8`` virtual devices.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# some environments force a TPU platform plugin via jax.config at interpreter
+# startup (sitecustomize); programmatic config wins over env vars, so force
+# it back to CPU the same way before any backend is initialized.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

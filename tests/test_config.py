@@ -1,0 +1,57 @@
+"""Config / expression-parsing tests (ref oracle: program_options.hpp
+expression handling; example config userspace/srtb_config_1644-4559.cfg)."""
+
+import os
+import tempfile
+
+from srtb_tpu.config import Config
+from srtb_tpu.utils.expression import parse_expression, parse_number
+
+
+def test_expressions():
+    assert parse_expression("2 ** 30") == 2 ** 30
+    assert parse_expression("1405 + (64 / 2)") == 1437.0
+    assert parse_expression("128 * 1e6") == 128e6
+    assert parse_number("-478.80") == -478.80
+    assert parse_number("2 ** 11") == 2048
+
+
+def test_config_file_roundtrip():
+    text = """
+# example config file (mirrors srtb_config_1644-4559.cfg)
+baseband_input_count = 2 ** 20
+spectrum_channel_count = 2 ** 11
+log_level = 4
+mitigate_rfi_average_method_threshold = 1.5
+signal_detect_max_boxcar_length = 256
+baseband_input_bits = 2
+dm = -478.80
+baseband_reserve_sample = 0
+baseband_freq_low = 1405 + (64 / 2)
+baseband_bandwidth = -64
+baseband_sample_rate = 128 * 1e6
+mitigate_rfi_freq_list = 1418-1422
+"""
+    with tempfile.NamedTemporaryFile("w", suffix=".cfg", delete=False) as f:
+        f.write(text)
+        path = f.name
+    try:
+        cfg = Config()
+        cfg.load_file(path)
+    finally:
+        os.unlink(path)
+    assert cfg.baseband_input_count == 2 ** 20
+    assert cfg.spectrum_channel_count == 2048
+    assert cfg.baseband_input_bits == 2
+    assert cfg.dm == -478.80
+    assert cfg.baseband_reserve_sample is False
+    assert cfg.baseband_freq_low == 1437.0
+    assert cfg.baseband_bandwidth == -64
+    assert cfg.baseband_sample_rate == 128e6
+    assert cfg.mitigate_rfi_freq_list == "1418-1422"
+
+
+def test_cli_precedence():
+    cfg = Config.from_args(["--dm=10.5", "--baseband-input-count", "2**16"])
+    assert cfg.dm == 10.5
+    assert cfg.baseband_input_count == 65536

@@ -1,0 +1,45 @@
+"""df64 two-float arithmetic tests vs float64 (oracle style follows the
+reference's test-df64.cpp:28-60 + tests/test-df64.py numpy cross-check)."""
+
+import jax
+import numpy as np
+
+from srtb_tpu.ops import df64 as ds
+
+
+def _as_f64(pair):
+    return ds.to_float64(tuple(np.asarray(p) for p in pair))
+
+
+def test_roundtrip():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(1000) * 1e8
+    hi, lo = ds.from_float64(x)
+    np.testing.assert_allclose(_as_f64((hi, lo)), x, rtol=1e-14)
+
+
+def test_add_mul_div_precision():
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal(1000) * 1e6
+    b = rng.standard_normal(1000) * 1e3 + 2000.0
+    a_d = tuple(map(np.asarray, ds.from_float64(a)))
+    b_d = tuple(map(np.asarray, ds.from_float64(b)))
+
+    def run(op):
+        return _as_f64(jax.jit(lambda x, y: op(x, y))(a_d, b_d))
+
+    # input representation error is ~|a| * 2^-50, which becomes the absolute
+    # error floor under cancellation in add
+    np.testing.assert_allclose(run(ds.add), a + b, rtol=1e-12, atol=1e-8)
+    np.testing.assert_allclose(run(ds.mul), a * b, rtol=1e-12)
+    np.testing.assert_allclose(run(ds.div), a / b, rtol=1e-12)
+
+
+def test_frac_large_values():
+    """Fraction extraction at k ~ 1e9, the dedispersion use case
+    (ref: coherent_dedispersion.hpp:49)."""
+    k = np.array([1.23456789e9 + 0.625, -9.876543e8 - 0.25, 3.0, -0.75])
+    k_d = tuple(map(np.asarray, ds.from_float64(k)))
+    frac = np.asarray(jax.jit(ds.frac)(k_d))
+    expected = np.modf(k)[0]
+    np.testing.assert_allclose(frac, expected, atol=2e-5)

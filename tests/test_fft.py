@@ -1,0 +1,81 @@
+"""FFT layer tests.
+
+Oracle style follows the reference: a golden FFT (numpy, standing in for
+FFTW in test-fft_wrappers.cpp:29-67) over size sweeps, including the
+four-step decomposition and the half-size-C2C R2C trick
+(ref: fft/fft_1d_r2c_post_process.hpp, naive_fft.hpp:219-261).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from srtb_tpu.ops import fft as F
+
+
+@pytest.mark.parametrize("log2n", [5, 8, 12, 16, 20])
+def test_rfft_drop_nyquist(log2n):
+    n = 1 << log2n
+    rng = np.random.default_rng(log2n)
+    x = rng.standard_normal(n).astype(np.float32)
+    got = np.asarray(F.rfft_drop_nyquist(jnp.asarray(x)))
+    expected = np.fft.rfft(x)[:-1]
+    assert got.shape == (n // 2,)
+    np.testing.assert_allclose(got, expected.astype(np.complex64),
+                               rtol=1e-4, atol=1e-2 * np.sqrt(n))
+
+
+def test_c2c_backward_unnormalized():
+    """Backward C2C must be unnormalized (cuFFT convention): ifft(fft(x)) ==
+    n * x."""
+    n = 1024
+    rng = np.random.default_rng(0)
+    x = (rng.standard_normal(n) + 1j * rng.standard_normal(n)).astype(
+        np.complex64)
+    y = np.asarray(F.c2c_backward(F.c2c_forward(jnp.asarray(x))))
+    np.testing.assert_allclose(y, n * x, rtol=1e-4, atol=1e-3 * n)
+
+
+@pytest.mark.parametrize("log2n", [6, 10, 14, 18])
+@pytest.mark.parametrize("inverse", [False, True])
+def test_four_step_fft(log2n, inverse):
+    n = 1 << log2n
+    rng = np.random.default_rng(log2n)
+    x = (rng.standard_normal(n) + 1j * rng.standard_normal(n)).astype(
+        np.complex64)
+    got = np.asarray(F.four_step_fft(jnp.asarray(x), inverse=inverse))
+    expected = np.fft.ifft(x) * n if inverse else np.fft.fft(x)
+    np.testing.assert_allclose(got, expected.astype(np.complex64),
+                               rtol=1e-3, atol=2e-2 * np.sqrt(n))
+
+
+@pytest.mark.parametrize("log2n", [4, 8, 12, 16])
+@pytest.mark.parametrize("use_four_step", [False, True])
+def test_rfft_via_c2c(log2n, use_four_step):
+    n = 1 << log2n
+    rng = np.random.default_rng(log2n)
+    x = rng.standard_normal(n).astype(np.float32)
+    got = np.asarray(F.rfft_via_c2c(jnp.asarray(x),
+                                    use_four_step=use_four_step))
+    expected = np.fft.rfft(x)
+    assert got.shape == (n // 2 + 1,)
+    np.testing.assert_allclose(got, expected.astype(np.complex64),
+                               rtol=1e-3, atol=2e-2 * np.sqrt(n))
+
+
+def test_waterfall_layout():
+    """Waterfall output must be frequency-major: row i is the unnormalized
+    backward C2C of the i-th contiguous sub-band (ref: fft_pipe.hpp:295-343,
+    signal_detect_pipe.hpp:305-316 indexing)."""
+    channels, watfft_len = 8, 32
+    n = channels * watfft_len
+    rng = np.random.default_rng(3)
+    spec = (rng.standard_normal(n) + 1j * rng.standard_normal(n)).astype(
+        np.complex64)
+    wf = np.asarray(F.waterfall_c2c(jnp.asarray(spec), channels))
+    assert wf.shape == (channels, watfft_len)
+    for i in range(channels):
+        row = spec[i * watfft_len:(i + 1) * watfft_len]
+        expected = np.fft.ifft(row) * watfft_len
+        np.testing.assert_allclose(wf[i], expected.astype(np.complex64),
+                                   rtol=1e-4, atol=1e-3 * watfft_len)

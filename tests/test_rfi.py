@@ -1,0 +1,101 @@
+"""RFI mitigation tests (oracle style follows test-rfi_mitigation.cpp:
+range parsing + end-state of zapped bins, plus numpy recomputation)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from srtb_tpu.ops import rfi
+
+
+def test_eval_rfi_ranges():
+    ranges = rfi.eval_rfi_ranges("11-12, 15-90, 233-235, 1176-1177")
+    assert ranges == [(11.0, 12.0), (15.0, 90.0), (233.0, 235.0),
+                      (1176.0, 1177.0)]
+    assert rfi.eval_rfi_ranges("") == []
+    assert rfi.eval_rfi_ranges("garbage") == []
+
+
+def test_average_method_zap_and_normalize():
+    n = 1 << 12
+    rng = np.random.default_rng(1)
+    spec = (rng.standard_normal(n) + 1j * rng.standard_normal(n)).astype(
+        np.complex64)
+    spec[100] = 1000.0 + 0j  # strong RFI line
+    threshold = 10.0
+    coeff = rfi.normalization_coefficient(n, 256)
+
+    got = np.asarray(rfi.mitigate_rfi_average_and_normalize(
+        jnp.asarray(spec), threshold, coeff))
+
+    power = np.abs(spec) ** 2
+    mean_power = power.mean(dtype=np.float64)
+    zap = power > threshold * mean_power
+    assert zap[100]
+    assert got[100] == 0
+    np.testing.assert_allclose(got[~zap], spec[~zap] * np.float32(coeff),
+                               rtol=1e-5)
+
+
+def test_normalization_coefficient():
+    # (N^2 / nchan)^-0.5 (ref: rfi_mitigation_pipe.hpp:61-65)
+    n, nchan = 1 << 20, 1 << 15
+    expected = (float(n) * float(n) / nchan) ** -0.5
+    # the reference evaluates this in float (rfi_mitigation_pipe.hpp:61-65)
+    assert abs(rfi.normalization_coefficient(n, nchan) / expected - 1) < 1e-6
+
+
+def test_manual_zap_inverted_band():
+    """J1644-4559 style: freq_low 1437, bandwidth -64, zap 1418-1422 MHz
+    (ref: srtb_config_1644-4559.cfg + rfi_mitigation.hpp:102-143)."""
+    n = 64
+    f_low, bw = 1437.0, -64.0
+    mask = rfi.rfi_ranges_to_mask([(1418.0, 1422.0)], n, f_low, bw)
+    assert mask is not None
+    lo = round((1422.0 - f_low) / bw * (n - 1))
+    hi = round((1418.0 - f_low) / bw * (n - 1))
+    expected = np.zeros(n, dtype=bool)
+    expected[lo:hi + 1] = True
+    np.testing.assert_array_equal(mask, expected)
+
+    spec = jnp.ones(n, dtype=jnp.complex64)
+    got = np.asarray(rfi.mitigate_rfi_manual(spec, jnp.asarray(mask)))
+    np.testing.assert_array_equal(got == 0, expected)
+
+
+def test_manual_zap_out_of_range_warns_not_zaps():
+    mask = rfi.rfi_ranges_to_mask([(10.0, 20.0)], 64, 1437.0, -64.0)
+    assert mask is None
+
+
+def test_spectral_kurtosis():
+    """Gaussian noise rows survive; a CW tone row (SK -> 1... actually
+    constant-amplitude -> SK near 1, zapped low) and an impulsive row
+    (SK high) are zapped."""
+    rng = np.random.default_rng(5)
+    m = 512  # time samples
+    nfreq = 8
+    wf = (rng.standard_normal((nfreq, m))
+          + 1j * rng.standard_normal((nfreq, m))).astype(np.complex64)
+    wf[2] = 1.0 + 0j              # constant amplitude: SK ~ 1 < low threshold
+    wf[5, :] = 0.01
+    wf[5, 100] = 300.0            # impulsive: SK >> high threshold
+    thr = 1.1
+
+    got = np.asarray(rfi.mitigate_rfi_spectral_kurtosis(jnp.asarray(wf), thr))
+
+    # numpy oracle (ref: rfi_mitigation.hpp:290-341)
+    x2 = np.abs(wf.astype(np.complex128)) ** 2
+    s2 = x2.sum(axis=1)
+    s4 = (x2 * x2).sum(axis=1)
+    sk = m * s4 / (s2 * s2)
+    scale = (m - 1.0) / (m + 1.0)
+    hi = max(thr, 2 - thr) * scale + 1
+    lo = min(thr, 2 - thr) * scale + 1
+    zap = (sk > hi) | (sk < lo)
+    assert zap[2] and zap[5]
+    assert not zap[0]
+    for i in range(nfreq):
+        if zap[i]:
+            assert np.all(got[i] == 0)
+        else:
+            np.testing.assert_array_equal(got[i], wf[i])

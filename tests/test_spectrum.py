@@ -1,0 +1,59 @@
+"""Spectrum simplification tests (oracle: resample_oracle transliterates the
+v1 kernel, ref: spectrum/simplify_spectrum.hpp:137-230; pixmap colors
+config.hpp:60-68)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from srtb_tpu.ops import spectrum as sp
+
+
+def test_resample_matches_oracle():
+    rng = np.random.default_rng(11)
+    in_h, in_w, out_h, out_w = 37, 53, 9, 16
+    power = rng.random((in_h, in_w)).astype(np.float32)
+    w_f = sp.freq_area_weights(in_h, out_h)
+    w_t = sp.time_interp_weights(in_w, out_w)
+    got = np.asarray(sp.resample_spectrum(jnp.asarray(power),
+                                          jnp.asarray(w_f),
+                                          jnp.asarray(w_t)))
+    expected = sp.resample_oracle(power.astype(np.float64), out_h, out_w)
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_resample_conserves_area():
+    """Each output row sums input rows with total weight in_h/out_h."""
+    in_h, out_h = 64, 10
+    w_f = sp.freq_area_weights(in_h, out_h)
+    np.testing.assert_allclose(w_f.sum(axis=1), in_h / out_h, rtol=1e-5)
+    in_w, out_w = 64, 10
+    w_t = sp.time_interp_weights(in_w, out_w)
+    np.testing.assert_allclose(w_t.sum(axis=0), 1.0, rtol=1e-5)
+
+
+def test_normalize_by_average():
+    x = jnp.asarray(np.full((4, 4), 3.0, dtype=np.float32))
+    out = np.asarray(sp.normalize_by_average(x))
+    np.testing.assert_allclose(out, 0.5, rtol=1e-6)
+    zero = jnp.zeros((4, 4), dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(sp.normalize_by_average(zero)),
+                                  0.0)
+
+
+def test_pixmap_colors():
+    intensity = jnp.asarray(np.array([0.0, 1.0, 2.0, -0.5], dtype=np.float32))
+    out = np.asarray(sp.generate_pixmap(intensity))
+    assert out[0] == sp.COLOR_0
+    assert out[1] == sp.COLOR_1
+    assert out[2] == sp.COLOR_OVERFLOW
+    assert out[3] == sp.COLOR_OVERFLOW
+
+
+def test_pixmap_lerp_midpoint():
+    out = int(np.asarray(sp.generate_pixmap(
+        jnp.asarray(np.array([0.5], dtype=np.float32))))[0])
+    for shift in (24, 16, 8, 0):
+        c0 = (sp.COLOR_0 >> shift) & 0xFF
+        c1 = (sp.COLOR_1 >> shift) & 0xFF
+        got = (out >> shift) & 0xFF
+        assert abs(got - (c0 + c1) / 2) <= 1

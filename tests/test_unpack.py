@@ -1,0 +1,90 @@
+"""Unpack tests.
+
+Oracle style follows the reference's test-unpack.cpp: hand-computed bit
+patterns for sub-byte widths (test-unpack.cpp:63-139) plus random-data
+self-consistency against an independent numpy model (test-unpack.cpp:236-253).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from srtb_tpu.ops import unpack as U
+
+
+def test_unpack_1bit_pattern():
+    # 0b10110001 -> 1,0,1,1,0,0,0,1 (MSB first, ref: unpack.hpp:91-98)
+    data = jnp.asarray(np.array([0b10110001], dtype=np.uint8))
+    out = np.asarray(U.unpack(data, 1))
+    np.testing.assert_array_equal(out, [1, 0, 1, 1, 0, 0, 0, 1])
+
+
+def test_unpack_2bit_pattern():
+    # 0b10110001 -> 0b10, 0b11, 0b00, 0b01 (ref: unpack.hpp:116-119)
+    data = jnp.asarray(np.array([0b10110001], dtype=np.uint8))
+    out = np.asarray(U.unpack(data, 2))
+    np.testing.assert_array_equal(out, [2, 3, 0, 1])
+
+
+def test_unpack_4bit_pattern():
+    data = jnp.asarray(np.array([0xA7, 0x3C], dtype=np.uint8))
+    out = np.asarray(U.unpack(data, 4))
+    np.testing.assert_array_equal(out, [0xA, 0x7, 0x3, 0xC])
+
+
+@pytest.mark.parametrize("nbits", [1, 2, 4, 8, -8, 16, -16, 32])
+def test_unpack_random_vs_oracle(nbits):
+    rng = np.random.default_rng(42)
+    data = rng.integers(0, 256, size=1 << 12, dtype=np.uint8)
+    expected = U.unpack_oracle(data, nbits)
+    got = np.asarray(U.unpack(jnp.asarray(data), nbits))
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_unpack_window_fusion():
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=256, dtype=np.uint8)
+    window = rng.random(256 * 4).astype(np.float32)
+    expected = U.unpack_oracle(data, 2) * window
+    got = np.asarray(U.unpack(jnp.asarray(data), 2, jnp.asarray(window)))
+    np.testing.assert_allclose(got, expected, rtol=1e-6)
+
+
+def test_unpack_interleaved_2pol():
+    # "1212" layout (ref: unpack.hpp:214-244)
+    data = np.array([1, 101, 2, 102, 3, 103, 4, 104], dtype=np.uint8)
+    out1, out2 = U.unpack_interleaved_2pol(jnp.asarray(data), 8)
+    np.testing.assert_array_equal(np.asarray(out1), [1, 2, 3, 4])
+    np.testing.assert_array_equal(np.asarray(out2), [101, 102, 103, 104])
+
+
+def test_unpack_naocpsr_snap1():
+    # "1122" layout (ref: unpack.hpp:253-283)
+    data = np.array([1, 2, 101, 102, 3, 4, 103, 104], dtype=np.uint8)
+    out1, out2 = U.unpack_naocpsr_snap1(jnp.asarray(data), 8)
+    np.testing.assert_array_equal(np.asarray(out1), [1, 2, 3, 4])
+    np.testing.assert_array_equal(np.asarray(out2), [101, 102, 103, 104])
+
+
+def test_unpack_gznupsr_a1():
+    # 4-way word interleave with XOR 0x80 (ref: unpack.hpp:291-328)
+    word = np.arange(16, dtype=np.uint8)  # streams of 4 words each
+    data = np.concatenate([word, word + 16])
+    outs = U.unpack_gznupsr_a1(jnp.asarray(data))
+    assert len(outs) == 4
+    for i, out in enumerate(outs):
+        expected_bytes = np.concatenate([
+            (word[4 * i:4 * i + 4] ^ 0x80).view(np.int8),
+            ((word + 16)[4 * i:4 * i + 4] ^ 0x80).view(np.int8)])
+        np.testing.assert_array_equal(np.asarray(out),
+                                      expected_bytes.astype(np.float32))
+
+
+def test_unpack_gznupsr_a1_v2_1():
+    # 2-way word interleave, signed (ref: unpack.hpp:336-369)
+    data = np.arange(16, dtype=np.uint8)
+    out1, out2 = U.unpack_gznupsr_a1_v2_1(jnp.asarray(data))
+    np.testing.assert_array_equal(np.asarray(out1),
+                                  [0, 1, 2, 3, 8, 9, 10, 11])
+    np.testing.assert_array_equal(np.asarray(out2),
+                                  [4, 5, 6, 7, 12, 13, 14, 15])
