@@ -1,0 +1,1 @@
+from srtb_tpu.io import formats, file_input, writers  # noqa: F401

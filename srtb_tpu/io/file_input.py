@@ -1,0 +1,67 @@
+"""Baseband file reader with overlap-save seek-back.
+
+Mirrors read_file_pipe (ref: pipeline/read_file_pipe.hpp:31-127):
+- skip ``input_file_offset_bytes`` first;
+- each call reads ``baseband_input_count * |bits|/8 * data_stream_count``
+  bytes into a zero-filled buffer (short final reads stay zero-padded);
+- then seeks back ``nsamps_reserved`` samples' worth of bytes so
+  consecutive segments overlap (the overlap-save "long-context" mechanism);
+- a logical byte counter, not the stream position, tracks progress because
+  the final partial segment reads past EOF.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from srtb_tpu.config import Config
+from srtb_tpu.io import formats
+from srtb_tpu.ops import dedisperse as dd
+from srtb_tpu.pipeline.work import SegmentWork
+from srtb_tpu.utils.logging import log
+
+
+class BasebandFileReader:
+    """Iterates SegmentWork items from a raw baseband file."""
+
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+        self.fmt = formats.resolve(cfg.baseband_format_type)
+        self.segment_bytes = cfg.segment_bytes(self.fmt.data_stream_count)
+        nsamps = dd.nsamps_reserved(cfg)
+        self.reserved_bytes = int(nsamps * abs(cfg.baseband_input_bits)
+                                  // 8 * self.fmt.data_stream_count)
+        self._file = open(cfg.input_file_path, "rb")
+        self._file.seek(cfg.input_file_offset_bytes)
+        self._exhausted = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> SegmentWork:
+        if self._exhausted:
+            raise StopIteration
+        buf = np.zeros(self.segment_bytes, dtype=np.uint8)
+        chunk = self._file.read(self.segment_bytes)
+        if len(chunk) == 0:
+            log.info(f"[read_file] {self.cfg.input_file_path} has been read")
+            self._exhausted = True
+            raise StopIteration
+        buf[:len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
+        if len(chunk) < self.segment_bytes:
+            # final partial segment: emit zero-padded, then stop
+            # (ref: read_file_pipe.hpp:76-77 memset + short read)
+            self._exhausted = True
+        elif 0 < self.reserved_bytes < self.segment_bytes:
+            # overlap-save: rewind so the next segment reprocesses the
+            # dedispersion-corrupted tail (ref: read_file_pipe.hpp:86-99)
+            self._file.seek(-self.reserved_bytes, 1)
+        return SegmentWork(
+            data=buf,
+            timestamp=time.time_ns(),
+        )
+
+    def close(self):
+        self._file.close()

@@ -1,0 +1,223 @@
+"""Output writers: candidate capture (.bin/.npy/.tim), write-all mode, and
+the sigproc filterbank header.
+
+File formats are byte-compatible with the reference so its offline plot
+helpers (src/plot_spectrum.py, plot_tim.py) work unmodified:
+- ``<prefix><counter>.bin``      raw baseband bytes of the segment
+  (ref: write_signal_pipe.hpp:159-206);
+- ``<prefix><counter>.<i>.npy``  complex64 spectrum waterfall, shape
+  [freq_bins, time_samples] (ref: write_signal_pipe.hpp:209-246);
+- ``<prefix><counter>.<boxcar>.tim``  raw float32 time series
+  (ref: write_signal_pipe.hpp:249-280);
+- the "piggybank" logic keeps recent negatives and writes them when they
+  overlap (within 0.45 segment) a recent positive in another polarization
+  (ref: write_signal_pipe.hpp:77-140).
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+from dataclasses import dataclass
+
+import numpy as np
+
+from srtb_tpu.config import Config
+from srtb_tpu.pipeline.work import (NO_UDP_PACKET_COUNTER, SegmentResultWork)
+from srtb_tpu.utils.logging import log
+
+
+@dataclass
+class CandidateFiles:
+    """Paths written for one positive segment."""
+    bin_path: str
+    npy_paths: list
+    tim_paths: list
+
+
+class WriteSignalSink:
+    """Candidate writer with the reference's piggybank capture policy."""
+
+    def __init__(self, cfg: Config, fdatasync: bool = True):
+        self.cfg = cfg
+        self.fdatasync = fdatasync
+        self.recent_positive_timestamps: deque[int] = deque()
+        self.recent_negative_works: deque[SegmentResultWork] = deque()
+        self.written: list[CandidateFiles] = []
+        # check directory writability up front (ref: write_signal_pipe.hpp:62-75)
+        check_path = cfg.baseband_output_file_prefix + ".check"
+        with open(check_path, "wb"):
+            pass
+        os.unlink(check_path)
+
+    # ------------------------------------------------------------------
+
+    def _overlap_window_ns(self) -> float:
+        # 0.45 of a segment duration, in ns (ref: write_signal_pipe.hpp:84-86)
+        return (0.45 * 1e9 * self.cfg.baseband_input_count
+                / self.cfg.baseband_sample_rate)
+
+    def _overlaps_recent_positive(self, timestamp: int) -> bool:
+        w = self._overlap_window_ns()
+        return any(abs(timestamp - t) < w
+                   for t in self.recent_positive_timestamps)
+
+    def push(self, work: SegmentResultWork, has_signal: bool) -> None:
+        """Feed one processed segment; writes to disk when warranted."""
+        real_time = self.cfg.input_file_path == ""
+        w = self._overlap_window_ns()
+        ts = work.segment.timestamp
+
+        # clean outdated positives (ref: write_signal_pipe.hpp:88-94)
+        while (real_time and self.recent_positive_timestamps
+               and ts - self.recent_positive_timestamps[0] > 5 * w):
+            self.recent_positive_timestamps.popleft()
+
+        to_write = None
+        if has_signal:
+            self.recent_positive_timestamps.append(ts)
+            to_write = work
+        elif real_time and self._overlaps_recent_positive(ts):
+            # other-polarization piggyback (ref: write_signal_pipe.hpp:102-115)
+            to_write = work
+        elif real_time:
+            self.recent_negative_works.append(work)
+
+        # re-check old negatives against new positives (ref: 122-140)
+        if real_time and to_write is None and self.recent_negative_works:
+            work_2 = self.recent_negative_works.popleft()
+            if self._overlaps_recent_positive(work_2.segment.timestamp):
+                to_write = work_2
+
+        if to_write is not None:
+            self._write(to_write)
+
+        # bound the negative queue (the reference relies on deque churn; we
+        # cap explicitly to one overlap window's worth of segments)
+        while len(self.recent_negative_works) > 16:
+            self.recent_negative_works.popleft()
+
+    # ------------------------------------------------------------------
+
+    def _write(self, work: SegmentResultWork) -> None:
+        counter = work.segment.udp_packet_counter
+        if counter == NO_UDP_PACKET_COUNTER:
+            counter = work.segment.timestamp
+        base = self.cfg.baseband_output_file_prefix + str(counter)
+        log.info(f"[write_signal] begin writing, file_counter = {counter}")
+
+        bin_path = base + ".bin"
+        with open(bin_path, "wb") as f:
+            f.write(np.ascontiguousarray(work.segment.data).tobytes())
+            f.flush()
+            if self.fdatasync:
+                os.fdatasync(f.fileno())
+
+        npy_paths = []
+        if work.waterfall is not None:
+            wf = np.asarray(work.waterfall)
+            if wf.ndim == 2:
+                wf = wf[None]
+            for i in range(wf.shape[0]):
+                # pick first non-existing index (ref: 230-235)
+                j = i
+                while os.path.exists(f"{base}.{j}.npy"):
+                    j += 1
+                path = f"{base}.{j}.npy"
+                np.save(path, wf[i].astype(np.complex64))
+                npy_paths.append(path)
+
+        tim_paths = []
+        if work.detect is not None:
+            counts = np.asarray(work.detect.signal_counts)
+            series = np.asarray(work.detect.boxcar_series)
+            if counts.ndim == 1:
+                counts = counts[None]
+                series = series[None]
+            lengths = work.detect.boxcar_lengths
+            for s in range(counts.shape[0]):
+                for bi, b in enumerate(lengths):
+                    if counts[s, bi] > 0:
+                        path = f"{base}.{b}.tim"
+                        valid = series.shape[-1] - (b if b > 1 else 0)
+                        series[s, bi, :valid].astype("<f4").tofile(path)
+                        tim_paths.append(path)
+
+        self.written.append(CandidateFiles(bin_path, npy_paths, tim_paths))
+        log.info(f"[write_signal] finished writing, file_counter = {counter}")
+
+
+class WriteAllSink:
+    """Unconditional append of baseband minus the reserved tail to one file
+    per stream (ref: pipeline/write_file_pipe.hpp:41-94, selected when
+    ``baseband_write_all``)."""
+
+    def __init__(self, cfg: Config, reserved_bytes: int,
+                 data_stream_id: int = 0):
+        self.reserved_bytes = reserved_bytes
+        path = (cfg.baseband_output_file_prefix
+                + f"stream{data_stream_id}.bin")
+        self.path = path
+        self._f = open(path, "ab")
+
+    def push(self, work: SegmentResultWork, has_signal: bool = False) -> None:
+        data = work.segment.data
+        end = len(data) - self.reserved_bytes
+        if end <= 0:
+            end = len(data)
+        self._f.write(np.ascontiguousarray(data[:end]).tobytes())
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+# ----------------------------------------------------------------
+# sigproc filterbank header (ref: io/sigproc_filterbank.hpp)
+# ----------------------------------------------------------------
+
+def _fb_string(key: str) -> bytes:
+    b = key.encode()
+    return np.int32(len(b)).tobytes() + b
+
+
+def _fb_int(key: str, value: int) -> bytes:
+    return _fb_string(key) + np.int32(value).tobytes()
+
+
+def _fb_double(key: str, value: float) -> bytes:
+    return _fb_string(key) + np.float64(value).tobytes()
+
+
+def encode_angle_dms(d: int, m: int, s: float) -> float:
+    """Pack degrees/minutes/seconds as ddmmss.s, the sigproc convention
+    (ref: io/sigproc_filterbank.hpp:59-70)."""
+    sign = -1.0 if d < 0 else 1.0
+    return sign * (abs(d) * 10000.0 + m * 100.0 + s)
+
+
+def write_filterbank_header(f, *, telescope_id: int = 0, machine_id: int = 0,
+                            data_type: int = 1, fch1: float = 0.0,
+                            foff: float = 0.0, nchans: int = 0,
+                            tsamp: float = 0.0, nbits: int = 32,
+                            nifs: int = 1, tstart: float = 0.0,
+                            src_raj: float = 0.0, src_dej: float = 0.0,
+                            source_name: str = "unknown") -> None:
+    """Serialize a sigproc filterbank header (keys as in the reference's
+    io/sigproc_filterbank.hpp writer)."""
+    f.write(_fb_string("HEADER_START"))
+    f.write(_fb_string("source_name"))
+    f.write(_fb_string(source_name))
+    f.write(_fb_int("telescope_id", telescope_id))
+    f.write(_fb_int("machine_id", machine_id))
+    f.write(_fb_int("data_type", data_type))
+    f.write(_fb_double("fch1", fch1))
+    f.write(_fb_double("foff", foff))
+    f.write(_fb_int("nchans", nchans))
+    f.write(_fb_int("nbits", nbits))
+    f.write(_fb_double("tstart", tstart))
+    f.write(_fb_double("tsamp", tsamp))
+    f.write(_fb_int("nifs", nifs))
+    f.write(_fb_double("src_raj", src_raj))
+    f.write(_fb_double("src_dej", src_dej))
+    f.write(_fb_string("HEADER_END"))

@@ -39,7 +39,7 @@ def mitigate_rfi_average_and_normalize(
     (ref: rfi_mitigation_pipe.hpp:61-65).
     """
     power = _norm(spectrum)
-    mean_power = jnp.mean(power)
+    mean_power = jnp.mean(power, axis=-1, keepdims=True)
     zap = power > threshold * mean_power
     return jnp.where(zap, jnp.zeros((), dtype=spectrum.dtype),
                      spectrum * normalization_coefficient)

@@ -1,0 +1,2 @@
+from srtb_tpu.pipeline.work import SegmentWork, SegmentResultWork  # noqa: F401
+from srtb_tpu.pipeline.segment import SegmentProcessor  # noqa: F401

@@ -1,0 +1,125 @@
+"""Streaming runtime: reader -> device segment processor -> sinks.
+
+The reference's thread-per-pipe/bounded-queue machinery
+(ref: pipeline/framework/pipe.hpp, pipe_io.hpp) exists to overlap GPU
+kernels of consecutive segments.  Under JAX, async dispatch already
+overlaps: ``process(segment_k+1)`` is enqueued while ``segment_k``'s
+results are still materializing, and host->HBM transfer of the next
+segment overlaps device compute (double buffering).  What remains of the
+framework is this small host loop with work accounting
+(ref: main.cpp:146-162 work_in_pipeline_count) and orderly shutdown
+(ref: framework/exit_handler.hpp).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+
+from srtb_tpu.config import Config
+from srtb_tpu.io.file_input import BasebandFileReader
+from srtb_tpu.io.writers import WriteAllSink, WriteSignalSink
+from srtb_tpu.pipeline.segment import SegmentProcessor
+from srtb_tpu.pipeline.work import SegmentResultWork, SegmentWork
+from srtb_tpu.utils.logging import log
+
+
+@dataclass
+class PipelineStats:
+    segments: int = 0
+    samples: int = 0
+    signals: int = 0
+    elapsed_s: float = 0.0
+    extras: dict = field(default_factory=dict)
+
+    @property
+    def msamples_per_sec(self) -> float:
+        return self.samples / self.elapsed_s / 1e6 if self.elapsed_s else 0.0
+
+
+def has_signal(cfg: Config, detect_result, stream: int | None = None) -> bool:
+    """The reference's gating: skip when too many channels are zapped
+    (ref: signal_detect_pipe.hpp:343-345), else positive when any boxcar
+    fired."""
+    zero_count = np.asarray(detect_result.zero_count)
+    counts = np.asarray(detect_result.signal_counts)
+    if zero_count.ndim == 0:
+        zero_count = zero_count[None]
+        counts = counts[None]
+    freq_bins = cfg.spectrum_channel_count
+    ok = zero_count < cfg.signal_detect_channel_threshold * freq_bins
+    fired = counts.sum(axis=-1) > 0
+    per_stream = ok & fired
+    if stream is not None:
+        return bool(per_stream[stream])
+    return bool(per_stream.any())
+
+
+class Pipeline:
+    """File (or any SegmentWork iterator) to sinks."""
+
+    def __init__(self, cfg: Config, source=None, sinks=None,
+                 keep_waterfall: bool = True):
+        self.cfg = cfg
+        self.processor = SegmentProcessor(cfg)
+        if source is None:
+            if not cfg.input_file_path:
+                raise ValueError("no input_file_path and no source given")
+            source = BasebandFileReader(cfg)
+        self.source = source
+        if sinks is None:
+            if cfg.baseband_write_all:
+                from srtb_tpu.ops import dedisperse as dd
+                reserved_bytes = int(
+                    dd.nsamps_reserved(cfg) * cfg.bytes_per_sample
+                    * self.processor.data_stream_count)
+                sinks = [WriteAllSink(cfg, reserved_bytes)]
+            else:
+                sinks = [WriteSignalSink(cfg)]
+        self.sinks = sinks
+        self.keep_waterfall = keep_waterfall
+        self.stats = PipelineStats()
+
+    def run(self, max_segments: int | None = None) -> PipelineStats:
+        cfg = self.cfg
+        start = time.perf_counter()
+        pending: list[tuple[SegmentWork, object, object]] = []
+        n_samples_per_seg = cfg.baseband_input_count
+
+        def drain(item):
+            seg, wf, det_res = item
+            # block until device results are ready
+            det_res = jax.tree_util.tree_map(np.asarray, det_res)
+            result = SegmentResultWork(
+                segment=seg,
+                waterfall=wf if self.keep_waterfall else None,
+                detect=det_res)
+            positive = has_signal(cfg, det_res)
+            if positive:
+                self.stats.signals += 1
+                log.info("[pipeline] signal detected in segment "
+                         f"{self.stats.segments}")
+            for sink in self.sinks:
+                sink.push(result, positive)
+
+        for i, seg in enumerate(self.source):
+            if max_segments is not None and i >= max_segments:
+                break
+            wf, det_res = self.processor.process(seg.data)
+            pending.append((seg, wf, det_res))
+            # keep at most 2 segments in flight (the reference's queue
+            # capacity, config.hpp:40-43): drain the oldest
+            if len(pending) >= 2:
+                drain(pending.pop(0))
+            self.stats.segments += 1
+            self.stats.samples += n_samples_per_seg
+
+        for item in pending:
+            drain(item)
+        self.stats.elapsed_s = time.perf_counter() - start
+        log.info(f"[pipeline] {self.stats.segments} segments, "
+                 f"{self.stats.msamples_per_sec:.1f} Msamples/s")
+        return self.stats

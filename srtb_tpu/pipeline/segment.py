@@ -1,0 +1,136 @@
+"""The fused segment processor.
+
+The reference runs one OS thread per pipeline stage with bounded queues so
+GPU kernels of consecutive segments overlap (ref: pipeline/framework/
+pipe.hpp, src/main.cpp:125-272).  On TPU the idiomatic equivalent is a
+**single jitted function for the whole device chain** — XLA fuses the
+elementwise stages into the FFTs' epilogues and overlaps host transfers
+with compute via async dispatch; the host-side stage structure survives
+only around the device (reader -> processor -> writers).
+
+Device chain (ref call stack: SURVEY.md §3.2):
+
+  unpack (+window) -> R2C FFT (drop Nyquist) -> RFI s1 (avg-zap +
+  normalize + manual zap) -> chirp multiply -> waterfall backward C2C ->
+  RFI s2 (spectral kurtosis) -> signal detect (boxcar cascade)
+
+Everything is batched over data streams (polarizations): shape [S, ...].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from srtb_tpu.config import Config
+from srtb_tpu.io import formats
+from srtb_tpu.ops import dedisperse as dd
+from srtb_tpu.ops import detect as det
+from srtb_tpu.ops import fft as F
+from srtb_tpu.ops import rfi
+from srtb_tpu.ops import unpack as U
+from srtb_tpu.ops import window as W
+from srtb_tpu.utils.logging import log
+
+
+def unpack_streams(raw: jnp.ndarray, variant: str, nbits: int,
+                   window: jnp.ndarray | None) -> jnp.ndarray:
+    """Dispatch to the right unpack kernel and stack the resulting data
+    streams into [S, n] (ref dispatch: unpack_pipe.hpp:46-136, 392-413)."""
+    if variant == "simple":
+        return U.unpack(raw, nbits, window)[None, :]
+    if variant == "interleaved_samples_2":
+        return jnp.stack(U.unpack_interleaved_2pol(raw, nbits, window))
+    if variant == "naocpsr_snap1":
+        return jnp.stack(U.unpack_naocpsr_snap1(raw, nbits, window))
+    if variant == "gznupsr_a1":
+        return jnp.stack(U.unpack_gznupsr_a1(raw, window))
+    if variant == "gznupsr_a1_v2_1":
+        return jnp.stack(U.unpack_gznupsr_a1_v2_1(raw, window))
+    raise ValueError(f"unknown unpack variant {variant!r}")
+
+
+class SegmentProcessor:
+    """Builds and owns the jitted per-segment device function plus its
+    precomputed constants (chirp, window, RFI mask, normalization)."""
+
+    def __init__(self, cfg: Config, window_name: str = W.DEFAULT_WINDOW,
+                 compute_chirp_on_device: bool | None = None):
+        self.cfg = cfg
+        self.fmt = formats.resolve(cfg.baseband_format_type)
+        n = cfg.baseband_input_count
+        if n & (n - 1):
+            raise ValueError("baseband_input_count must be a power of 2")
+        self.n = n
+        self.n_spectrum = n // 2  # after R2C + drop-Nyquist
+        self.channel_count = min(cfg.spectrum_channel_count, self.n_spectrum)
+        self.watfft_len = self.n_spectrum // self.channel_count
+
+        # ---- precomputed constants ----
+        win = W.window_coefficients(window_name, n)
+        self.window = None if win is None else jnp.asarray(win)
+
+        f_min, f_c, df = dd.spectrum_frequencies(cfg, self.n_spectrum)
+        self.f_min, self.f_c, self.df = f_min, f_c, df
+        if compute_chirp_on_device is None:
+            compute_chirp_on_device = cfg.use_emulated_fp64
+        if compute_chirp_on_device:
+            self.chirp = jax.jit(
+                lambda: dd.chirp_factor_df64(self.n_spectrum, f_min, df, f_c,
+                                             cfg.dm))()
+        else:
+            self.chirp = jnp.asarray(
+                dd.chirp_factor_host(self.n_spectrum, f_min, df, f_c, cfg.dm))
+
+        mask = rfi.rfi_ranges_to_mask(
+            rfi.eval_rfi_ranges(cfg.mitigate_rfi_freq_list), self.n_spectrum,
+            cfg.baseband_freq_low, cfg.baseband_bandwidth)
+        self.rfi_mask = None if mask is None else jnp.asarray(mask)
+
+        self.norm_coeff = rfi.normalization_coefficient(
+            self.n_spectrum, self.channel_count)
+
+        self.nsamps_reserved = dd.nsamps_reserved(cfg)
+        # trim of the waterfall time axis (ref: signal_detect_pipe.hpp:289-299)
+        self.time_reserved_count = self.nsamps_reserved // self.channel_count
+
+        self._jit_process = jax.jit(self._process)
+        log.debug(f"[segment] n={n} spectrum={self.n_spectrum} "
+                  f"channels={self.channel_count} watfft={self.watfft_len} "
+                  f"reserved={self.nsamps_reserved}")
+
+    # ------------------------------------------------------------------
+
+    def _process(self, raw: jnp.ndarray, chirp: jnp.ndarray):
+        cfg = self.cfg
+        x = unpack_streams(raw, self.fmt.unpack_variant,
+                           cfg.baseband_input_bits, self.window)
+        spec = F.segment_rfft(x)                      # [S, n/2]
+        spec = rfi.mitigate_rfi_average_and_normalize(
+            spec, cfg.mitigate_rfi_average_method_threshold, self.norm_coeff)
+        spec = rfi.mitigate_rfi_manual(spec, self.rfi_mask)
+        spec = dd.dedisperse(spec, chirp)
+        wf = F.waterfall_c2c(spec, self.channel_count)  # [S, F, T]
+        wf = rfi.mitigate_rfi_spectral_kurtosis(
+            wf, cfg.mitigate_rfi_spectral_kurtosis_threshold)
+        result = det.detect(wf, self.time_reserved_count,
+                            cfg.signal_detect_signal_noise_threshold,
+                            cfg.signal_detect_max_boxcar_length)
+        return wf, result
+
+    # ------------------------------------------------------------------
+
+    def process(self, raw) -> tuple[jnp.ndarray, det.DetectResult]:
+        """Run one segment. ``raw`` is the uint8 byte array of the segment
+        (all streams interleaved, as read from file or UDP)."""
+        raw = jnp.asarray(raw, dtype=jnp.uint8)
+        expected = self.cfg.segment_bytes(self.fmt.data_stream_count)
+        if raw.shape != (expected,):
+            raise ValueError(
+                f"segment must be {expected} bytes, got {raw.shape}")
+        return self._jit_process(raw, self.chirp)
+
+    @property
+    def data_stream_count(self) -> int:
+        return self.fmt.data_stream_count
