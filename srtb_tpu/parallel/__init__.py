@@ -1,0 +1,11 @@
+"""Distributed layer (no reference equivalent — the reference is
+single-device, single-process; SURVEY.md §2.9/§5.8).
+
+- ``mesh``         — device mesh construction over ICI/DCN
+- ``dist_fft``     — sequence-sharded large FFT (four-step + all_to_all)
+- ``dm_grid``      — DM-trial data parallelism: chirp bank sharded over
+                     chips, spectrum broadcast once over ICI
+- ``segment_dist`` — the full segment step sharded over a ("dm", "seq") mesh
+"""
+
+from srtb_tpu.parallel import mesh, dist_fft  # noqa: F401

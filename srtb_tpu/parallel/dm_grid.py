@@ -1,0 +1,107 @@
+"""Multi-chip DM-trial search.
+
+The reference dedisperses at a single configured DM (config.hpp:129-132
+"TODO: DM search list for unknown source").  On TPU a DM search is the
+natural scale-out axis: every trial applies a different chirp to the *same*
+spectrum — pure data parallelism.  The spectrum is broadcast over ICI once
+per segment; the chirp bank lives sharded over the ``dm`` mesh axis
+(precomputed once, reused for every segment); each chip runs
+chirp-multiply -> waterfall FFT -> spectral kurtosis -> detection on its
+local trials and only tiny per-trial summaries leave the chips.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from srtb_tpu.ops import dedisperse as dd
+from srtb_tpu.ops import detect as det
+from srtb_tpu.ops import fft as F
+from srtb_tpu.ops import rfi
+
+
+class DMTrialResult(NamedTuple):
+    dm_list: np.ndarray          # [n_dm] host
+    zero_count: jnp.ndarray      # [n_dm]
+    signal_counts: jnp.ndarray   # [n_dm, n_boxcars]
+    snr_peaks: jnp.ndarray       # [n_dm, n_boxcars]
+    time_series: jnp.ndarray     # [n_dm, T] mean-subtracted boxcar-1 series
+
+
+def build_chirp_bank(dm_list, n_spectrum: int, f_min: float, df: float,
+                     f_c: float, mesh: Mesh | None = None,
+                     on_device: bool = False) -> jnp.ndarray:
+    """[n_dm, n_spectrum] chirp bank, optionally sharded over the mesh's
+    ``dm`` axis.  ``on_device=True`` computes each chirp with df64
+    two-float arithmetic directly on the owning chip (no host->device
+    transfer of the bank, SURVEY.md §7 step 6)."""
+    dm_list = np.asarray(dm_list, dtype=np.float64)
+    if on_device and mesh is not None:
+        def gen(dms_block):
+            return jax.vmap(lambda dm: dd.chirp_factor_df64(
+                n_spectrum, f_min, df, f_c, dm))(dms_block)
+        fn = shard_map(gen, mesh=mesh, in_specs=P("dm"), out_specs=P("dm"))
+        return fn(jnp.asarray(dm_list, dtype=jnp.float32))
+    bank = np.stack([dd.chirp_factor_host(n_spectrum, f_min, df, f_c, dm)
+                     for dm in dm_list])
+    if mesh is not None:
+        sharding = NamedSharding(mesh, P("dm", None))
+        return jax.device_put(bank, sharding)
+    return jnp.asarray(bank)
+
+
+def _trial_body(spec, chirp_block, *, channel_count, time_reserved_count,
+                snr_threshold, max_boxcar_length, sk_threshold):
+    """Per-device: run all local DM trials on the replicated spectrum."""
+
+    def one(chirp):
+        s = dd.dedisperse(spec, chirp)
+        wf = F.waterfall_c2c(s, channel_count)
+        wf = rfi.mitigate_rfi_spectral_kurtosis(wf, sk_threshold)
+        r = det.detect(wf, time_reserved_count, snr_threshold,
+                       max_boxcar_length)
+        return r.zero_count, r.signal_counts, r.snr_peaks, r.time_series
+
+    return jax.vmap(one)(chirp_block)
+
+
+def dm_trial_search(spectrum: jnp.ndarray, chirp_bank: jnp.ndarray,
+                    dm_list, mesh: Mesh, *, channel_count: int,
+                    time_reserved_count: int, snr_threshold: float,
+                    max_boxcar_length: int,
+                    sk_threshold: float) -> DMTrialResult:
+    """Run the DM grid on one segment's (RFI-cleaned) spectrum.
+
+    ``spectrum`` [n_spectrum] is replicated (XLA broadcasts it over ICI);
+    ``chirp_bank`` [n_dm, n_spectrum] is sharded over the ``dm`` axis.
+    """
+    body = partial(_trial_body, channel_count=channel_count,
+                   time_reserved_count=time_reserved_count,
+                   snr_threshold=snr_threshold,
+                   max_boxcar_length=max_boxcar_length,
+                   sk_threshold=sk_threshold)
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(P(), P("dm", None)),
+                   out_specs=P("dm"))
+    zero_count, counts, peaks, ts = jax.jit(fn)(spectrum, chirp_bank)
+    return DMTrialResult(
+        dm_list=np.asarray(dm_list),
+        zero_count=zero_count,
+        signal_counts=counts,
+        snr_peaks=peaks,
+        time_series=ts,
+    )
+
+
+def best_trial(result: DMTrialResult) -> tuple[int, float]:
+    """(index, peak SNR) of the strongest trial across all boxcars."""
+    peaks = np.asarray(result.snr_peaks)
+    idx = int(np.argmax(peaks.max(axis=-1)))
+    return idx, float(peaks[idx].max())

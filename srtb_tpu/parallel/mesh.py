@@ -1,0 +1,50 @@
+"""Device-mesh construction.
+
+The TPU scaling axes for this workload:
+- ``dm``  — embarrassingly-parallel DM trials (data parallelism over chips;
+  the spectrum is broadcast over ICI once per segment, each chip applies
+  its own chirp);
+- ``seq`` — sequence (frequency/sample) sharding of one huge segment whose
+  FFT exceeds a single chip (sequence/context parallelism analog).
+
+Multi-host meshes come from ``jax.devices()`` spanning hosts; the same code
+runs under ``jax.distributed.initialize`` with DCN-connected slices.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: F401
+
+
+def make_mesh(n_dm: int = 1, n_seq: int = 1,
+              devices=None) -> Mesh:
+    """Build a ("dm", "seq") mesh.  n_dm * n_seq must divide the available
+    device count; by default all devices go to the dm axis."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if n_dm * n_seq == 1:
+        n_dm = n
+    if n % (n_dm * n_seq):
+        raise ValueError(
+            f"{n} devices not divisible into dm={n_dm} x seq={n_seq}")
+    use = np.asarray(devices[: n_dm * n_seq]).reshape(n_dm, n_seq)
+    return Mesh(use, ("dm", "seq"))
+
+
+def seq_mesh(n_seq: int | None = None, devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    if n_seq is None:
+        n_seq = len(devices)
+    return Mesh(np.asarray(devices[:n_seq]), ("seq",))
+
+
+def dm_mesh(n_dm: int | None = None, devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    if n_dm is None:
+        n_dm = len(devices)
+    return Mesh(np.asarray(devices[:n_dm]), ("dm",))

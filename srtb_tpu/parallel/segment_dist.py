@@ -1,0 +1,181 @@
+"""The full segment step sharded over a ("dm", "seq") mesh.
+
+This is the multi-chip version of pipeline.segment.SegmentProcessor: one
+``shard_map`` program covering unpack -> distributed R2C FFT -> RFI s1 ->
+DM-trial chirp -> waterfall FFT -> RFI s2 -> detection, with
+
+- ``seq``: the segment's samples/channels sharded over chips (sequence /
+  context parallelism; all_to_all transposes inside the distributed FFT,
+  psum reductions for the global statistics), and
+- ``dm``:  independent DM trials replicating the sequence work (data
+  parallelism; the cleaned spectrum is computed once per seq-shard and
+  reused by every local trial).
+
+Collective inventory per segment: 3 all_to_all (FFT transposes) + 2
+ppermute (Hermitian mirror) + 4 psum (means/counts) — all riding ICI.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from srtb_tpu.config import Config
+from srtb_tpu.io import formats
+from srtb_tpu.ops import dedisperse as dd
+from srtb_tpu.ops import detect as det
+from srtb_tpu.ops import rfi
+from srtb_tpu.ops import unpack as U
+from srtb_tpu.parallel import dist_fft as DF
+from srtb_tpu.parallel import dm_grid
+
+
+class DistSegmentResult(NamedTuple):
+    zero_count: jnp.ndarray      # [n_dm]
+    signal_counts: jnp.ndarray   # [n_dm, n_boxcars]
+    snr_peaks: jnp.ndarray       # [n_dm, n_boxcars]
+    time_series: jnp.ndarray     # [n_dm, T]
+
+
+class DistSegmentProcessor:
+    """Builds the jitted multi-chip step for one baseband segment and a DM
+    trial list."""
+
+    def __init__(self, cfg: Config, mesh: Mesh, dm_list=None):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.fmt = formats.resolve(cfg.baseband_format_type)
+        if self.fmt.data_stream_count != 1:
+            raise NotImplementedError(
+                "distributed step currently processes one stream; "
+                "run streams on separate meshes or interleave segments")
+        self.n_seq = mesh.shape["seq"]
+        self.n_dm_devices = mesh.shape["dm"]
+        if dm_list is None:
+            dm_list = cfg.dm_list or [cfg.dm]
+        if len(dm_list) % self.n_dm_devices:
+            raise ValueError("len(dm_list) must divide by dm-axis size")
+        self.dm_list = np.asarray(dm_list, dtype=np.float64)
+
+        n = cfg.baseband_input_count
+        self.n = n
+        self.n_spectrum = n // 2
+        self.channel_count = min(cfg.spectrum_channel_count, self.n_spectrum)
+        self.watfft_len = self.n_spectrum // self.channel_count
+        if self.channel_count % self.n_seq:
+            raise ValueError("spectrum_channel_count must divide by seq axis")
+
+        f_min, f_c, df = dd.spectrum_frequencies(cfg, self.n_spectrum)
+        self.chirp_bank = dm_grid.build_chirp_bank(
+            self.dm_list, self.n_spectrum, f_min, df, f_c)
+        # shard [n_dm, n_spec] over (dm, seq)
+        self.chirp_bank = jax.device_put(
+            self.chirp_bank, NamedSharding(mesh, P("dm", "seq")))
+
+        mask = rfi.rfi_ranges_to_mask(
+            rfi.eval_rfi_ranges(cfg.mitigate_rfi_freq_list), self.n_spectrum,
+            cfg.baseband_freq_low, cfg.baseband_bandwidth)
+        if mask is None:
+            mask = np.zeros(self.n_spectrum, dtype=bool)
+        self.rfi_mask = jax.device_put(
+            mask, NamedSharding(mesh, P("seq")))
+
+        self.norm_coeff = rfi.normalization_coefficient(
+            self.n_spectrum, self.channel_count)
+        self.nsamps_reserved = dd.nsamps_reserved(cfg)
+        self.time_reserved_count = self.nsamps_reserved // self.channel_count
+
+        body = partial(
+            self._body,
+            nbits=cfg.baseband_input_bits,
+            n=self.n, n_seq=self.n_seq,
+            n_spectrum=self.n_spectrum,
+            channel_count=self.channel_count,
+            norm_coeff=self.norm_coeff,
+            avg_threshold=cfg.mitigate_rfi_average_method_threshold,
+            sk_threshold=cfg.mitigate_rfi_spectral_kurtosis_threshold,
+            time_reserved_count=self.time_reserved_count,
+            snr_threshold=cfg.signal_detect_signal_noise_threshold,
+            max_boxcar_length=cfg.signal_detect_max_boxcar_length,
+        )
+        self._step = jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=(P("seq"), P("dm", "seq"), P("seq")),
+            out_specs=(P("dm"), P("dm"), P("dm"), P("dm"))))
+
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _body(raw_block, chirp_block, mask_block, *, nbits, n, n_seq,
+              n_spectrum, channel_count, norm_coeff, avg_threshold,
+              sk_threshold, time_reserved_count, snr_threshold,
+              max_boxcar_length):
+        # ---- unpack (local; sub-byte fields never straddle shards) ----
+        x = U.unpack(raw_block, nbits)                  # [n/n_seq]
+
+        # ---- distributed R2C FFT, drop Nyquist ----
+        m = n // 2
+        z = x.reshape(-1, 2)
+        z = jax.lax.complex(z[:, 0], z[:, 1])
+        log2m = m.bit_length() - 1
+        n1 = 1 << (log2m // 2)
+        n2 = m // n1
+        zf = DF._dist_fft_block(z, axis_name="seq", n1=n1, n2=n2,
+                                n_dev=n_seq, inverse=False)
+        spec = DF._dist_rfft_post_block(zf, axis_name="seq", m=m,
+                                        n_dev=n_seq)   # [m/n_seq]
+
+        # ---- RFI stage 1: global mean power via psum, zap + normalize ----
+        power = jnp.real(spec) ** 2 + jnp.imag(spec) ** 2
+        mean_power = jax.lax.psum(jnp.sum(power), "seq") / n_spectrum
+        zap = power > avg_threshold * mean_power
+        spec = jnp.where(zap, 0.0 + 0.0j, spec * norm_coeff)
+        spec = jnp.where(mask_block, 0.0 + 0.0j, spec)
+
+        # ---- per-DM-trial: chirp, waterfall, SK, detect ----
+        wlen = n_spectrum // channel_count
+        ch_local = channel_count // n_seq
+        t = wlen - time_reserved_count \
+            if wlen > time_reserved_count else wlen
+
+        def one_trial(chirp):
+            s = spec * chirp
+            # local channels are complete contiguous sub-bands
+            wf = s.reshape(ch_local, wlen)
+            wf = jnp.fft.ifft(wf, axis=-1, norm="forward")
+            wf = rfi.mitigate_rfi_spectral_kurtosis(wf, sk_threshold)
+            # global zapped-channel count
+            zero_count = jax.lax.psum(
+                jnp.sum((jnp.abs(wf[:, 0]) == 0).astype(jnp.int32)), "seq")
+            # global time series: sum power over all channels
+            ts = jax.lax.psum(
+                jnp.sum(jnp.real(wf[:, :t]) ** 2 + jnp.imag(wf[:, :t]) ** 2,
+                        axis=0), "seq")
+            ts = ts - jnp.mean(ts)
+            # boxcar cascade on the (replicated) time series
+            lengths = det.boxcar_lengths(max_boxcar_length, t)
+            acc = jnp.cumsum(ts)
+            counts, peaks = [], []
+            for b in lengths:
+                series = ts if b == 1 else acc[b:] - acc[:-b]
+                c, p = det.count_signal(series, snr_threshold)
+                counts.append(c)
+                peaks.append(p)
+            return (zero_count, jnp.stack(counts), jnp.stack(peaks), ts)
+
+        return jax.vmap(one_trial)(chirp_block)
+
+    # ------------------------------------------------------------------
+
+    def process(self, raw) -> DistSegmentResult:
+        raw = jax.device_put(
+            jnp.asarray(raw, dtype=jnp.uint8),
+            NamedSharding(self.mesh, P("seq")))
+        out = self._step(raw, self.chirp_bank, self.rfi_mask)
+        return DistSegmentResult(*out)

@@ -1,0 +1,52 @@
+"""Distributed FFT tests on the virtual 8-device CPU mesh
+(multi-chip logic tested the way the reference tests multi-backend code on
+CPU-only CI, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from srtb_tpu.parallel import dist_fft as DF
+from srtb_tpu.parallel import mesh as M
+
+
+@pytest.fixture(scope="module")
+def seq_mesh8():
+    assert len(jax.devices()) == 8, "conftest must provide 8 CPU devices"
+    return M.seq_mesh(8)
+
+
+@pytest.mark.parametrize("log2n", [10, 14, 16])
+@pytest.mark.parametrize("inverse", [False, True])
+def test_dist_fft_matches_numpy(seq_mesh8, log2n, inverse):
+    n = 1 << log2n
+    rng = np.random.default_rng(log2n)
+    x = (rng.standard_normal(n) + 1j * rng.standard_normal(n)).astype(
+        np.complex64)
+    got = np.asarray(DF.dist_fft(jnp.asarray(x), seq_mesh8,
+                                 inverse=inverse))
+    expected = np.fft.ifft(x) * n if inverse else np.fft.fft(x)
+    np.testing.assert_allclose(got, expected.astype(np.complex64),
+                               rtol=1e-3, atol=3e-2 * np.sqrt(n))
+
+
+@pytest.mark.parametrize("log2n", [12, 16])
+def test_dist_rfft(seq_mesh8, log2n):
+    n = 1 << log2n
+    rng = np.random.default_rng(log2n)
+    x = rng.standard_normal(n).astype(np.float32)
+    got = np.asarray(DF.dist_rfft_drop_nyquist(jnp.asarray(x), seq_mesh8))
+    expected = np.fft.rfft(x)[:-1]
+    assert got.shape == (n // 2,)
+    np.testing.assert_allclose(got, expected.astype(np.complex64),
+                               rtol=1e-3, atol=3e-2 * np.sqrt(n))
+
+
+def test_dist_fft_output_sharding(seq_mesh8):
+    n = 1 << 12
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(n)
+                    .astype(np.float32)).astype(jnp.complex64)
+    out = DF.dist_fft(x, seq_mesh8)
+    # output stays sharded over the seq axis (no implicit gather)
+    assert len(out.sharding.device_set) == 8
