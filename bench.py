@@ -1,0 +1,76 @@
+"""Benchmark: sustained coherent-dedispersion pipeline throughput on one
+chip, in the J1644-4559 configuration (2-bit samples, 128 MSa/s, |DM| =
+478.80, inverted 64 MHz band — ref: srtb_config_1644-4559.cfg).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": Msamples/s, "unit": ..., "vs_baseline": x}
+where vs_baseline is the real-time factor against the 128 MSa/s baseband
+rate (BASELINE.md target: >= 1x real-time on a single v5e chip).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from srtb_tpu.config import Config
+    from srtb_tpu.pipeline.segment import SegmentProcessor
+
+    # J1644-4559 parameters (ref: srtb_config_1644-4559.cfg) at a segment
+    # size that exercises the large-FFT path while fitting one chip
+    n = 1 << 27
+    cfg = Config(
+        baseband_input_count=n,
+        baseband_input_bits=2,
+        baseband_format_type="simple",
+        baseband_freq_low=1405.0 + 32.0,
+        baseband_bandwidth=-64.0,
+        baseband_sample_rate=128e6,
+        dm=-478.80,
+        spectrum_channel_count=1 << 11,
+        mitigate_rfi_average_method_threshold=1.5,
+        mitigate_rfi_spectral_kurtosis_threshold=1.05,
+        signal_detect_signal_noise_threshold=8.0,
+        signal_detect_max_boxcar_length=256,
+        mitigate_rfi_freq_list="1418-1422",
+        baseband_reserve_sample=False,
+    )
+    proc = SegmentProcessor(cfg)
+
+    rng = np.random.default_rng(0)
+    raw = rng.integers(0, 256, size=cfg.segment_bytes(1), dtype=np.uint8)
+    raw_dev = jax.device_put(raw)
+
+    # warmup / compile
+    wf, res = proc._jit_process(raw_dev, proc.chirp)
+    jax.block_until_ready(res.signal_counts)
+
+    # steady state: time several segments back to back
+    reps = 5
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        wf, res = proc._jit_process(raw_dev, proc.chirp)
+        jax.block_until_ready(res.signal_counts)
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+
+    samples_per_sec = n / dt
+    msamples = samples_per_sec / 1e6
+    realtime_factor = samples_per_sec / cfg.baseband_sample_rate
+    print(json.dumps({
+        "metric": "coherent_dedispersion_pipeline_throughput",
+        "value": round(msamples, 2),
+        "unit": "Msamples/s/chip",
+        "vs_baseline": round(realtime_factor, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -116,6 +116,8 @@ class WriteSignalSink:
         npy_paths = []
         if work.waterfall is not None:
             wf = np.asarray(work.waterfall)
+            if wf.ndim == 4:  # stacked (re, im) boundary representation
+                wf = (wf[0] + 1j * wf[1]).astype(np.complex64)
             if wf.ndim == 2:
                 wf = wf[None]
             for i in range(wf.shape[0]):

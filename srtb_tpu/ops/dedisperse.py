@@ -90,15 +90,41 @@ def chirp_factor_df64(n: int, f_min: float, df: float, f_c: float, dm,
     Mirrors phase_factor_v3 with phase_real = dsmath::df64
     (ref: coherent_dedispersion.hpp:31-53,134-150).
     """
+    delta_phi = _chirp_phase_df64(n, f_min, df, f_c, dm)
+    return (jnp.cos(delta_phi) + 1j * jnp.sin(delta_phi)).astype(dtype)
+
+
+def chirp_factor_host_ri(n: int, f_min: float, df: float, f_c: float,
+                         dm: float) -> np.ndarray:
+    """Chirp as stacked (real, imag) float32 [2, n].
+
+    TPU-native boundary representation: some TPU runtimes don't transfer
+    complex buffers across the host<->device boundary, and splitting
+    re/im is the natural layout for the VPU anyway; complex exists only
+    inside jit.
+    """
+    c = chirp_factor_host(n, f_min, df, f_c, dm)
+    return np.stack([c.real, c.imag]).astype(np.float32)
+
+
+def chirp_factor_df64_ri(n: int, f_min: float, df: float, f_c: float,
+                         dm) -> jnp.ndarray:
+    """df64 on-device chirp as stacked (cos, sin) float32 [2, n] — jit-safe
+    output dtype on complex-less runtimes."""
+    phase = _chirp_phase_df64(n, f_min, df, f_c, dm)
+    return jnp.stack([jnp.cos(phase), jnp.sin(phase)])
+
+
+def _chirp_phase_df64(n: int, f_min: float, df: float, f_c: float, dm):
+    """delta_phi [n] in f32 via df64 arithmetic (shared by the complex and
+    split-ri chirp generators)."""
     i = jnp.arange(n, dtype=jnp.float32)
-    # f = f_min + df * i in df64: split each constant on host where possible
     f_min_d = ds.df64(jnp.float32(np.float32(f_min)),
                       jnp.float32(np.float64(f_min) - np.float32(f_min)))
     df_d = ds.df64(jnp.float32(np.float32(df)),
                    jnp.float32(np.float64(df) - np.float32(df)))
     f_c_d = ds.df64(jnp.float32(np.float32(f_c)),
                     jnp.float32(np.float64(f_c) - np.float32(f_c)))
-    # i is exactly representable up to 2^24; above that split into hi/lo parts
     i_hi = jnp.float32(1 << 12) * jnp.trunc(i / (1 << 12))
     i_lo = i - i_hi
     df_i = ds.add(ds.mul(df_d, ds.df64(i_hi)), ds.mul(df_d, ds.df64(i_lo)))
@@ -114,8 +140,7 @@ def chirp_factor_df64(n: int, f_min: float, df: float, f_c: float, dm,
     ratio = ds.div(delta_f, f_c_d)
     k = ds.mul(ds.div(ds.mul(D_d, dm_d), f), ds.mul(ratio, ratio))
     k_frac = ds.frac(k)
-    delta_phi = jnp.float32(-2.0 * np.pi) * k_frac
-    return (jnp.cos(delta_phi) + 1j * jnp.sin(delta_phi)).astype(dtype)
+    return jnp.float32(-2.0 * np.pi) * k_frac
 
 
 def spectrum_frequencies(cfg, n: int):

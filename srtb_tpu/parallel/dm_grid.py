@@ -38,30 +38,33 @@ class DMTrialResult(NamedTuple):
 def build_chirp_bank(dm_list, n_spectrum: int, f_min: float, df: float,
                      f_c: float, mesh: Mesh | None = None,
                      on_device: bool = False) -> jnp.ndarray:
-    """[n_dm, n_spectrum] chirp bank, optionally sharded over the mesh's
-    ``dm`` axis.  ``on_device=True`` computes each chirp with df64
-    two-float arithmetic directly on the owning chip (no host->device
-    transfer of the bank, SURVEY.md §7 step 6)."""
+    """[n_dm, 2, n_spectrum] (re, im) float32 chirp bank, optionally sharded
+    over the mesh's ``dm`` axis.  ``on_device=True`` computes each chirp
+    with df64 two-float arithmetic directly on the owning chip (no
+    host->device transfer of the bank, SURVEY.md §7 step 6)."""
     dm_list = np.asarray(dm_list, dtype=np.float64)
     if on_device and mesh is not None:
         def gen(dms_block):
-            return jax.vmap(lambda dm: dd.chirp_factor_df64(
+            return jax.vmap(lambda dm: dd.chirp_factor_df64_ri(
                 n_spectrum, f_min, df, f_c, dm))(dms_block)
-        fn = shard_map(gen, mesh=mesh, in_specs=P("dm"), out_specs=P("dm"))
+        fn = jax.jit(shard_map(gen, mesh=mesh, in_specs=P("dm"),
+                               out_specs=P("dm")))
         return fn(jnp.asarray(dm_list, dtype=jnp.float32))
-    bank = np.stack([dd.chirp_factor_host(n_spectrum, f_min, df, f_c, dm)
+    bank = np.stack([dd.chirp_factor_host_ri(n_spectrum, f_min, df, f_c, dm)
                      for dm in dm_list])
     if mesh is not None:
-        sharding = NamedSharding(mesh, P("dm", None))
+        sharding = NamedSharding(mesh, P("dm", None, None))
         return jax.device_put(bank, sharding)
     return jnp.asarray(bank)
 
 
-def _trial_body(spec, chirp_block, *, channel_count, time_reserved_count,
+def _trial_body(spec_ri, chirp_block, *, channel_count, time_reserved_count,
                 snr_threshold, max_boxcar_length, sk_threshold):
     """Per-device: run all local DM trials on the replicated spectrum."""
+    spec = jax.lax.complex(spec_ri[0], spec_ri[1])
 
-    def one(chirp):
+    def one(chirp_ri):
+        chirp = jax.lax.complex(chirp_ri[0], chirp_ri[1])
         s = dd.dedisperse(spec, chirp)
         wf = F.waterfall_c2c(s, channel_count)
         wf = rfi.mitigate_rfi_spectral_kurtosis(wf, sk_threshold)
@@ -72,15 +75,16 @@ def _trial_body(spec, chirp_block, *, channel_count, time_reserved_count,
     return jax.vmap(one)(chirp_block)
 
 
-def dm_trial_search(spectrum: jnp.ndarray, chirp_bank: jnp.ndarray,
+def dm_trial_search(spectrum_ri: jnp.ndarray, chirp_bank: jnp.ndarray,
                     dm_list, mesh: Mesh, *, channel_count: int,
                     time_reserved_count: int, snr_threshold: float,
                     max_boxcar_length: int,
                     sk_threshold: float) -> DMTrialResult:
     """Run the DM grid on one segment's (RFI-cleaned) spectrum.
 
-    ``spectrum`` [n_spectrum] is replicated (XLA broadcasts it over ICI);
-    ``chirp_bank`` [n_dm, n_spectrum] is sharded over the ``dm`` axis.
+    ``spectrum_ri`` [2, n_spectrum] (re, im) is replicated (XLA broadcasts
+    it over ICI); ``chirp_bank`` [n_dm, 2, n_spectrum] is sharded over the
+    ``dm`` axis.
     """
     body = partial(_trial_body, channel_count=channel_count,
                    time_reserved_count=time_reserved_count,
@@ -88,9 +92,9 @@ def dm_trial_search(spectrum: jnp.ndarray, chirp_bank: jnp.ndarray,
                    max_boxcar_length=max_boxcar_length,
                    sk_threshold=sk_threshold)
     fn = shard_map(body, mesh=mesh,
-                   in_specs=(P(), P("dm", None)),
+                   in_specs=(P(), P("dm", None, None)),
                    out_specs=P("dm"))
-    zero_count, counts, peaks, ts = jax.jit(fn)(spectrum, chirp_bank)
+    zero_count, counts, peaks, ts = jax.jit(fn)(spectrum_ri, chirp_bank)
     return DMTrialResult(
         dm_list=np.asarray(dm_list),
         zero_count=zero_count,

@@ -72,11 +72,11 @@ class DistSegmentProcessor:
             raise ValueError("spectrum_channel_count must divide by seq axis")
 
         f_min, f_c, df = dd.spectrum_frequencies(cfg, self.n_spectrum)
-        self.chirp_bank = dm_grid.build_chirp_bank(
-            self.dm_list, self.n_spectrum, f_min, df, f_c)
-        # shard [n_dm, n_spec] over (dm, seq)
+        # [n_dm, 2, n_spec] (re, im) sharded over (dm, -, seq)
         self.chirp_bank = jax.device_put(
-            self.chirp_bank, NamedSharding(mesh, P("dm", "seq")))
+            np.asarray(dm_grid.build_chirp_bank(
+                self.dm_list, self.n_spectrum, f_min, df, f_c)),
+            NamedSharding(mesh, P("dm", None, "seq")))
 
         mask = rfi.rfi_ranges_to_mask(
             rfi.eval_rfi_ranges(cfg.mitigate_rfi_freq_list), self.n_spectrum,
@@ -106,7 +106,7 @@ class DistSegmentProcessor:
         )
         self._step = jax.jit(shard_map(
             body, mesh=mesh,
-            in_specs=(P("seq"), P("dm", "seq"), P("seq")),
+            in_specs=(P("seq"), P("dm", None, "seq"), P("seq")),
             out_specs=(P("dm"), P("dm"), P("dm"), P("dm"))))
 
     # ------------------------------------------------------------------
@@ -144,8 +144,8 @@ class DistSegmentProcessor:
         t = wlen - time_reserved_count \
             if wlen > time_reserved_count else wlen
 
-        def one_trial(chirp):
-            s = spec * chirp
+        def one_trial(chirp_ri):
+            s = spec * jax.lax.complex(chirp_ri[0], chirp_ri[1])
             # local channels are complete contiguous sub-bands
             wf = s.reshape(ch_local, wlen)
             wf = jnp.fft.ifft(wf, axis=-1, norm="forward")

@@ -73,15 +73,19 @@ class SegmentProcessor:
 
         f_min, f_c, df = dd.spectrum_frequencies(cfg, self.n_spectrum)
         self.f_min, self.f_c, self.df = f_min, f_c, df
+        # the chirp crosses the host->device boundary as stacked (re, im)
+        # float32 [2, n]: some TPU runtimes can't transfer complex buffers,
+        # and split re/im is the natural VPU layout anyway; complex exists
+        # only inside jit
         if compute_chirp_on_device is None:
             compute_chirp_on_device = cfg.use_emulated_fp64
         if compute_chirp_on_device:
             self.chirp = jax.jit(
-                lambda: dd.chirp_factor_df64(self.n_spectrum, f_min, df, f_c,
-                                             cfg.dm))()
+                lambda: dd.chirp_factor_df64_ri(self.n_spectrum, f_min, df,
+                                                f_c, cfg.dm))()
         else:
-            self.chirp = jnp.asarray(
-                dd.chirp_factor_host(self.n_spectrum, f_min, df, f_c, cfg.dm))
+            self.chirp = jnp.asarray(dd.chirp_factor_host_ri(
+                self.n_spectrum, f_min, df, f_c, cfg.dm))
 
         mask = rfi.rfi_ranges_to_mask(
             rfi.eval_rfi_ranges(cfg.mitigate_rfi_freq_list), self.n_spectrum,
@@ -102,7 +106,7 @@ class SegmentProcessor:
 
     # ------------------------------------------------------------------
 
-    def _process(self, raw: jnp.ndarray, chirp: jnp.ndarray):
+    def _process(self, raw: jnp.ndarray, chirp_ri: jnp.ndarray):
         cfg = self.cfg
         x = unpack_streams(raw, self.fmt.unpack_variant,
                            cfg.baseband_input_bits, self.window)
@@ -110,6 +114,7 @@ class SegmentProcessor:
         spec = rfi.mitigate_rfi_average_and_normalize(
             spec, cfg.mitigate_rfi_average_method_threshold, self.norm_coeff)
         spec = rfi.mitigate_rfi_manual(spec, self.rfi_mask)
+        chirp = jax.lax.complex(chirp_ri[0], chirp_ri[1])
         spec = dd.dedisperse(spec, chirp)
         wf = F.waterfall_c2c(spec, self.channel_count)  # [S, F, T]
         wf = rfi.mitigate_rfi_spectral_kurtosis(
@@ -117,13 +122,20 @@ class SegmentProcessor:
         result = det.detect(wf, self.time_reserved_count,
                             cfg.signal_detect_signal_noise_threshold,
                             cfg.signal_detect_max_boxcar_length)
-        return wf, result
+        # boundary representation: waterfall leaves jit as stacked (re, im)
+        wf_ri = jnp.stack([jnp.real(wf), jnp.imag(wf)])  # [2, S, F, T]
+        return wf_ri, result
 
     # ------------------------------------------------------------------
 
     def process(self, raw) -> tuple[jnp.ndarray, det.DetectResult]:
         """Run one segment. ``raw`` is the uint8 byte array of the segment
-        (all streams interleaved, as read from file or UDP)."""
+        (all streams interleaved, as read from file or UDP).
+
+        Returns ``(waterfall_ri, detect_result)`` where waterfall_ri is
+        [2, S, F, T] float32 (re, im); use :func:`waterfall_to_numpy` to
+        assemble a complex host array.
+        """
         raw = jnp.asarray(raw, dtype=jnp.uint8)
         expected = self.cfg.segment_bytes(self.fmt.data_stream_count)
         if raw.shape != (expected,):
@@ -134,3 +146,9 @@ class SegmentProcessor:
     @property
     def data_stream_count(self) -> int:
         return self.fmt.data_stream_count
+
+
+def waterfall_to_numpy(wf_ri) -> np.ndarray:
+    """[2, S, F, T] float32 (re, im) -> [S, F, T] complex64 on host."""
+    a = np.asarray(wf_ri)
+    return (a[0] + 1j * a[1]).astype(np.complex64)

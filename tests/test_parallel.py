@@ -55,6 +55,7 @@ def test_dm_grid_finds_true_dm(raw_segment):
     spec = F.segment_rfft(x)
     spec = rfi.mitigate_rfi_average_and_normalize(
         spec, cfg.mitigate_rfi_average_method_threshold, proc.norm_coeff)
+    spec = jnp.stack([jnp.real(spec), jnp.imag(spec)])
 
     dm_list = [0.0, 10.0, 20.0, 30.0, 40.0, 50.0, 60.0, 70.0]
     f_min, f_c, df = dd.spectrum_frequencies(cfg, proc.n_spectrum)

@@ -78,10 +78,10 @@ def test_segment_processor_shapes(synthetic_cfg):
     proc = SegmentProcessor(cfg)
     raw = np.fromfile(cfg.input_file_path, dtype=np.uint8,
                       count=cfg.baseband_input_count)
-    wf, res = proc.process(raw)
+    wf_ri, res = proc.process(raw)
     n_spec = cfg.baseband_input_count // 2
-    assert wf.shape == (1, cfg.spectrum_channel_count,
-                        n_spec // cfg.spectrum_channel_count)
+    assert wf_ri.shape == (2, 1, cfg.spectrum_channel_count,
+                           n_spec // cfg.spectrum_channel_count)
     assert np.asarray(res.signal_counts).shape[0] == 1
 
 
