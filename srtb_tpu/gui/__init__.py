@@ -1,0 +1,1 @@
+from srtb_tpu.gui import waterfall  # noqa: F401

@@ -1,0 +1,111 @@
+"""Spectrum-waterfall rendering service.
+
+TPU-native replacement for the Qt GUI chain (ref: pipeline/spectrum_pipe.
+hpp simplify_spectrum_pipe_2 -> gui/spectrum_image_provider.hpp -> QML):
+the device side is identical — resample to pixmap size, normalize by
+2x average, ARGB colormap (ops.spectrum) — but the sink is a PNG/PPM file
+or raw pixmap stream per data stream instead of a Qt window, so it runs
+headless next to the TPU job.  The lossy-tap semantics of the reference's
+``loose_queue_out_functor`` (drop frames when the consumer is slow,
+ref: framework/pipe_io.hpp:79-94) are preserved in WaterfallService.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from srtb_tpu.config import Config
+from srtb_tpu.ops import spectrum as sp
+
+
+class WaterfallRenderer:
+    """Owns the jitted resample+normalize+colormap function for one
+    waterfall geometry."""
+
+    def __init__(self, in_freq: int, in_time: int, out_h: int, out_w: int):
+        self.w_freq = jnp.asarray(sp.freq_area_weights(in_freq, out_h))
+        self.w_time = jnp.asarray(sp.time_interp_weights(in_time, out_w))
+        self._render = jax.jit(self._render_impl)
+
+    def _render_impl(self, wf_ri: jnp.ndarray) -> jnp.ndarray:
+        """wf_ri [2, F, T] (re, im) -> ARGB32 [out_h, out_w] uint32."""
+        power = wf_ri[0] ** 2 + wf_ri[1] ** 2
+        img = sp.resample_spectrum(power, self.w_freq, self.w_time)
+        img = sp.normalize_by_average(img)
+        return sp.generate_pixmap(img)
+
+    def render(self, wf_ri) -> np.ndarray:
+        return np.asarray(self._render(jnp.asarray(wf_ri)))
+
+
+# ----------------------------------------------------------------
+# minimal dependency-free PNG writer (RGBA8)
+# ----------------------------------------------------------------
+
+def _png_chunk(tag: bytes, data: bytes) -> bytes:
+    c = tag + data
+    return struct.pack(">I", len(data)) + c + struct.pack(
+        ">I", zlib.crc32(c) & 0xFFFFFFFF)
+
+
+def write_png(path: str, argb: np.ndarray) -> None:
+    """Write an ARGB32 uint32 [h, w] array as a PNG file."""
+    h, w = argb.shape
+    a = ((argb >> 24) & 0xFF).astype(np.uint8)
+    r = ((argb >> 16) & 0xFF).astype(np.uint8)
+    g = ((argb >> 8) & 0xFF).astype(np.uint8)
+    b = (argb & 0xFF).astype(np.uint8)
+    rgba = np.stack([r, g, b, a], axis=-1)
+    raw = b""
+    rows = np.concatenate(
+        [np.zeros((h, 1), dtype=np.uint8),  # filter byte 0 per row
+         rgba.reshape(h, w * 4)], axis=1)
+    raw = rows.tobytes()
+    with open(path, "wb") as f:
+        f.write(b"\x89PNG\r\n\x1a\n")
+        f.write(_png_chunk(
+            b"IHDR", struct.pack(">IIBBBBB", w, h, 8, 6, 0, 0, 0)))
+        f.write(_png_chunk(b"IDAT", zlib.compress(raw, 6)))
+        f.write(_png_chunk(b"IEND", b""))
+
+
+class WaterfallService:
+    """Per-stream waterfall file sink with lossy-frame semantics: only the
+    most recent segment is rendered; older frames are dropped if rendering
+    lags (ref: loose_queue_out_functor, framework/pipe_io.hpp:79-94)."""
+
+    def __init__(self, cfg: Config, in_freq: int, in_time: int,
+                 out_dir: str = ".", fmt: str = "png"):
+        self.cfg = cfg
+        self.out_dir = out_dir
+        self.fmt = fmt
+        self.renderer = WaterfallRenderer(
+            in_freq, in_time, cfg.gui_pixmap_height, cfg.gui_pixmap_width)
+        self.frame_counter = {}
+        self._pending = None
+
+    def push(self, wf_ri, data_stream_id: int = 0) -> None:
+        # lossy tap: replace any unrendered frame
+        self._pending = (wf_ri, data_stream_id)
+
+    def render_pending(self) -> str | None:
+        if self._pending is None:
+            return None
+        wf_ri, stream = self._pending
+        self._pending = None
+        wf = np.asarray(wf_ri)
+        if wf.ndim == 4:  # [2, S, F, T] -> this stream
+            wf = wf[:, stream]
+        pix = self.renderer.render(wf)
+        n = self.frame_counter.get(stream, 0)
+        self.frame_counter[stream] = n + 1
+        path = os.path.join(self.out_dir,
+                            f"waterfall_s{stream}_{n:06d}.{self.fmt}")
+        write_png(path, pix)
+        return path

@@ -1,0 +1,226 @@
+"""UDP baseband ingest.
+
+Python-side interface over the native C++ receiver
+(``srtb_tpu/native/udp_receiver.cpp``, built to ``libsrtb_udp.so``), with a
+pure-Python socket fallback implementing the same block-assembly semantics
+(counter placement, reorder tolerance within a block, zero-fill of lost
+packets with loss accounting — ref: io/udp/udp_receiver.hpp:180-272).
+
+``UdpReceiverSource`` is the equivalent of udp_receiver_pipe
+(ref: pipeline/udp_receiver_pipe.hpp): one receiver per (address, port)
+pair, each yielding full segments stamped with timestamp and first packet
+counter.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+import struct
+import time
+
+import numpy as np
+
+from srtb_tpu.config import Config
+from srtb_tpu.io import formats
+from srtb_tpu.pipeline.work import SegmentWork
+from srtb_tpu.utils.logging import log
+
+COUNTER_LE64 = 0
+COUNTER_VDIF67 = 1
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "..", "native",
+                         "libsrtb_udp.so")
+
+
+def _load_native():
+    try:
+        lib = ctypes.CDLL(os.path.abspath(_LIB_PATH))
+    except OSError:
+        return None
+    lib.srtb_udp_rx_create.restype = ctypes.c_void_p
+    lib.srtb_udp_rx_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint16, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_int32, ctypes.c_int64]
+    lib.srtb_udp_rx_receive_block.restype = ctypes.c_int32
+    lib.srtb_udp_rx_receive_block.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.srtb_udp_rx_total_packets.restype = ctypes.c_uint64
+    lib.srtb_udp_rx_total_packets.argtypes = [ctypes.c_void_p]
+    lib.srtb_udp_rx_lost_packets.restype = ctypes.c_uint64
+    lib.srtb_udp_rx_lost_packets.argtypes = [ctypes.c_void_p]
+    lib.srtb_udp_rx_destroy.argtypes = [ctypes.c_void_p]
+    lib.srtb_set_thread_affinity.restype = ctypes.c_int32
+    lib.srtb_set_thread_affinity.argtypes = [ctypes.c_int32]
+    return lib
+
+
+_NATIVE = _load_native()
+
+
+def counter_kind_for(fmt: formats.PacketFormat) -> int:
+    return COUNTER_VDIF67 if fmt.name.startswith("gznupsr") else COUNTER_LE64
+
+
+class NativeBlockReceiver:
+    """Block receiver backed by the C++ recvmmsg implementation."""
+
+    def __init__(self, addr: str, port: int, fmt: formats.PacketFormat,
+                 rcvbuf_bytes: int = 1 << 28):
+        if _NATIVE is None:
+            raise RuntimeError("libsrtb_udp.so not built "
+                               "(run make -C srtb_tpu/native)")
+        self._lib = _NATIVE
+        self._h = self._lib.srtb_udp_rx_create(
+            addr.encode(), port, fmt.packet_payload_size,
+            fmt.packet_header_size, counter_kind_for(fmt), rcvbuf_bytes)
+        if not self._h:
+            raise OSError(f"cannot bind UDP {addr}:{port}")
+        self.fmt = fmt
+
+    def receive_block(self, out: np.ndarray) -> tuple[int, int, int]:
+        """Fill ``out`` (uint8, multiple of payload size) with one block.
+        Returns (first_counter, lost, total)."""
+        first = ctypes.c_uint64()
+        lost = ctypes.c_uint64()
+        total = ctypes.c_uint64()
+        rc = self._lib.srtb_udp_rx_receive_block(
+            self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            out.nbytes, ctypes.byref(first), ctypes.byref(lost),
+            ctypes.byref(total))
+        if rc != 0:
+            raise OSError(f"receive_block failed rc={rc}")
+        return first.value, lost.value, total.value
+
+    @property
+    def total_packets(self) -> int:
+        return self._lib.srtb_udp_rx_total_packets(self._h)
+
+    @property
+    def lost_packets(self) -> int:
+        return self._lib.srtb_udp_rx_lost_packets(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.srtb_udp_rx_destroy(self._h)
+            self._h = None
+
+
+class PythonBlockReceiver:
+    """Same semantics in pure Python (the reference's asio/recvfrom
+    providers play this role: a slower but portable fallback)."""
+
+    def __init__(self, addr: str, port: int, fmt: formats.PacketFormat,
+                 rcvbuf_bytes: int = 1 << 26):
+        self.fmt = fmt
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                                  rcvbuf_bytes)
+        except OSError:
+            pass
+        self._sock.bind((addr, port))
+        self._pending: tuple[int, bytes] | None = None
+        self._next_counter: int | None = None
+        self.total_packets = 0
+        self.lost_packets = 0
+
+    def _parse_counter(self, pkt: bytes) -> int:
+        if counter_kind_for(self.fmt) == COUNTER_VDIF67:
+            w6, w7 = struct.unpack_from("<2I", pkt, 24)
+            return w6 | (w7 << 32)
+        return struct.unpack_from("<Q", pkt)[0]
+
+    def receive_block(self, out: np.ndarray) -> tuple[int, int, int]:
+        fmt = self.fmt
+        payload = fmt.payload_bytes
+        assert out.nbytes % payload == 0
+        packets_per_block = out.nbytes // payload
+        out[:] = 0
+        begin = self._next_counter
+        filled = 0
+        seen = 0
+        while True:
+            if self._pending is not None:
+                c, pkt = self._pending
+                self._pending = None
+            else:
+                pkt, _ = self._sock.recvfrom(fmt.packet_payload_size + 64)
+                if len(pkt) < fmt.packet_payload_size:
+                    continue
+                c = self._parse_counter(pkt)
+            if begin is None:
+                begin = c
+            if c < begin:
+                continue
+            slot = c - begin
+            if slot >= packets_per_block:
+                self._pending = (c, pkt)
+                break
+            start = slot * payload
+            out[start:start + payload] = np.frombuffer(
+                pkt, dtype=np.uint8,
+                count=payload, offset=fmt.packet_header_size)
+            filled += 1
+            seen += 1
+            if filled == packets_per_block:
+                break
+        self._next_counter = begin + packets_per_block
+        lost = packets_per_block - filled
+        self.total_packets += seen
+        self.lost_packets += lost
+        return begin, lost, packets_per_block
+
+    def close(self):
+        self._sock.close()
+
+
+class UdpReceiverSource:
+    """Yields SegmentWork blocks from a UDP stream
+    (ref: pipeline/udp_receiver_pipe.hpp:106-155)."""
+
+    def __init__(self, cfg: Config, receiver_id: int = 0,
+                 use_native: bool | None = None):
+        self.cfg = cfg
+        self.fmt = formats.resolve(cfg.baseband_format_type)
+        if self.fmt.packet_payload_size == 0:
+            raise ValueError(
+                f"format {self.fmt.name} has no packet structure")
+        addr = cfg.udp_receiver_address[
+            min(receiver_id, len(cfg.udp_receiver_address) - 1)]
+        port = cfg.udp_receiver_port[
+            min(receiver_id, len(cfg.udp_receiver_port) - 1)]
+        if use_native is None:
+            use_native = _NATIVE is not None
+        cls = NativeBlockReceiver if use_native else PythonBlockReceiver
+        self.receiver = cls(addr, port, self.fmt)
+        self.data_stream_id = receiver_id
+        self.segment_bytes = cfg.segment_bytes(self.fmt.data_stream_count)
+        payload = self.fmt.payload_bytes
+        if self.segment_bytes % payload:
+            raise ValueError(
+                f"segment bytes {self.segment_bytes} not a multiple of "
+                f"packet payload {payload}")
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> SegmentWork:
+        buf = np.zeros(self.segment_bytes, dtype=np.uint8)
+        first_counter, lost, total = self.receiver.receive_block(buf)
+        if lost:
+            log.warning(f"[udp_receiver] lost {lost}/{total} packets "
+                        f"({lost / total:.2%})")
+        return SegmentWork(
+            data=buf,
+            timestamp=time.time_ns(),
+            udp_packet_counter=first_counter,
+            data_stream_id=self.data_stream_id,
+        )
+
+    def close(self):
+        self.receiver.close()

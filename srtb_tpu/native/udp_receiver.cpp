@@ -1,0 +1,211 @@
+// Native UDP baseband receiver.
+//
+// TPU-native equivalent of the reference's ingest stack
+// (ref: io/udp/recvmmsg_packet_provider.hpp, io/udp/udp_receiver.hpp
+// udp_receive_block_worker): batched recvmmsg() syscalls (128
+// packets/call), counter parsing per packet format, placement of payloads
+// by counter offset into a caller-provided block buffer (tolerating
+// reordering within a block), zero-fill of lost packets with loss-rate
+// accounting, optional CPU pinning of the receive thread.
+//
+// Exposed as a C ABI for Python ctypes (no pybind11 in this image).
+//
+// Build: make -C srtb_tpu/native  (produces libsrtb_udp.so)
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <netinet/in.h>
+#include <sched.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <new>
+#include <vector>
+
+namespace {
+
+constexpr size_t kBatch = 128;  // packets per recvmmsg (ref: recvmmsg_packet_provider.hpp)
+
+// counter parsers (ref: io/backend_registry.hpp:63-73, 129-152)
+enum CounterKind : int32_t {
+  kCounterLe64 = 0,   // first 8 bytes little-endian (fastmb_roach2 / snap1)
+  kCounterVdif67 = 1, // VDIF words 6 & 7 (gznupsr_a1)
+};
+
+inline uint64_t parse_counter(const uint8_t* pkt, int32_t kind) {
+  uint64_t c = 0;
+  if (kind == kCounterVdif67) {
+    uint32_t w6, w7;
+    std::memcpy(&w6, pkt + 6 * 4, 4);
+    std::memcpy(&w7, pkt + 7 * 4, 4);
+    c = (uint64_t)w6 | ((uint64_t)w7 << 32);
+  } else {
+    std::memcpy(&c, pkt, 8);
+  }
+  return c;
+}
+
+struct UdpRx {
+  int fd = -1;
+  size_t packet_size = 0;   // total datagram size incl. header
+  size_t header_size = 0;
+  int32_t counter_kind = kCounterLe64;
+  uint64_t next_counter = 0;
+  bool have_counter = false;
+
+  // batch state: received but not yet consumed packets
+  std::vector<uint8_t> buf;           // kBatch * packet_size
+  std::vector<mmsghdr> msgs;
+  std::vector<iovec> iovs;
+  size_t batch_pos = 0;
+  size_t batch_len = 0;
+
+  // statistics
+  uint64_t total_packets = 0;
+  uint64_t lost_packets = 0;
+
+  size_t payload_size() const { return packet_size - header_size; }
+};
+
+bool refill(UdpRx* rx) {
+  for (size_t i = 0; i < kBatch; i++) {
+    rx->iovs[i].iov_base = rx->buf.data() + i * rx->packet_size;
+    rx->iovs[i].iov_len = rx->packet_size;
+    std::memset(&rx->msgs[i].msg_hdr, 0, sizeof(msghdr));
+    rx->msgs[i].msg_hdr.msg_iov = &rx->iovs[i];
+    rx->msgs[i].msg_hdr.msg_iovlen = 1;
+  }
+  int n = recvmmsg(rx->fd, rx->msgs.data(), kBatch, MSG_WAITFORONE, nullptr);
+  if (n <= 0) return false;
+  rx->batch_pos = 0;
+  rx->batch_len = (size_t)n;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a bound UDP socket with a large receive buffer.
+// Returns nullptr on failure.
+UdpRx* srtb_udp_rx_create(const char* addr, uint16_t port,
+                          uint64_t packet_size, uint64_t header_size,
+                          int32_t counter_kind, int64_t rcvbuf_bytes) {
+  UdpRx* rx = new (std::nothrow) UdpRx;
+  if (!rx) return nullptr;
+  rx->packet_size = packet_size;
+  rx->header_size = header_size;
+  rx->counter_kind = counter_kind;
+  rx->buf.resize(kBatch * packet_size);
+  rx->msgs.resize(kBatch);
+  rx->iovs.resize(kBatch);
+
+  rx->fd = socket(AF_INET, SOCK_DGRAM, 0);
+  if (rx->fd < 0) { delete rx; return nullptr; }
+  int reuse = 1;
+  setsockopt(rx->fd, SOL_SOCKET, SO_REUSEADDR, &reuse, sizeof(reuse));
+  if (rcvbuf_bytes > 0) {
+    // like the reference's max SO_RCVBUF tuning (README.md deployment notes)
+    int v = (int)rcvbuf_bytes;
+    setsockopt(rx->fd, SOL_SOCKET, SO_RCVBUF, &v, sizeof(v));
+  }
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(port);
+  sa.sin_addr.s_addr = addr && addr[0] ? inet_addr(addr) : INADDR_ANY;
+  if (bind(rx->fd, (sockaddr*)&sa, sizeof(sa)) < 0) {
+    close(rx->fd);
+    delete rx;
+    return nullptr;
+  }
+  return rx;
+}
+
+// Pin the calling thread to a CPU (ref: util/thread_affinity.hpp:34-122).
+int32_t srtb_set_thread_affinity(int32_t cpu) {
+  cpu_set_t set;
+  CPU_ZERO(&set);
+  CPU_SET(cpu, &set);
+  return sched_setaffinity(0, sizeof(set), &set);
+}
+
+// Receive exactly one block of `out_bytes` payload bytes, assembled by
+// packet counter.  Payload of packet with counter c goes to offset
+// (c - begin_counter) * payload_size; gaps are left zeroed (caller provides
+// a zeroed buffer or we memset here); packets beyond the block terminate
+// assembly and are kept for the next call (ref: io/udp/udp_receiver.hpp
+// 180-272 block worker).
+// Returns 0 on success; fills first_counter / lost / total statistics.
+int32_t srtb_udp_rx_receive_block(UdpRx* rx, uint8_t* out,
+                                  uint64_t out_bytes,
+                                  uint64_t* first_counter_out,
+                                  uint64_t* lost_out, uint64_t* total_out) {
+  const size_t payload = rx->payload_size();
+  if (out_bytes % payload != 0) return -22;  // EINVAL
+  const uint64_t packets_per_block = out_bytes / payload;
+  std::memset(out, 0, out_bytes);
+
+  uint64_t begin_counter = 0;
+  bool begin_set = false;
+  if (rx->have_counter) {
+    begin_counter = rx->next_counter;
+    begin_set = true;
+  }
+  uint64_t filled = 0;
+  uint64_t seen = 0;
+
+  while (true) {
+    if (rx->batch_pos >= rx->batch_len) {
+      if (!refill(rx)) return -1;
+    }
+    for (; rx->batch_pos < rx->batch_len; rx->batch_pos++) {
+      const size_t i = rx->batch_pos;
+      if (rx->msgs[i].msg_len < rx->packet_size) continue;  // runt
+      const uint8_t* pkt = rx->buf.data() + i * rx->packet_size;
+      const uint64_t c = parse_counter(pkt, rx->counter_kind);
+      if (!begin_set) {
+        begin_counter = c;
+        begin_set = true;
+      }
+      if (c < begin_counter) continue;  // stale packet from previous block
+      const uint64_t slot = c - begin_counter;
+      if (slot >= packets_per_block) {
+        // block complete; keep this packet position for next call
+        rx->next_counter = begin_counter + packets_per_block;
+        rx->have_counter = true;
+        rx->total_packets += seen;
+        rx->lost_packets += packets_per_block - filled;
+        if (first_counter_out) *first_counter_out = begin_counter;
+        if (lost_out) *lost_out = packets_per_block - filled;
+        if (total_out) *total_out = packets_per_block;
+        return 0;
+      }
+      std::memcpy(out + slot * payload, pkt + rx->header_size, payload);
+      filled++;
+      seen++;
+      if (filled == packets_per_block) {
+        rx->batch_pos++;
+        rx->next_counter = begin_counter + packets_per_block;
+        rx->have_counter = true;
+        rx->total_packets += seen;
+        if (first_counter_out) *first_counter_out = begin_counter;
+        if (lost_out) *lost_out = 0;
+        if (total_out) *total_out = packets_per_block;
+        return 0;
+      }
+    }
+  }
+}
+
+uint64_t srtb_udp_rx_total_packets(UdpRx* rx) { return rx->total_packets; }
+uint64_t srtb_udp_rx_lost_packets(UdpRx* rx) { return rx->lost_packets; }
+
+void srtb_udp_rx_destroy(UdpRx* rx) {
+  if (!rx) return;
+  if (rx->fd >= 0) close(rx->fd);
+  delete rx;
+}
+
+}  // extern "C"

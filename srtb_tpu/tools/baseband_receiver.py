@@ -1,0 +1,37 @@
+"""UDP -> disk baseband recorder (ref: src/baseband_receiver.cpp:59-87:
+composite_pipe of udp receive + cast + write, no device processing)."""
+
+from __future__ import annotations
+
+import sys
+
+from srtb_tpu.config import Config
+from srtb_tpu.io.udp import UdpReceiverSource
+from srtb_tpu.utils.logging import log
+from srtb_tpu.utils.termination import install_termination_handler
+
+
+def main(argv=None) -> int:
+    install_termination_handler()
+    cfg = Config.from_args(argv)
+    src = UdpReceiverSource(cfg)
+    path = cfg.baseband_output_file_prefix + "recorded.bin"
+    n = 0
+    with open(path, "ab") as f:
+        try:
+            for seg in src:
+                f.write(seg.data.tobytes())
+                n += 1
+                log.debug(f"[baseband_receiver] segment {n}, counter "
+                          f"{seg.udp_packet_counter}")
+        except KeyboardInterrupt:
+            pass
+        finally:
+            src.close()
+    log.info(f"[baseband_receiver] wrote {n} segments to {path}; "
+             f"lost {src.receiver.lost_packets} packets")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

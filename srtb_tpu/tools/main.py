@@ -1,0 +1,66 @@
+"""Main pipeline entry point (ref: src/main.cpp:88-333).
+
+Usage:
+    python -m srtb_tpu.tools.main --config_file_name srtb_config.cfg \
+        [--key value ...]
+
+Input selection follows the reference (main.cpp:241-271): if
+``input_file_path`` exists, read from file; otherwise start UDP receivers.
+The GUI equivalent (waterfall PNG service) activates with ``gui_enable``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from srtb_tpu.config import Config
+from srtb_tpu.ops import dedisperse as dd
+from srtb_tpu.pipeline.runtime import Pipeline
+from srtb_tpu.utils.logging import log
+from srtb_tpu.utils.termination import install_termination_handler
+
+
+def main(argv=None) -> int:
+    install_termination_handler()
+    cfg = Config.from_args(argv)
+    log.info(f"[main] nsamps_reserved = {dd.nsamps_reserved(cfg)}")
+
+    sinks = None
+    waterfall_service = None
+    if cfg.gui_enable:
+        from srtb_tpu.gui.waterfall import WaterfallService
+        n_spec = cfg.baseband_input_count // 2
+        nchan = min(cfg.spectrum_channel_count, n_spec)
+        waterfall_service = WaterfallService(
+            cfg, in_freq=nchan, in_time=n_spec // nchan,
+            out_dir=os.path.dirname(cfg.baseband_output_file_prefix) or ".")
+
+    if cfg.input_file_path and os.path.exists(cfg.input_file_path):
+        source = None  # Pipeline builds the file reader
+    elif cfg.input_file_path:
+        log.error(f"[main] input file {cfg.input_file_path} not found")
+        return 1
+    else:
+        from srtb_tpu.io.udp import UdpReceiverSource
+        source = UdpReceiverSource(cfg)
+
+    pipe = Pipeline(cfg, source=source, sinks=sinks)
+    if waterfall_service is not None:
+        class _Tap:
+            def push(self, work, has_signal):
+                if work.waterfall is not None:
+                    waterfall_service.push(work.waterfall,
+                                           work.segment.data_stream_id)
+                    waterfall_service.render_pending()
+        pipe.sinks.append(_Tap())
+
+    stats = pipe.run()
+    log.info(f"[main] done: {stats.segments} segments, "
+             f"{stats.signals} with signal, "
+             f"{stats.msamples_per_sec:.1f} Msamples/s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

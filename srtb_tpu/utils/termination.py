@@ -1,0 +1,49 @@
+"""Fault handling: signal handlers with stack traces.
+
+Mirrors the reference's termination handler (ref: util/termination_handler.
+hpp:38-113: std::terminate + SIGTERM/SEGV/INT/ILL/ABRT/FPE handlers
+printing a boost::stacktrace then chaining to the original handlers).
+Python's ``faulthandler`` covers the hard faults; sys.excepthook and
+signal handlers cover the rest.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import signal
+import sys
+import traceback
+
+from srtb_tpu.utils.logging import log
+
+_installed = False
+
+
+def install_termination_handler() -> None:
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    # SIGSEGV/SIGFPE/SIGABRT/SIGILL -> stack dump (like boost::stacktrace)
+    faulthandler.enable(all_threads=True)
+
+    def _excepthook(exc_type, exc, tb):
+        log.error("[termination_handler] uncaught exception:")
+        for line in traceback.format_exception(exc_type, exc, tb):
+            log.error(line.rstrip())
+        sys.__excepthook__(exc_type, exc, tb)
+
+    sys.excepthook = _excepthook
+
+    def _signal_handler(signum, frame):
+        log.error(f"[termination_handler] received signal {signum}")
+        traceback.print_stack(frame)
+        # chain to default behavior (ref chains to original handlers)
+        signal.signal(signum, signal.SIG_DFL)
+        signal.raise_signal(signum)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _signal_handler)
+        except (ValueError, OSError):
+            pass  # not main thread or unsupported
