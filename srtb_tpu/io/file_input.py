@@ -20,19 +20,25 @@ from srtb_tpu.config import Config
 from srtb_tpu.io import formats
 from srtb_tpu.ops import dedisperse as dd
 from srtb_tpu.pipeline.work import SegmentWork
+from srtb_tpu.utils.bufferpool import BufferPool
 from srtb_tpu.utils.logging import log
+
+# process-wide segment-buffer pool (ref: srtb::host_allocator singleton,
+# global_variables.hpp:49-61)
+host_buffer_pool = BufferPool("segments")
 
 
 class BasebandFileReader:
     """Iterates SegmentWork items from a raw baseband file."""
 
-    def __init__(self, cfg: Config):
+    def __init__(self, cfg: Config, buffer_pool: BufferPool | None = None):
         self.cfg = cfg
         self.fmt = formats.resolve(cfg.baseband_format_type)
         self.segment_bytes = cfg.segment_bytes(self.fmt.data_stream_count)
         nsamps = dd.nsamps_reserved(cfg)
         self.reserved_bytes = int(nsamps * abs(cfg.baseband_input_bits)
                                   // 8 * self.fmt.data_stream_count)
+        self.pool = buffer_pool or host_buffer_pool
         self._file = open(cfg.input_file_path, "rb")
         self._file.seek(cfg.input_file_offset_bytes)
         self._exhausted = False
@@ -43,9 +49,10 @@ class BasebandFileReader:
     def __next__(self) -> SegmentWork:
         if self._exhausted:
             raise StopIteration
-        buf = np.zeros(self.segment_bytes, dtype=np.uint8)
+        buf = self.pool.acquire(self.segment_bytes)
         chunk = self._file.read(self.segment_bytes)
         if len(chunk) == 0:
+            self.pool.release(buf)
             log.info(f"[read_file] {self.cfg.input_file_path} has been read")
             self._exhausted = True
             raise StopIteration

@@ -1,0 +1,78 @@
+"""Running-mean 1-bit quantizer (ref: algorithm/running_mean.hpp:30-80).
+
+Per channel: compare each sample against a sliding-window mean that trails
+it by ``windowsize`` samples, emit 1 bit (sample > mean), and carry the
+running mean across calls.  The reference loops serially per channel on
+the GPU; here the recurrence is a ``lax.scan`` over the (vectorized)
+channel axis — time is sequential, channels ride the VPU lanes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def running_mean_init_average(data: jnp.ndarray, windowsize: int):
+    """Initial per-channel average over the first window
+    (ref: running_mean.hpp:61-78).  ``data`` is [nsamp, nchan]."""
+    return jnp.mean(data[:windowsize].astype(jnp.float32), axis=0)
+
+
+def running_mean(data: jnp.ndarray, windowsize: int, ave: jnp.ndarray):
+    """[nsamp, nchan] samples -> ([nsamp, nchan] 1-bit output, final ave).
+
+    Mirrors the reference's two-phase update: for output row i the
+    comparison uses the average state after consuming rows < i+windowsize,
+    then updates with (tail - head)/windowsize; the final ``windowsize``
+    rows reuse mirrored tail samples (ref: running_mean.hpp:41-57).
+    """
+    nsamp, nchan = data.shape
+    x = data.astype(jnp.float32)
+
+    def phase1(ave_j, i):
+        head = x[i - windowsize]
+        tail = x[i]
+        out = (head > ave_j).astype(jnp.uint8)
+        ave_next = ave_j + (tail - head) / windowsize
+        return ave_next, out
+
+    ave1, out1 = jax.lax.scan(phase1, ave,
+                              jnp.arange(windowsize, nsamp))
+
+    def phase2(ave_j, i):
+        head = x[nsamp + i - windowsize]
+        tail = x[nsamp - i - 1]
+        out = (head > ave_j).astype(jnp.uint8)
+        ave_next = ave_j + (tail - head) / windowsize
+        return ave_next, out
+
+    ave2, out2 = jax.lax.scan(phase2, ave1, jnp.arange(windowsize))
+
+    out = jnp.concatenate([out1, out2], axis=0)
+    del nchan
+    return out, ave2
+
+
+def running_mean_oracle(data: np.ndarray, windowsize: int,
+                        ave: np.ndarray):
+    """Direct transliteration for tests."""
+    nsamp, nchan = data.shape
+    out = np.zeros_like(data, dtype=np.uint8)
+    ave = ave.astype(np.float64).copy()
+    x = data.astype(np.float64)
+    for j in range(nchan):
+        a = ave[j]
+        for i in range(windowsize, nsamp):
+            head = x[i - windowsize, j]
+            tail = x[i, j]
+            out[i - windowsize, j] = head > a
+            a += (tail - head) / windowsize
+        for i in range(windowsize):
+            head = x[nsamp + i - windowsize, j]
+            tail = x[nsamp - i - 1, j]
+            out[i + nsamp - windowsize, j] = head > a
+            a += (tail - head) / windowsize
+        ave[j] = a
+    return out, ave

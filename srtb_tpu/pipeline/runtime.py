@@ -104,6 +104,11 @@ class Pipeline:
                          f"{self.stats.segments}")
             for sink in self.sinks:
                 sink.push(result, positive)
+            # file mode: sinks never retain segments (no piggybank deque),
+            # so the host buffer can go back to the pool for the reader
+            pool = getattr(self.source, "pool", None)
+            if pool is not None and cfg.input_file_path:
+                pool.release(seg.data)
 
         for i, seg in enumerate(self.source):
             if max_segments is not None and i >= max_segments:

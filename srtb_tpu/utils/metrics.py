@@ -1,0 +1,51 @@
+"""Runtime metrics/observability.
+
+The reference's observability is logs: packet-loss rates
+(io/udp/udp_receiver.hpp:154-164), allocator sizes, per-pipe timestamps
+(SURVEY.md §5.5).  Here metrics are first-class counters with a one-line
+summary and optional JSON export, covering the quantities BASELINE.md
+tracks (segments/s, Msamples/s, loss rate, detections).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._start = time.monotonic()
+
+    def add(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + value
+
+    def set(self, name: str, value: float) -> None:
+        with self._lock:
+            self._counters[name] = value
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self._counters)
+        elapsed = time.monotonic() - self._start
+        out["elapsed_s"] = elapsed
+        if "samples" in out and elapsed > 0:
+            out["msamples_per_sec"] = out["samples"] / elapsed / 1e6
+        if "packets_total" in out and out["packets_total"] > 0:
+            out["packet_loss_rate"] = (
+                out.get("packets_lost", 0.0) / out["packets_total"])
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps(self.snapshot(), sort_keys=True)
+
+
+metrics = Metrics()
