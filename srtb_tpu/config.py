@@ -84,6 +84,11 @@ class Config:
     n_devices: int = 0
     # use two-float (df64) on-device chirp generation instead of host f64
     use_emulated_fp64: bool = False
+    # resume state file for file-mode streaming ("" = disabled)
+    checkpoint_path: str = ""
+    # persistent XLA compile cache dir; the FFTW-wisdom analog
+    # ("" = default ~/.cache location, "off" = disabled)
+    fft_fftw_wisdom_path: str = ""
 
     # ------------------------------------------------------------------
     # derived quantities

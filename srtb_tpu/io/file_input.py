@@ -31,7 +31,8 @@ host_buffer_pool = BufferPool("segments")
 class BasebandFileReader:
     """Iterates SegmentWork items from a raw baseband file."""
 
-    def __init__(self, cfg: Config, buffer_pool: BufferPool | None = None):
+    def __init__(self, cfg: Config, buffer_pool: BufferPool | None = None,
+                 start_offset_bytes: int | None = None):
         self.cfg = cfg
         self.fmt = formats.resolve(cfg.baseband_format_type)
         self.segment_bytes = cfg.segment_bytes(self.fmt.data_stream_count)
@@ -40,7 +41,12 @@ class BasebandFileReader:
                                   // 8 * self.fmt.data_stream_count)
         self.pool = buffer_pool or host_buffer_pool
         self._file = open(cfg.input_file_path, "rb")
-        self._file.seek(cfg.input_file_offset_bytes)
+        start = (start_offset_bytes if start_offset_bytes is not None
+                 else cfg.input_file_offset_bytes)
+        self._file.seek(start)
+        # logical byte counter (ref: read_file_pipe.hpp:47-55): tracks where
+        # the next segment starts, even past EOF zero-padding
+        self.logical_offset = start
         self._exhausted = False
 
     def __iter__(self):
@@ -57,6 +63,7 @@ class BasebandFileReader:
             self._exhausted = True
             raise StopIteration
         buf[:len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
+        self.logical_offset += self.segment_bytes
         if len(chunk) < self.segment_bytes:
             # final partial segment: emit zero-padded, then stop
             # (ref: read_file_pipe.hpp:76-77 memset + short read)
@@ -64,6 +71,7 @@ class BasebandFileReader:
         elif 0 < self.reserved_bytes < self.segment_bytes:
             # overlap-save: rewind so the next segment reprocesses the
             # dedispersion-corrupted tail (ref: read_file_pipe.hpp:86-99)
+            self.logical_offset -= self.reserved_bytes
             self._file.seek(-self.reserved_bytes, 1)
         return SegmentWork(
             data=buf,

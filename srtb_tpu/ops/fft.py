@@ -55,6 +55,31 @@ def waterfall_c2c(spectrum: jnp.ndarray, channel_count: int) -> jnp.ndarray:
     return c2c_backward(x, axis=-1)
 
 
+def ifft_refft_waterfall(spectrum: jnp.ndarray, channel_count: int,
+                         nsamps_reserved_complex: int = 0,
+                         window: jnp.ndarray | None = None) -> jnp.ndarray:
+    """The reference's alternate channelization path (currently disabled in
+    its main(), ref: main.cpp:182-186): full unnormalized inverse C2C back
+    to the (dedispersed) complex time domain, trim the reserved tail, then
+    forward C2C in chunks of ``channel_count``
+    (ref: fft_pipe.hpp:88-170 ifft_1d_c2c_pipe, 183-278 refft_1d_c2c_pipe).
+
+    Output is time-major: [n_chunks(time), channel_count(freq)] — the
+    orientation consumed by signal_detect_pipe variant 1.
+    """
+    td = c2c_backward(spectrum)
+    n = td.shape[-1]
+    if 0 < nsamps_reserved_complex < n:
+        td = td[..., : n - nsamps_reserved_complex]
+    refft_length = min(channel_count, td.shape[-1])
+    batch = td.shape[-1] // refft_length
+    td = td[..., : batch * refft_length]
+    td = td.reshape(*td.shape[:-1], batch, refft_length)
+    if window is not None:
+        td = td * window
+    return c2c_forward(td, axis=-1)
+
+
 # ----------------------------------------------------------------
 # four-step (Bailey) decomposition for very large 1-D FFTs
 # ----------------------------------------------------------------

@@ -1,0 +1,53 @@
+"""Streaming checkpoint/resume.
+
+The reference is a streaming system with no checkpointing; its closest
+analogs are FFTW wisdom, the piggybank capture, and
+``input_file_offset_bytes`` for resuming file reads (SURVEY.md §5.4).
+Here resume is first-class: a small JSON state file tracks the logical
+file offset and segment counter so a crashed/restarted file-mode run
+continues where it stopped, and the persistent XLA compile cache
+(utils.compile_cache) removes the recompilation cost on restart.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from srtb_tpu.utils.logging import log
+
+
+class StreamCheckpoint:
+    def __init__(self, path: str):
+        self.path = path
+        self.state = {"segments_done": 0, "file_offset_bytes": 0}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    self.state.update(json.load(f))
+                log.info(f"[checkpoint] resuming from {path}: "
+                         f"{self.state}")
+            except (json.JSONDecodeError, OSError) as e:
+                log.warning(f"[checkpoint] unreadable {path}: {e}")
+
+    @property
+    def segments_done(self) -> int:
+        return self.state["segments_done"]
+
+    @property
+    def file_offset_bytes(self) -> int:
+        return self.state["file_offset_bytes"]
+
+    def update(self, segments_done: int, file_offset_bytes: int) -> None:
+        self.state["segments_done"] = segments_done
+        self.state["file_offset_bytes"] = file_offset_bytes
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.state, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)  # atomic, like the fdatasync'd writers
+
+    def clear(self) -> None:
+        if os.path.exists(self.path):
+            os.unlink(self.path)

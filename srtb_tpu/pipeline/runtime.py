@@ -65,10 +65,17 @@ class Pipeline:
                  keep_waterfall: bool = True):
         self.cfg = cfg
         self.processor = SegmentProcessor(cfg)
+        self.checkpoint = None
+        if cfg.checkpoint_path:
+            from srtb_tpu.pipeline.checkpoint import StreamCheckpoint
+            self.checkpoint = StreamCheckpoint(cfg.checkpoint_path)
         if source is None:
             if not cfg.input_file_path:
                 raise ValueError("no input_file_path and no source given")
-            source = BasebandFileReader(cfg)
+            start = None
+            if self.checkpoint and self.checkpoint.segments_done:
+                start = self.checkpoint.file_offset_bytes
+            source = BasebandFileReader(cfg, start_offset_bytes=start)
         self.source = source
         if sinks is None:
             if cfg.baseband_write_all:
@@ -89,8 +96,10 @@ class Pipeline:
         pending: list[tuple[SegmentWork, object, object]] = []
         n_samples_per_seg = cfg.baseband_input_count
 
+        drained = [self.checkpoint.segments_done if self.checkpoint else 0]
+
         def drain(item):
-            seg, wf, det_res = item
+            seg, wf, det_res, offset_after = item
             # block until device results are ready
             det_res = jax.tree_util.tree_map(np.asarray, det_res)
             result = SegmentResultWork(
@@ -109,12 +118,16 @@ class Pipeline:
             pool = getattr(self.source, "pool", None)
             if pool is not None and cfg.input_file_path:
                 pool.release(seg.data)
+            drained[0] += 1
+            if self.checkpoint is not None:
+                self.checkpoint.update(drained[0], offset_after)
 
         for i, seg in enumerate(self.source):
             if max_segments is not None and i >= max_segments:
                 break
             wf, det_res = self.processor.process(seg.data)
-            pending.append((seg, wf, det_res))
+            pending.append((seg, wf, det_res,
+                            getattr(self.source, "logical_offset", 0)))
             # keep at most 2 segments in flight (the reference's queue
             # capacity, config.hpp:40-43): drain the oldest
             if len(pending) >= 2:

@@ -24,6 +24,9 @@ from srtb_tpu.utils.termination import install_termination_handler
 def main(argv=None) -> int:
     install_termination_handler()
     cfg = Config.from_args(argv)
+    if cfg.fft_fftw_wisdom_path != "off":
+        from srtb_tpu.utils.compile_cache import enable_compile_cache
+        enable_compile_cache(cfg.fft_fftw_wisdom_path)
     log.info(f"[main] nsamps_reserved = {dd.nsamps_reserved(cfg)}")
 
     sinks = None

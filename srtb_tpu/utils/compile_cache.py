@@ -1,0 +1,36 @@
+"""Persistent XLA compilation cache — the FFTW-wisdom analog.
+
+The reference persists FFTW plans to ``fft_fftw_wisdom_path`` so later
+runs skip planning (ref: fft/fftw_wrapper.hpp:196-238, config.hpp:176).
+The TPU equivalent of "planning" is XLA compilation (20-40 s for the big
+fused segment program); JAX's on-disk compilation cache plays the role of
+the wisdom file, so a restarted observation resumes at full speed.
+"""
+
+from __future__ import annotations
+
+import os
+
+from srtb_tpu.utils.logging import log
+
+
+def enable_compile_cache(path: str = "") -> str | None:
+    """Point JAX's persistent compilation cache at ``path`` (created if
+    missing).  Returns the directory used, or None if unavailable."""
+    import jax
+
+    if not path:
+        path = os.path.join(os.path.expanduser("~"), ".cache",
+                            "srtb_tpu_xla_cache")
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache everything, however small — streaming restart latency is
+        # what matters, not disk
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        log.debug(f"[compile_cache] enabled at {path}")
+        return path
+    except Exception as e:  # unsupported backend/config name drift
+        log.warning(f"[compile_cache] could not enable: {e}")
+        return None

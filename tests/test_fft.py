@@ -79,3 +79,24 @@ def test_waterfall_layout():
         expected = np.fft.ifft(row) * watfft_len
         np.testing.assert_allclose(wf[i], expected.astype(np.complex64),
                                    rtol=1e-4, atol=1e-3 * watfft_len)
+
+
+def test_ifft_refft_waterfall():
+    """Alternate path (ref: fft_pipe.hpp:88-278): ifft back to time domain,
+    trim the reserved tail, chunked forward FFT; time-major output."""
+    n = 1 << 10
+    channel_count = 32
+    reserved = 64
+    rng = np.random.default_rng(9)
+    spec = (rng.standard_normal(n) + 1j * rng.standard_normal(n)).astype(
+        np.complex64)
+    out = np.asarray(F.ifft_refft_waterfall(jnp.asarray(spec), channel_count,
+                                            reserved))
+    td = np.fft.ifft(spec) * n
+    td = td[: n - reserved]
+    batch = td.size // channel_count
+    expected = np.fft.fft(td[: batch * channel_count]
+                          .reshape(batch, channel_count), axis=-1)
+    assert out.shape == (batch, channel_count)
+    np.testing.assert_allclose(out, expected.astype(np.complex64),
+                               rtol=1e-3, atol=0.5)
