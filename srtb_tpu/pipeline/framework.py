@@ -1,0 +1,166 @@
+"""Host-side pipeline framework: threads + bounded queues + stop tokens.
+
+On TPU the *device* stages live in one fused jit (see segment.py), but the
+host stages around it — ingest from N UDP receivers, device feeding,
+result draining, writers — still benefit from the reference's
+thread-per-stage structure (ref: pipeline/framework/pipe.hpp:108-175,
+pipe_io.hpp:27-152):
+
+- ``WorkQueue``: bounded queue, capacity 2 by default
+  (ref: work.hpp:30-72 + config.hpp:40-43), blocking push/pop with a stop
+  token, and a lossy push for visualization taps
+  (ref: loose_queue_out_functor, pipe_io.hpp:79-94);
+- ``Pipe``/``start_pipe``: a worker thread running
+  in -> functor -> out until stopped (thread named after the functor);
+- ``on_exit``: request stop + join all (ref: framework/exit_handler.hpp).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable
+
+from srtb_tpu.utils.logging import log
+
+WORK_QUEUE_CAPACITY = 2  # ref: config.hpp:40
+
+
+class StopToken:
+    def __init__(self):
+        self._evt = threading.Event()
+
+    def request_stop(self):
+        self._evt.set()
+
+    @property
+    def stop_requested(self) -> bool:
+        return self._evt.is_set()
+
+
+class WorkQueue:
+    """Bounded blocking queue with stop-token-aware operations."""
+
+    def __init__(self, capacity: int = WORK_QUEUE_CAPACITY):
+        self._q = queue.Queue(maxsize=capacity)
+
+    def push(self, item, stop_token: StopToken | None = None) -> bool:
+        while True:
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                if stop_token is not None and stop_token.stop_requested:
+                    return False
+
+    def push_lossy(self, item) -> bool:
+        """Drop-if-full push for lossy visualization taps
+        (ref: pipe_io.hpp:79-94)."""
+        try:
+            self._q.put_nowait(item)
+            return True
+        except queue.Full:
+            return False
+
+    def pop(self, stop_token: StopToken | None = None):
+        """Blocking pop; returns None once stopped and drained."""
+        while True:
+            try:
+                return self._q.get(timeout=0.05)
+            except queue.Empty:
+                if stop_token is not None and stop_token.stop_requested:
+                    return None
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+
+_SENTINEL = object()
+
+
+class Pipe:
+    """One worker thread: pop from in_queue, apply functor, push to
+    out_queue.  A functor returning None drops the work item; raising
+    StopIteration ends the pipe (and forwards the sentinel downstream)."""
+
+    def __init__(self, functor: Callable, in_queue: WorkQueue | None,
+                 out_queue: WorkQueue | None, stop_token: StopToken,
+                 name: str | None = None):
+        self.functor = functor
+        self.in_queue = in_queue
+        self.out_queue = out_queue
+        self.stop_token = stop_token
+        self.name = name or getattr(functor, "__name__", type(functor).__name__)
+        self.thread = threading.Thread(target=self._run, name=self.name,
+                                       daemon=True)
+        self.exception: BaseException | None = None
+
+    def _run(self):
+        log.debug(f"[pipe {self.name}] started")
+        try:
+            while not self.stop_token.stop_requested:
+                if self.in_queue is not None:
+                    work = self.in_queue.pop(self.stop_token)
+                    if work is None:
+                        break
+                    if work is _SENTINEL:
+                        break
+                else:
+                    work = None
+                try:
+                    out = self.functor(self.stop_token, work)
+                except StopIteration:
+                    break
+                if out is not None and self.out_queue is not None:
+                    if not self.out_queue.push(out, self.stop_token):
+                        break
+        except BaseException as e:  # noqa: BLE001 - report, don't die silent
+            self.exception = e
+            log.error(f"[pipe {self.name}] crashed: {e!r}")
+        finally:
+            if self.out_queue is not None:
+                # blocking push: a lossy sentinel could be dropped on a full
+                # queue and deadlock the consumer
+                self.out_queue.push(_SENTINEL, self.stop_token)
+            log.debug(f"[pipe {self.name}] exiting")
+
+    def start(self):
+        self.thread.start()
+        return self
+
+    def join(self, timeout=None):
+        self.thread.join(timeout)
+
+
+def start_pipe(functor: Callable, in_queue: WorkQueue | None,
+               out_queue: WorkQueue | None, stop_token: StopToken,
+               name: str | None = None) -> Pipe:
+    """Spawn a pipe thread (ref: start_pipe, framework/pipe.hpp:148-175)."""
+    return Pipe(functor, in_queue, out_queue, stop_token, name).start()
+
+
+def on_exit(stop_token: StopToken, pipes: list[Pipe],
+            timeout: float = 5.0) -> None:
+    """Orderly shutdown: request stop, join everything
+    (ref: framework/exit_handler.hpp:28-39)."""
+    stop_token.request_stop()
+    for p in pipes:
+        p.join(timeout)
+        if p.thread.is_alive():
+            log.warning(f"[on_exit] pipe {p.name} did not stop in time")
+
+
+def composite(*functors: Callable) -> Callable:
+    """Sequential fusion of pipe functors into one thread
+    (ref: framework/composite_pipe.hpp:28-51)."""
+
+    def fused(stop_token, work):
+        for f in functors:
+            work = f(stop_token, work)
+            if work is None:
+                return None
+        return work
+
+    fused.__name__ = "+".join(
+        getattr(f, "__name__", type(f).__name__) for f in functors)
+    return fused

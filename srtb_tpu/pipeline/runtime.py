@@ -141,3 +141,76 @@ class Pipeline:
         log.info(f"[pipeline] {self.stats.segments} segments, "
                  f"{self.stats.msamples_per_sec:.1f} Msamples/s")
         return self.stats
+
+
+class ThreadedPipeline(Pipeline):
+    """Thread-per-host-stage variant using the framework module: ingest,
+    device dispatch and result draining run concurrently over bounded
+    queues — the closest analog of the reference's full pipe graph, useful
+    when ingest (UDP parsing, disk reads) must overlap drain (writers).
+    """
+
+    def run(self, max_segments: int | None = None) -> PipelineStats:
+        from srtb_tpu.pipeline import framework as fw
+
+        cfg = self.cfg
+        start_t = time.perf_counter()
+        it = iter(self.source)
+        count = [0]
+        drained = [self.checkpoint.segments_done if self.checkpoint else 0]
+
+        def source_f(stop_token, _):
+            if max_segments is not None and count[0] >= max_segments:
+                raise StopIteration
+            try:
+                seg = next(it)
+            except StopIteration:
+                raise StopIteration from None
+            count[0] += 1
+            return seg
+
+        def device_f(stop_token, seg):
+            wf, det_res = self.processor.process(seg.data)
+            self.stats.segments += 1
+            self.stats.samples += cfg.baseband_input_count
+            return (seg, wf, det_res,
+                    getattr(self.source, "logical_offset", 0))
+
+        def drain_f(stop_token, item):
+            seg, wf, det_res, offset_after = item
+            det_res = jax.tree_util.tree_map(np.asarray, det_res)
+            result = SegmentResultWork(
+                segment=seg,
+                waterfall=wf if self.keep_waterfall else None,
+                detect=det_res)
+            positive = has_signal(cfg, det_res)
+            if positive:
+                self.stats.signals += 1
+            for sink in self.sinks:
+                sink.push(result, positive)
+            pool = getattr(self.source, "pool", None)
+            if pool is not None and cfg.input_file_path:
+                pool.release(seg.data)
+            drained[0] += 1
+            if self.checkpoint is not None:
+                self.checkpoint.update(drained[0], offset_after)
+            return None
+
+        stop = fw.StopToken()
+        q_seg = fw.WorkQueue()
+        q_res = fw.WorkQueue()
+        pipes = [
+            fw.start_pipe(source_f, None, q_seg, stop, "source"),
+            fw.start_pipe(device_f, q_seg, q_res, stop, "device"),
+            fw.start_pipe(drain_f, q_res, None, stop, "drain"),
+        ]
+        # wait for the drain pipe to see the sentinel
+        pipes[2].join()
+        fw.on_exit(stop, pipes)
+        for p in pipes:
+            if p.exception is not None:
+                raise p.exception
+        self.stats.elapsed_s = time.perf_counter() - start_t
+        log.info(f"[pipeline threaded] {self.stats.segments} segments, "
+                 f"{self.stats.msamples_per_sec:.1f} Msamples/s")
+        return self.stats

@@ -1,0 +1,66 @@
+"""Host pipeline-framework tests: bounded queues, stop tokens, composite
+pipes, sentinel propagation, lossy push."""
+
+import time
+
+from srtb_tpu.pipeline import framework as fw
+
+
+def test_queue_capacity_and_lossy():
+    q = fw.WorkQueue(capacity=2)
+    assert q.push_lossy(1) and q.push_lossy(2)
+    assert not q.push_lossy(3)  # full -> dropped
+    assert q.pop() == 1
+
+
+def test_pipeline_chain():
+    stop = fw.StopToken()
+    q1, q2 = fw.WorkQueue(), fw.WorkQueue()
+    results = []
+
+    counter = {"n": 0}
+
+    def source(stop_token, _):
+        counter["n"] += 1
+        if counter["n"] > 5:
+            raise StopIteration
+        return counter["n"]
+
+    def double(stop_token, x):
+        return 2 * x
+
+    def sink(stop_token, x):
+        results.append(x)
+        return None
+
+    pipes = [
+        fw.start_pipe(source, None, q1, stop),
+        fw.start_pipe(double, q1, q2, stop),
+        fw.start_pipe(sink, q2, None, stop),
+    ]
+    deadline = time.time() + 5
+    while len(results) < 5 and time.time() < deadline:
+        time.sleep(0.01)
+    fw.on_exit(stop, pipes)
+    assert results == [2, 4, 6, 8, 10]
+    assert all(p.exception is None for p in pipes)
+
+
+def test_composite_fusion():
+    f = fw.composite(lambda st, x: x + 1, lambda st, x: x * 10)
+    assert f(None, 2) == 30
+    g = fw.composite(lambda st, x: None, lambda st, x: x * 10)
+    assert g(None, 2) is None  # drop propagates
+
+
+def test_stop_token_unblocks():
+    stop = fw.StopToken()
+    q = fw.WorkQueue(capacity=1)
+
+    def blocked_source(stop_token, _):
+        return 1  # push side will block on full queue
+
+    p = fw.start_pipe(blocked_source, None, q, stop)
+    time.sleep(0.1)
+    fw.on_exit(stop, [p], timeout=2.0)
+    assert not p.thread.is_alive()

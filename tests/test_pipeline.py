@@ -127,3 +127,15 @@ def test_has_signal_channel_threshold_gate():
     assert has_signal(cfg, FakeDetect()) is False
     FakeDetect.zero_count = np.asarray(10)
     assert has_signal(cfg, FakeDetect()) is True
+
+
+def test_threaded_pipeline_matches_serial(synthetic_cfg, tmp_path):
+    """ThreadedPipeline (thread-per-host-stage over bounded queues) must
+    find the same signals as the serial loop."""
+    from srtb_tpu.pipeline.runtime import ThreadedPipeline
+    cfg = synthetic_cfg.replace(
+        baseband_output_file_prefix=str(tmp_path / "thr_"))
+    pipe = ThreadedPipeline(cfg)
+    stats = pipe.run()
+    assert stats.segments >= 2
+    assert stats.signals >= 1
