@@ -224,3 +224,44 @@ class UdpReceiverSource:
 
     def close(self):
         self.receiver.close()
+
+
+class MultiUdpSource:
+    """N receivers (one per address/port pair, each on its own thread, like
+    the reference's N udp_receiver_pipe instances, ref: main.cpp:261-271)
+    multiplexed into one SegmentWork stream distinguished by
+    ``data_stream_id``."""
+
+    def __init__(self, cfg: Config, use_native: bool | None = None):
+        from srtb_tpu.pipeline import framework as fw
+        self.cfg = cfg
+        n = len(cfg.udp_receiver_port)
+        self.sources = [UdpReceiverSource(cfg, receiver_id=i,
+                                          use_native=use_native)
+                        for i in range(n)]
+        self._stop = fw.StopToken()
+        self._queue = fw.WorkQueue(capacity=2 * n)
+        self._pipes = []
+        for i, src in enumerate(self.sources):
+            def make(src):
+                def recv(stop_token, _):
+                    return next(src)
+                return recv
+            self._pipes.append(fw.start_pipe(
+                make(src), None, self._queue, self._stop,
+                name=f"udp_receiver_{i}"))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> SegmentWork:
+        item = self._queue.pop(self._stop)
+        if item is None or not isinstance(item, SegmentWork):
+            raise StopIteration
+        return item
+
+    def close(self):
+        from srtb_tpu.pipeline import framework as fw
+        fw.on_exit(self._stop, self._pipes)
+        for src in self.sources:
+            src.close()

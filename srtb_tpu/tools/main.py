@@ -44,9 +44,22 @@ def main(argv=None) -> int:
     elif cfg.input_file_path:
         log.error(f"[main] input file {cfg.input_file_path} not found")
         return 1
+    elif len(cfg.udp_receiver_port) > 1:
+        from srtb_tpu.io.udp import MultiUdpSource
+        source = MultiUdpSource(cfg)
     else:
         from srtb_tpu.io.udp import UdpReceiverSource
         source = UdpReceiverSource(cfg)
+
+    if cfg.dm_list:
+        # multi-chip DM-trial search mode
+        from srtb_tpu.pipeline.runtime import DMSearchPipeline
+        search = DMSearchPipeline(cfg, source=source)
+        stats = search.run()
+        log.info(f"[main] dm search done: {stats.segments} segments, "
+                 f"{stats.signals} with signal; trials in "
+                 f"{search.trials_path}")
+        return 0
 
     pipe = Pipeline(cfg, source=source, sinks=sinks)
     if waterfall_service is not None:

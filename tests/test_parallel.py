@@ -117,3 +117,30 @@ def test_dist_segment_seq_only(raw_segment):
     res = dist.process(raw_segment)
     assert np.asarray(res.signal_counts).shape[0] == 1
     assert np.asarray(res.signal_counts).sum() > 0  # pulse found
+
+
+def test_dm_search_pipeline(tmp_path):
+    """File -> DMSearchPipeline over an 8-trial grid on the 8-device mesh:
+    the best trial per segment must be the injected DM."""
+    cfg = _cfg().replace(
+        dm_list=[0.0, 10.0, 20.0, 30.0, 40.0, 50.0, 60.0, 70.0],
+        baseband_output_file_prefix=str(tmp_path / "dm_"),
+        signal_detect_signal_noise_threshold=7.0,
+    )
+    raw = make_dispersed_baseband(
+        cfg.baseband_input_count, cfg.baseband_freq_low,
+        cfg.baseband_bandwidth, 30.0,
+        pulse_pos=cfg.baseband_input_count // 2, pulse_amp=25.0)
+    path = str(tmp_path / "in.bin")
+    raw.tofile(path)
+    cfg = cfg.replace(input_file_path=path)
+
+    from srtb_tpu.pipeline.runtime import DMSearchPipeline
+    import json
+    pipe = DMSearchPipeline(cfg)
+    stats = pipe.run()
+    assert stats.segments == 1
+    with open(pipe.trials_path) as f:
+        rec = json.loads(f.readline())
+    assert rec["best_dm"] == 30.0
+    assert rec["best_snr"] > 7.0
