@@ -36,12 +36,19 @@ class WaterfallRenderer:
     def _render_impl(self, wf_ri: jnp.ndarray) -> jnp.ndarray:
         """wf_ri [2, F, T] (re, im) -> ARGB32 [out_h, out_w] uint32."""
         power = wf_ri[0] ** 2 + wf_ri[1] ** 2
+        return self._render_power_impl(power)
+
+    def _render_power_impl(self, power: jnp.ndarray) -> jnp.ndarray:
         img = sp.resample_spectrum(power, self.w_freq, self.w_time)
         img = sp.normalize_by_average(img)
         return sp.generate_pixmap(img)
 
     def render(self, wf_ri) -> np.ndarray:
         return np.asarray(self._render(jnp.asarray(wf_ri)))
+
+    def render_power(self, power) -> np.ndarray:
+        return np.asarray(jax.jit(self._render_power_impl)(
+            jnp.asarray(power, dtype=jnp.float32)))
 
 
 # ----------------------------------------------------------------
@@ -89,8 +96,25 @@ class WaterfallService:
             in_freq, in_time, cfg.gui_pixmap_height, cfg.gui_pixmap_width)
         self.frame_counter = {}
         self._pending = None
+        # sum several segments' power before drawing, reducing host-side
+        # frame rate (ref: config.hpp:196-200 spectrum_sum_count)
+        self.sum_count = max(1, cfg.spectrum_sum_count)
+        self._accum: dict[int, tuple[int, np.ndarray]] = {}
 
     def push(self, wf_ri, data_stream_id: int = 0) -> None:
+        if self.sum_count > 1:
+            wf = np.asarray(wf_ri)
+            if wf.ndim == 4:
+                wf = wf[:, data_stream_id]
+            power = wf[0] ** 2 + wf[1] ** 2
+            n, acc = self._accum.get(data_stream_id, (0, 0.0))
+            n, acc = n + 1, acc + power
+            if n < self.sum_count:
+                self._accum[data_stream_id] = (n, acc)
+                return
+            self._accum[data_stream_id] = (0, 0.0)
+            self._pending = (acc, data_stream_id)
+            return
         # lossy tap: replace any unrendered frame
         self._pending = (wf_ri, data_stream_id)
 
@@ -102,7 +126,10 @@ class WaterfallService:
         wf = np.asarray(wf_ri)
         if wf.ndim == 4:  # [2, S, F, T] -> this stream
             wf = wf[:, stream]
-        pix = self.renderer.render(wf)
+        if wf.ndim == 2:  # pre-summed power frame
+            pix = self.renderer.render_power(wf)
+        else:
+            pix = self.renderer.render(wf)
         n = self.frame_counter.get(stream, 0)
         self.frame_counter[stream] = n + 1
         path = os.path.join(self.out_dir,

@@ -243,12 +243,23 @@ class MultiUdpSource:
         self._queue = fw.WorkQueue(capacity=2 * n)
         self._pipes = []
         for i, src in enumerate(self.sources):
-            def make(src):
+            def make(src, cpu):
+                pinned = [False]
+
                 def recv(stop_token, _):
+                    if not pinned[0]:
+                        # pin the receiver thread near the NIC
+                        # (ref: udp_receiver_pipe.hpp:88-98)
+                        from srtb_tpu.utils.affinity import \
+                            set_thread_affinity
+                        set_thread_affinity(cpu)
+                        pinned[0] = True
                     return next(src)
                 return recv
+            cpu = cfg.udp_receiver_cpu_preferred[
+                min(i, len(cfg.udp_receiver_cpu_preferred) - 1)]
             self._pipes.append(fw.start_pipe(
-                make(src), None, self._queue, self._stop,
+                make(src, cpu), None, self._queue, self._stop,
                 name=f"udp_receiver_{i}"))
 
     def __iter__(self):

@@ -1,0 +1,26 @@
+"""CPU affinity for ingest threads (ref: util/thread_affinity.hpp:34-122,
+used by udp_receiver_pipe.hpp:88-98 to pin receivers near the NIC's NUMA
+node).  Uses os.sched_setaffinity (Linux), falling back to the native
+helper in libsrtb_udp.so."""
+
+from __future__ import annotations
+
+import os
+
+from srtb_tpu.utils.logging import log
+
+
+def set_thread_affinity(cpu: int) -> bool:
+    """Pin the calling thread to one CPU.  Returns True on success."""
+    try:
+        os.sched_setaffinity(0, {cpu})
+        return True
+    except (AttributeError, OSError) as e:
+        log.warning(f"[thread_affinity] sched_setaffinity failed: {e}")
+    try:
+        from srtb_tpu.io.udp import _NATIVE
+        if _NATIVE is not None:
+            return _NATIVE.srtb_set_thread_affinity(cpu) == 0
+    except Exception:
+        pass
+    return False

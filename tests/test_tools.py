@@ -113,3 +113,19 @@ def test_main_cli_on_file(tmp_path):
     assert rc == 0
     pngs = [f for f in os.listdir(tmp_path) if f.endswith(".png")]
     assert pngs, "gui_enable must produce waterfall PNGs"
+
+
+def test_waterfall_spectrum_sum_count(tmp_path):
+    """spectrum_sum_count: sum N segments' power before drawing
+    (ref: config.hpp:196-200)."""
+    cfg = Config(gui_pixmap_width=32, gui_pixmap_height=16,
+                 spectrum_sum_count=3)
+    svc = WaterfallService(cfg, in_freq=64, in_time=64,
+                           out_dir=str(tmp_path))
+    rng = np.random.default_rng(2)
+    wf = rng.standard_normal((2, 64, 64)).astype(np.float32)
+    svc.push(wf); assert svc.render_pending() is None
+    svc.push(wf); assert svc.render_pending() is None
+    svc.push(wf)
+    path = svc.render_pending()
+    assert path is not None and os.path.exists(path)
