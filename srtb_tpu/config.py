@@ -89,6 +89,8 @@ class Config:
     # persistent XLA compile cache dir; the FFTW-wisdom analog
     # ("" = default ~/.cache location, "off" = disabled)
     fft_fftw_wisdom_path: str = ""
+    # segment R2C strategy: auto | monolithic | four_step
+    fft_strategy: str = "auto"
 
     # ------------------------------------------------------------------
     # derived quantities

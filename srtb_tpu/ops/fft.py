@@ -162,10 +162,20 @@ def rfft_via_c2c(x: jnp.ndarray, use_four_step: bool = False) -> jnp.ndarray:
 LARGE_FFT_THRESHOLD = 1 << 27
 
 
-def segment_rfft(x: jnp.ndarray) -> jnp.ndarray:
-    """The segment-sized R2C with the drop-Nyquist convention, choosing the
-    monolithic or four-step path by size."""
+def segment_rfft(x: jnp.ndarray, strategy: str = "auto") -> jnp.ndarray:
+    """The segment-sized R2C with the drop-Nyquist convention.
+
+    strategy: "auto" (size-based), "monolithic" (one XLA R2C), or
+    "four_step" (half-size packed C2C via the Bailey decomposition +
+    Hermitian post-process — two large *batched* FFTs instead of one huge
+    1-D FFT, often the better mapping on TPU).
+    """
     n = x.shape[-1]
-    if n // 2 > LARGE_FFT_THRESHOLD:
+    if strategy == "auto":
+        strategy = "four_step" if n // 2 > LARGE_FFT_THRESHOLD \
+            else "monolithic"
+    if strategy == "four_step":
         return rfft_via_c2c(x, use_four_step=True)[..., :-1]
-    return rfft_drop_nyquist(x)
+    if strategy == "monolithic":
+        return rfft_drop_nyquist(x)
+    raise ValueError(f"unknown fft strategy {strategy!r}")

@@ -110,7 +110,7 @@ class SegmentProcessor:
         cfg = self.cfg
         x = unpack_streams(raw, self.fmt.unpack_variant,
                            cfg.baseband_input_bits, self.window)
-        spec = F.segment_rfft(x)                      # [S, n/2]
+        spec = F.segment_rfft(x, cfg.fft_strategy)    # [S, n/2]
         spec = rfi.mitigate_rfi_average_and_normalize(
             spec, cfg.mitigate_rfi_average_method_threshold, self.norm_coeff)
         spec = rfi.mitigate_rfi_manual(spec, self.rfi_mask)

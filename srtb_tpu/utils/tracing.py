@@ -1,0 +1,58 @@
+"""Profiling/tracing hooks.
+
+The reference has only ad-hoc timing (per-pipe debug logs, benchmark
+harness in test-fft_wrappers, hand-recorded kernel timings — SURVEY.md
+§5.1).  On TPU the native story is better: ``jax.profiler`` traces
+(viewable in xprof/tensorboard) plus lightweight wall-clock stage timers.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+from srtb_tpu.utils.logging import log
+
+
+@contextlib.contextmanager
+def device_trace(trace_dir: str):
+    """Capture a jax profiler trace to ``trace_dir`` (xprof format)."""
+    import jax
+
+    try:
+        jax.profiler.start_trace(trace_dir)
+        started = True
+        log.info(f"[tracing] jax profiler trace -> {trace_dir}")
+    except Exception as e:  # backend without profiler support
+        log.warning(f"[tracing] profiler unavailable: {e}")
+        started = False
+    try:
+        yield
+    finally:
+        if started:
+            jax.profiler.stop_trace()
+
+
+class StageTimer:
+    """Accumulates wall-clock per named stage; the per-pipe-timestamp logs
+    of the reference, queryable instead of grep-able."""
+
+    def __init__(self):
+        self.totals: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def summary(self) -> dict:
+        return {name: {"total_s": round(t, 6),
+                       "count": self.counts[name],
+                       "mean_ms": round(1e3 * t / self.counts[name], 3)}
+                for name, t in sorted(self.totals.items())}
