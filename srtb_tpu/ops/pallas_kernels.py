@@ -1,0 +1,247 @@
+"""Pallas TPU kernels for the hot elementwise ops.
+
+Two kernels where explicit VMEM control beats relying on XLA fusion:
+
+1. ``dedisperse_df64``: chirp multiply with the phase computed **on the
+   fly** inside the kernel using df64 two-float arithmetic.  The baseline
+   path streams a precomputed chirp bank from HBM (8 bytes/channel/trial);
+   computing the phase in-register turns the op from memory-bound (3
+   arrays in, 2 out) into 2-in/2-out — and for DM search it removes the
+   [n_dm, 2, n] chirp bank from HBM entirely.  (Same math as
+   ops.dedisperse.chirp_factor_df64 / ref: coherent_dedispersion.hpp
+   phase_factor_v3 with dsmath df64.)
+
+2. ``unpack_2bit_window``: sub-byte unpack fused with the FFT-window
+   multiply (ref: unpack.hpp:102-121 handwritten 2-bit kernel + fused
+   transform) — one byte load produces four windowed f32 samples without
+   an intermediate HBM round trip.
+
+Both fall back transparently to the jnp implementations when Pallas is
+unavailable (pure-CPU CI), and are validated against them in tests via
+``interpret=True``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from srtb_tpu.ops import dedisperse as dd
+
+# lane-friendly tile: rows x 128 lanes; f32 min tile is (8, 128)
+_LANES = 128
+_ROWS = 256  # 256*128 = 32768 elements per grid step, 128 KiB f32 in VMEM
+
+
+def _pallas_available() -> bool:
+    try:
+        from jax.experimental import pallas as pl  # noqa: F401
+        return True
+    except ImportError:  # pragma: no cover
+        return False
+
+
+# ----------------------------------------------------------------
+# df64 helpers usable inside kernels (f32-only, no tuples of refs)
+# ----------------------------------------------------------------
+
+def _two_sum(a, b):
+    s = a + b
+    v = s - a
+    return s, (a - (s - v)) + (b - v)
+
+
+def _split(a):
+    t = jnp.float32(4097.0) * a
+    hi = t - (t - a)
+    return hi, a - hi
+
+
+def _two_prod(a, b):
+    p = a * b
+    a_hi, a_lo = _split(a)
+    b_hi, b_lo = _split(b)
+    return p, ((a_hi * b_hi - p) + a_hi * b_lo + a_lo * b_hi) + a_lo * b_lo
+
+
+def _df_add(x_hi, x_lo, y_hi, y_lo):
+    s, e = _two_sum(x_hi, y_hi)
+    e = e + x_lo + y_lo
+    s2 = s + e
+    return s2, e - (s2 - s)
+
+
+def _df_mul(x_hi, x_lo, y_hi, y_lo):
+    p, e = _two_prod(x_hi, y_hi)
+    e = e + x_hi * y_lo + x_lo * y_hi
+    s = p + e
+    return s, e - (s - p)
+
+
+def _df_div(x_hi, x_lo, y_hi, y_lo):
+    q1 = x_hi / y_hi
+    p_hi, p_lo = _df_mul(q1, jnp.zeros_like(q1), y_hi, y_lo)
+    r_hi, r_lo = _df_add(x_hi, x_lo, -p_hi, -p_lo)
+    q2 = r_hi / y_hi
+    s = q1 + q2
+    return s, q2 - (s - q1)
+
+
+def _chirp_phase_block(i, f_min, df, f_c, dm):
+    """delta_phi for channel indices i (f32 array) — df64 arithmetic on
+    split constants, mirroring ops.dedisperse._chirp_phase_df64."""
+    def c(v):
+        hi = np.float32(v)
+        return jnp.float32(hi), jnp.float32(np.float64(v) - np.float64(hi))
+
+    f_min_hi, f_min_lo = c(f_min)
+    df_hi, df_lo = c(df)
+    f_c_hi, f_c_lo = c(f_c)
+    d_hi, d_lo = c(dd.D * 1e6)
+    dm_hi, dm_lo = c(dm)
+
+    i_hi = jnp.float32(1 << 12) * jnp.trunc(i / (1 << 12))
+    i_lo = i - i_hi
+    a_hi, a_lo = _df_mul(df_hi, df_lo, i_hi, jnp.zeros_like(i_hi))
+    b_hi, b_lo = _df_mul(df_hi, df_lo, i_lo, jnp.zeros_like(i_lo))
+    fi_hi, fi_lo = _df_add(a_hi, a_lo, b_hi, b_lo)
+    f_hi, f_lo = _df_add(f_min_hi, jnp.full_like(i, f_min_lo), fi_hi, fi_lo)
+
+    ddm_hi, ddm_lo = _df_mul(d_hi, d_lo, dm_hi, dm_lo)
+    q_hi, q_lo = _df_div(jnp.full_like(i, ddm_hi), jnp.full_like(i, ddm_lo),
+                         f_hi, f_lo)
+    delf_hi, delf_lo = _df_add(f_hi, f_lo, -f_c_hi,
+                               jnp.full_like(i, -f_c_lo))
+    r_hi, r_lo = _df_div(delf_hi, delf_lo, jnp.full_like(i, f_c_hi),
+                         jnp.full_like(i, f_c_lo))
+    r2_hi, r2_lo = _df_mul(r_hi, r_lo, r_hi, r_lo)
+    k_hi, k_lo = _df_mul(q_hi, q_lo, r2_hi, r2_lo)
+
+    # frac with modf semantics (sign of the value)
+    int_hi = jnp.trunc(k_hi)
+    frac = (k_hi - int_hi) + k_lo
+    frac = frac - jnp.trunc(frac)
+    positive = k_hi >= 0
+    frac = jnp.where(positive & (frac < 0), frac + 1.0, frac)
+    frac = jnp.where((~positive) & (frac > 0), frac - 1.0, frac)
+    return jnp.float32(-2.0 * np.pi) * frac
+
+
+def _dedisperse_kernel(re_ref, im_ref, out_re_ref, out_im_ref, *,
+                       f_min, df, f_c, dm, rows):
+    from jax.experimental import pallas as pl
+
+    step = pl.program_id(0)
+    base = step * (rows * _LANES)
+    # global channel index for each element of the block (row-major)
+    row_idx = jax.lax.broadcasted_iota(jnp.float32, (rows, _LANES), 0)
+    lane_idx = jax.lax.broadcasted_iota(jnp.float32, (rows, _LANES), 1)
+    i = jnp.float32(base) + row_idx * _LANES + lane_idx
+
+    phase = _chirp_phase_block(i, f_min, df, f_c, dm)
+    c = jnp.cos(phase)
+    s = jnp.sin(phase)
+    re = re_ref[:]
+    im = im_ref[:]
+    out_re_ref[:] = re * c - im * s
+    out_im_ref[:] = re * s + im * c
+
+
+def dedisperse_df64(spec_ri: jnp.ndarray, f_min: float, df: float,
+                    f_c: float, dm: float,
+                    interpret: bool = False) -> jnp.ndarray:
+    """spec_ri [2, n] -> dedispersed [2, n], chirp generated in-kernel.
+
+    n must be a multiple of 128; grid steps cover _ROWS*128 channels each.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = spec_ri.shape[-1]
+    if n % _LANES:
+        raise ValueError(f"n must be a multiple of {_LANES}")
+    rows_total = n // _LANES
+    rows = min(_ROWS, rows_total)
+    if rows_total % rows:
+        raise ValueError(f"{rows_total} rows not divisible by block {rows}")
+    grid = (rows_total // rows,)
+
+    re = spec_ri[0].reshape(rows_total, _LANES)
+    im = spec_ri[1].reshape(rows_total, _LANES)
+    kernel = functools.partial(_dedisperse_kernel, f_min=f_min, df=df,
+                               f_c=f_c, dm=dm, rows=rows)
+    block = pl.BlockSpec((rows, _LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    out_re, out_im = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[block, block],
+        out_specs=[block, block],
+        out_shape=[jax.ShapeDtypeStruct((rows_total, _LANES), jnp.float32),
+                   jax.ShapeDtypeStruct((rows_total, _LANES), jnp.float32)],
+        interpret=interpret,
+    )(re, im)
+    return jnp.stack([out_re.reshape(n), out_im.reshape(n)])
+
+
+# ----------------------------------------------------------------
+# fused 2-bit unpack + window
+# ----------------------------------------------------------------
+
+def _unpack2_kernel(byte_ref, win_ref, out_ref, *, apply_window):
+    b = byte_ref[:].astype(jnp.int32)
+    # MSB-first 2-bit fields (ref: unpack.hpp:116-119)
+    f0 = ((b >> 6) & 3).astype(jnp.float32)
+    f1 = ((b >> 4) & 3).astype(jnp.float32)
+    f2 = ((b >> 2) & 3).astype(jnp.float32)
+    f3 = (b & 3).astype(jnp.float32)
+    # interleave along lanes: [R, C] x4 -> [R, 4C]
+    out = jnp.stack([f0, f1, f2, f3], axis=-1).reshape(
+        b.shape[0], 4 * b.shape[1])
+    if apply_window:
+        out = out * win_ref[:]
+    out_ref[:] = out
+
+
+def unpack_2bit_window(data: jnp.ndarray,
+                       window: jnp.ndarray | None = None,
+                       interpret: bool = False) -> jnp.ndarray:
+    """uint8 [m] -> f32 [4m], 2-bit MSB-first unpack fused with an optional
+    window multiply, one HBM pass."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m = data.shape[-1]
+    if m % _LANES:
+        raise ValueError(f"byte count must be a multiple of {_LANES}")
+    rows_total = m // _LANES
+    rows = min(_ROWS, rows_total)
+    if rows_total % rows:
+        raise ValueError(f"{rows_total} rows not divisible by block {rows}")
+    grid = (rows_total // rows,)
+
+    bytes2d = data.reshape(rows_total, _LANES)
+    apply_window = window is not None
+    if window is None:
+        window = jnp.ones((rows_total, 4 * _LANES), dtype=jnp.float32)
+    else:
+        window = window.reshape(rows_total, 4 * _LANES)
+
+    kernel = functools.partial(_unpack2_kernel, apply_window=apply_window)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((rows, _LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((rows, 4 * _LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((rows, 4 * _LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows_total, 4 * _LANES),
+                                       jnp.float32),
+        interpret=interpret,
+    )(bytes2d, window)
+    return out.reshape(4 * m)
