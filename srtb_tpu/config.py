@@ -91,6 +91,9 @@ class Config:
     fft_fftw_wisdom_path: str = ""
     # segment R2C strategy: auto | monolithic | four_step
     fft_strategy: str = "auto"
+    # use Pallas fused kernels where available (df64 chirp-multiply,
+    # 2-bit unpack+window)
+    use_pallas: bool = False
 
     # ------------------------------------------------------------------
     # derived quantities
@@ -129,7 +132,7 @@ class Config:
     })
     _BOOL_FIELDS = frozenset({
         "baseband_reserve_sample", "baseband_write_all", "gui_enable",
-        "use_emulated_fp64",
+        "use_emulated_fp64", "use_pallas",
     })
     _LIST_FIELDS = frozenset({
         "udp_receiver_address", "udp_receiver_port",

@@ -99,6 +99,8 @@ class SegmentProcessor:
         # trim of the waterfall time axis (ref: signal_detect_pipe.hpp:289-299)
         self.time_reserved_count = self.nsamps_reserved // self.channel_count
 
+        # Pallas kernels need interpret mode off-TPU (CPU CI)
+        self._pallas_interpret = jax.default_backend() not in ("tpu", "axon")
         self._jit_process = jax.jit(self._process)
         log.debug(f"[segment] n={n} spectrum={self.n_spectrum} "
                   f"channels={self.channel_count} watfft={self.watfft_len} "
@@ -108,14 +110,29 @@ class SegmentProcessor:
 
     def _process(self, raw: jnp.ndarray, chirp_ri: jnp.ndarray):
         cfg = self.cfg
-        x = unpack_streams(raw, self.fmt.unpack_variant,
-                           cfg.baseband_input_bits, self.window)
+        use_pallas = cfg.use_pallas and self.fmt.data_stream_count == 1
+        interp = getattr(self, "_pallas_interpret", False)
+        if (use_pallas and cfg.baseband_input_bits == 2
+                and self.fmt.unpack_variant == "simple"):
+            from srtb_tpu.ops import pallas_kernels as pk
+            x = pk.unpack_2bit_window(raw, self.window,
+                                      interpret=interp)[None, :]
+        else:
+            x = unpack_streams(raw, self.fmt.unpack_variant,
+                               cfg.baseband_input_bits, self.window)
         spec = F.segment_rfft(x, cfg.fft_strategy)    # [S, n/2]
         spec = rfi.mitigate_rfi_average_and_normalize(
             spec, cfg.mitigate_rfi_average_method_threshold, self.norm_coeff)
         spec = rfi.mitigate_rfi_manual(spec, self.rfi_mask)
-        chirp = jax.lax.complex(chirp_ri[0], chirp_ri[1])
-        spec = dd.dedisperse(spec, chirp)
+        if use_pallas:
+            from srtb_tpu.ops import pallas_kernels as pk
+            spec_ri = jnp.stack([jnp.real(spec[0]), jnp.imag(spec[0])])
+            out_ri = pk.dedisperse_df64(spec_ri, self.f_min, self.df,
+                                        self.f_c, cfg.dm, interpret=interp)
+            spec = jax.lax.complex(out_ri[0], out_ri[1])[None, :]
+        else:
+            chirp = jax.lax.complex(chirp_ri[0], chirp_ri[1])
+            spec = dd.dedisperse(spec, chirp)
         wf = F.waterfall_c2c(spec, self.channel_count)  # [S, F, T]
         wf = rfi.mitigate_rfi_spectral_kurtosis(
             wf, cfg.mitigate_rfi_spectral_kurtosis_threshold)
