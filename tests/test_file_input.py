@@ -1,0 +1,79 @@
+"""File reader tests: offset skip, overlap-save positions, zero-padded
+tail (ref semantics: read_file_pipe.hpp:38-117)."""
+
+import numpy as np
+
+from srtb_tpu.config import Config
+from srtb_tpu.io.file_input import BasebandFileReader
+from srtb_tpu.ops import dedisperse as dd
+
+
+def _write(tmp_path, data):
+    path = str(tmp_path / "in.bin")
+    np.asarray(data, dtype=np.uint8).tofile(path)
+    return path
+
+
+def test_offset_skip(tmp_path):
+    data = np.arange(64, dtype=np.uint8)
+    cfg = Config(baseband_input_count=16, baseband_input_bits=8,
+                 input_file_path=_write(tmp_path, data),
+                 input_file_offset_bytes=10,
+                 baseband_reserve_sample=False)
+    reader = BasebandFileReader(cfg)
+    seg = next(reader)
+    np.testing.assert_array_equal(seg.data, data[10:26])
+
+
+def test_overlap_save_positions(tmp_path):
+    """With reserve enabled, consecutive segments must overlap by exactly
+    nsamps_reserved samples."""
+    n = 1 << 18
+    cfg = Config(baseband_input_count=n, baseband_input_bits=8,
+                 baseband_freq_low=1405.0, baseband_bandwidth=64.0,
+                 baseband_sample_rate=128e6, dm=0.5,
+                 spectrum_channel_count=1 << 4,
+                 baseband_reserve_sample=True)
+    reserved = dd.nsamps_reserved(cfg)
+    assert 0 < reserved < n
+    data = np.arange(3 * n, dtype=np.uint64).astype(np.uint8)  # wrapping ramp
+    data = np.arange(3 * n) % 251
+    data = data.astype(np.uint8)
+    cfg = cfg.replace(input_file_path=_write(tmp_path, data))
+    reader = BasebandFileReader(cfg)
+    seg1 = next(reader)
+    seg2 = next(reader)
+    np.testing.assert_array_equal(seg1.data, data[:n])
+    start2 = n - reserved
+    np.testing.assert_array_equal(seg2.data, data[start2:start2 + n])
+
+
+def test_zero_padded_tail(tmp_path):
+    data = np.full(24, 7, dtype=np.uint8)
+    cfg = Config(baseband_input_count=16, baseband_input_bits=8,
+                 input_file_path=_write(tmp_path, data),
+                 baseband_reserve_sample=False)
+    reader = BasebandFileReader(cfg)
+    seg1 = next(reader)
+    seg2 = next(reader)
+    np.testing.assert_array_equal(seg1.data, 7)
+    np.testing.assert_array_equal(seg2.data[:8], 7)
+    np.testing.assert_array_equal(seg2.data[8:], 0)  # memset-style padding
+    try:
+        next(reader)
+        raised = False
+    except StopIteration:
+        raised = True
+    assert raised
+
+
+def test_sub_byte_segment_bytes(tmp_path):
+    """2-bit samples: segment bytes = count/4."""
+    data = np.arange(32, dtype=np.uint8)
+    cfg = Config(baseband_input_count=64, baseband_input_bits=2,
+                 input_file_path=_write(tmp_path, data),
+                 baseband_reserve_sample=False)
+    reader = BasebandFileReader(cfg)
+    seg = next(reader)
+    assert seg.data.shape == (16,)
+    np.testing.assert_array_equal(seg.data, data[:16])

@@ -104,3 +104,25 @@ def test_vdif_counter_roundtrip():
     counter, _ = formats.GZNUPSR_A1.parse_packet(bytes(buf))
     assert counter == c
     assert hdr.extended_user_data_3 == c & 0xFFFFFFFF
+
+
+def test_gznupsr_block_assembly():
+    """VDIF-headed gznupsr_a1 packets through the Python receiver."""
+    fmt = formats.GZNUPSR_A1
+    payload = fmt.payload_bytes  # 8192
+    port = 42030
+    rx = udp.PythonBlockReceiver("127.0.0.1", port, fmt)
+
+    def payload_fn(c):
+        return bytes([c % 100]) * payload
+
+    sender = threading.Thread(
+        target=_send_packets, args=(port, fmt, [5, 6, 7], payload_fn))
+    sender.start()
+    out = np.zeros(2 * payload, dtype=np.uint8)
+    first, lost, total = rx.receive_block(out)
+    sender.join()
+    rx.close()
+    assert (first, lost, total) == (5, 0, 2)
+    np.testing.assert_array_equal(out[:payload], 5)
+    np.testing.assert_array_equal(out[payload:], 6)
