@@ -56,12 +56,11 @@ def boxcar_lengths(max_boxcar_length: int, time_series_count: int) -> tuple:
 def count_signal(x: jnp.ndarray, snr_threshold: float):
     """Count samples with x > threshold*sqrt(mean(x^2)), assuming mean(x)=0
     (ref: signal_detect.hpp:32-72).  Returns (count, peak_snr)."""
-    n = x.shape[-1]
-    sigma = jnp.sqrt(jnp.mean(x * x, axis=-1))
+    sigma = jnp.sqrt(jnp.mean(x * x, axis=-1, keepdims=True))
     thr = snr_threshold * sigma
     count = jnp.sum((x > thr).astype(jnp.int32), axis=-1)
-    peak_snr = jnp.max(x, axis=-1) / jnp.maximum(sigma, jnp.float32(1e-30))
-    del n
+    peak_snr = (jnp.max(x, axis=-1, keepdims=True)
+                / jnp.maximum(sigma, jnp.float32(1e-30)))[..., 0]
     return count, peak_snr
 
 

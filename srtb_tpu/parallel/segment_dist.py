@@ -37,10 +37,10 @@ from srtb_tpu.parallel import dm_grid
 
 
 class DistSegmentResult(NamedTuple):
-    zero_count: jnp.ndarray      # [n_dm]
-    signal_counts: jnp.ndarray   # [n_dm, n_boxcars]
-    snr_peaks: jnp.ndarray       # [n_dm, n_boxcars]
-    time_series: jnp.ndarray     # [n_dm, T]
+    zero_count: jnp.ndarray      # [n_dm, S]
+    signal_counts: jnp.ndarray   # [n_dm, S, n_boxcars]
+    snr_peaks: jnp.ndarray       # [n_dm, S, n_boxcars]
+    time_series: jnp.ndarray     # [n_dm, S, T]
 
 
 class DistSegmentProcessor:
@@ -51,10 +51,6 @@ class DistSegmentProcessor:
         self.cfg = cfg
         self.mesh = mesh
         self.fmt = formats.resolve(cfg.baseband_format_type)
-        if self.fmt.data_stream_count != 1:
-            raise NotImplementedError(
-                "distributed step currently processes one stream; "
-                "run streams on separate meshes or interleave segments")
         self.n_seq = mesh.shape["seq"]
         self.n_dm_devices = mesh.shape["dm"]
         if dm_list is None:
@@ -93,6 +89,7 @@ class DistSegmentProcessor:
 
         body = partial(
             self._body,
+            variant=self.fmt.unpack_variant,
             nbits=cfg.baseband_input_bits,
             n=self.n, n_seq=self.n_seq,
             n_spectrum=self.n_spectrum,
@@ -112,31 +109,37 @@ class DistSegmentProcessor:
     # ------------------------------------------------------------------
 
     @staticmethod
-    def _body(raw_block, chirp_block, mask_block, *, nbits, n, n_seq,
-              n_spectrum, channel_count, norm_coeff, avg_threshold,
+    def _body(raw_block, chirp_block, mask_block, *, variant, nbits, n,
+              n_seq, n_spectrum, channel_count, norm_coeff, avg_threshold,
               sk_threshold, time_reserved_count, snr_threshold,
               max_boxcar_length):
-        # ---- unpack (local; sub-byte fields never straddle shards) ----
-        x = U.unpack(raw_block, nbits)                  # [n/n_seq]
+        from srtb_tpu.pipeline.segment import unpack_streams
 
-        # ---- distributed R2C FFT, drop Nyquist ----
+        # ---- unpack (local; interleave patterns repeat within shards) ----
+        xs = unpack_streams(raw_block, variant, nbits, None)  # [S, n/n_seq]
+        n_streams = xs.shape[0]
+
+        # ---- distributed R2C FFT per stream, drop Nyquist ----
         m = n // 2
-        z = x.reshape(-1, 2)
-        z = jax.lax.complex(z[:, 0], z[:, 1])
         log2m = m.bit_length() - 1
         n1 = 1 << (log2m // 2)
         n2 = m // n1
-        zf = DF._dist_fft_block(z, axis_name="seq", n1=n1, n2=n2,
-                                n_dev=n_seq, inverse=False)
-        spec = DF._dist_rfft_post_block(zf, axis_name="seq", m=m,
-                                        n_dev=n_seq)   # [m/n_seq]
-
-        # ---- RFI stage 1: global mean power via psum, zap + normalize ----
-        power = jnp.real(spec) ** 2 + jnp.imag(spec) ** 2
-        mean_power = jax.lax.psum(jnp.sum(power), "seq") / n_spectrum
-        zap = power > avg_threshold * mean_power
-        spec = jnp.where(zap, 0.0 + 0.0j, spec * norm_coeff)
-        spec = jnp.where(mask_block, 0.0 + 0.0j, spec)
+        specs = []
+        for s in range(n_streams):  # S is tiny (1-4); loop, don't vmap
+            z = xs[s].reshape(-1, 2)
+            z = jax.lax.complex(z[:, 0], z[:, 1])
+            zf = DF._dist_fft_block(z, axis_name="seq", n1=n1, n2=n2,
+                                    n_dev=n_seq, inverse=False)
+            spec = DF._dist_rfft_post_block(zf, axis_name="seq", m=m,
+                                            n_dev=n_seq)   # [m/n_seq]
+            # RFI stage 1: global mean power via psum, zap + normalize
+            power = jnp.real(spec) ** 2 + jnp.imag(spec) ** 2
+            mean_power = jax.lax.psum(jnp.sum(power), "seq") / n_spectrum
+            zap = power > avg_threshold * mean_power
+            spec = jnp.where(zap, 0.0 + 0.0j, spec * norm_coeff)
+            spec = jnp.where(mask_block, 0.0 + 0.0j, spec)
+            specs.append(spec)
+        spec_all = jnp.stack(specs)                    # [S, m/n_seq]
 
         # ---- per-DM-trial: chirp, waterfall, SK, detect ----
         wlen = n_spectrum // channel_count
@@ -145,29 +148,33 @@ class DistSegmentProcessor:
             if wlen > time_reserved_count else wlen
 
         def one_trial(chirp_ri):
-            s = spec * jax.lax.complex(chirp_ri[0], chirp_ri[1])
+            s = spec_all * jax.lax.complex(chirp_ri[0], chirp_ri[1])
             # local channels are complete contiguous sub-bands
-            wf = s.reshape(ch_local, wlen)
+            wf = s.reshape(n_streams, ch_local, wlen)
             wf = jnp.fft.ifft(wf, axis=-1, norm="forward")
             wf = rfi.mitigate_rfi_spectral_kurtosis(wf, sk_threshold)
-            # global zapped-channel count
+            # global zapped-channel count per stream
             zero_count = jax.lax.psum(
-                jnp.sum((jnp.abs(wf[:, 0]) == 0).astype(jnp.int32)), "seq")
+                jnp.sum((jnp.abs(wf[:, :, 0]) == 0).astype(jnp.int32),
+                        axis=-1), "seq")               # [S]
             # global time series: sum power over all channels
             ts = jax.lax.psum(
-                jnp.sum(jnp.real(wf[:, :t]) ** 2 + jnp.imag(wf[:, :t]) ** 2,
-                        axis=0), "seq")
-            ts = ts - jnp.mean(ts)
+                jnp.sum(jnp.real(wf[:, :, :t]) ** 2
+                        + jnp.imag(wf[:, :, :t]) ** 2, axis=1),
+                "seq")                                  # [S, t]
+            ts = ts - jnp.mean(ts, axis=-1, keepdims=True)
             # boxcar cascade on the (replicated) time series
             lengths = det.boxcar_lengths(max_boxcar_length, t)
-            acc = jnp.cumsum(ts)
+            acc = jnp.cumsum(ts, axis=-1)
             counts, peaks = [], []
             for b in lengths:
-                series = ts if b == 1 else acc[b:] - acc[:-b]
+                series = ts if b == 1 \
+                    else acc[..., b:] - acc[..., :-b]
                 c, p = det.count_signal(series, snr_threshold)
                 counts.append(c)
                 peaks.append(p)
-            return (zero_count, jnp.stack(counts), jnp.stack(peaks), ts)
+            return (zero_count, jnp.stack(counts, axis=-1),
+                    jnp.stack(peaks, axis=-1), ts)
 
         return jax.vmap(one_trial)(chirp_block)
 

@@ -189,9 +189,12 @@ class DMSearchPipeline:
                 if max_segments is not None and i >= max_segments:
                     break
                 res = self.processor.process(seg.data)
-                peaks = np.asarray(res.snr_peaks)
-                counts = np.asarray(res.signal_counts)
-                zero = np.asarray(res.zero_count)
+                n_dm = len(self.dm_list)
+                # reduce over (stream, boxcar) axes -> per-dm quantities
+                peaks = np.asarray(res.snr_peaks).reshape(n_dm, -1)
+                counts = np.asarray(res.signal_counts).reshape(n_dm, -1)
+                zero = np.asarray(res.zero_count).reshape(n_dm, -1).max(
+                    axis=-1)
                 ok = zero < (cfg.signal_detect_channel_threshold
                              * cfg.spectrum_channel_count)
                 fired = counts.sum(axis=-1) > 0
