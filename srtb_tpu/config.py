@@ -78,6 +78,9 @@ class Config:
     gui_enable: bool = False
     gui_pixmap_width: int = 1920
     gui_pixmap_height: int = 1080
+    # serve live waterfall frames over HTTP on this port (0 = disabled;
+    # TPU-headless replacement for the reference's Qt windows)
+    gui_http_port: int = 0
 
     # ---- TPU-specific options (no reference equivalent) ----
     # number of devices to use; 0 = all local devices
@@ -121,7 +124,7 @@ class Config:
         "input_file_offset_bytes", "spectrum_sum_count",
         "spectrum_channel_count", "signal_detect_max_boxcar_length",
         "thread_query_work_wait_time", "gui_pixmap_width",
-        "gui_pixmap_height", "n_devices", "log_level",
+        "gui_pixmap_height", "gui_http_port", "n_devices", "log_level",
     })
     _FLOAT_FIELDS = frozenset({
         "baseband_freq_low", "baseband_bandwidth", "baseband_sample_rate",

@@ -1,0 +1,99 @@
+"""Live waterfall HTTP server.
+
+The reference shows a live Qt waterfall window per data stream
+(ref: gui/gui.hpp, spectrum_image_provider.hpp, src/main.qml).  The
+headless TPU equivalent: the WaterfallService writes PNG frames, and this
+tiny stdlib HTTP server exposes the latest frame per stream with an
+auto-refreshing index page — same live view, no GUI toolkit on the host.
+"""
+
+from __future__ import annotations
+
+import html
+import http.server
+import os
+import re
+import threading
+
+from srtb_tpu.utils.logging import log
+
+_INDEX_TEMPLATE = """<!DOCTYPE html>
+<html><head><title>srtb_tpu waterfall</title>
+<meta http-equiv="refresh" content="2">
+<style>body{{background:#111;color:#eee;font-family:monospace}}
+img{{image-rendering:pixelated;border:1px solid #444}}</style></head>
+<body><h2>srtb_tpu spectrum waterfall</h2>{body}</body></html>
+"""
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    directory = "."
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _latest_frames(self):
+        pat = re.compile(r"waterfall_s(\d+)_(\d+)\.png$")
+        latest: dict[int, str] = {}
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            names = []
+        for name in sorted(names):
+            m = pat.match(name)
+            if m:
+                latest[int(m.group(1))] = name
+        return latest
+
+    def do_GET(self):
+        if self.path in ("/", "/index.html"):
+            frames = self._latest_frames()
+            if frames:
+                body = "".join(
+                    f"<div>stream {s}: {html.escape(name)}<br>"
+                    f'<img src="/{name}"></div>'
+                    for s, name in sorted(frames.items()))
+            else:
+                body = "<p>no frames yet</p>"
+            data = _INDEX_TEMPLATE.format(body=body).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
+        name = os.path.basename(self.path)
+        path = os.path.join(self.directory, name)
+        if name.endswith(".png") and os.path.exists(path):
+            with open(path, "rb") as f:
+                data = f.read()
+            self.send_response(200)
+            self.send_header("Content-Type", "image/png")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
+        self.send_response(404)
+        self.end_headers()
+
+
+class WaterfallHTTPServer:
+    """Serve the waterfall PNG directory on a background thread."""
+
+    def __init__(self, directory: str, port: int = 0,
+                 address: str = "127.0.0.1"):
+        handler = type("Handler", (_Handler,), {"directory": directory})
+        self._httpd = http.server.ThreadingHTTPServer((address, port),
+                                                      handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+
+    def start(self) -> "WaterfallHTTPServer":
+        self._thread.start()
+        log.info(f"[gui] waterfall at http://127.0.0.1:{self.port}/")
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -31,13 +31,18 @@ def main(argv=None) -> int:
 
     sinks = None
     waterfall_service = None
+    gui_server = None
     if cfg.gui_enable:
         from srtb_tpu.gui.waterfall import WaterfallService
         n_spec = cfg.baseband_input_count // 2
         nchan = min(cfg.spectrum_channel_count, n_spec)
+        out_dir = os.path.dirname(cfg.baseband_output_file_prefix) or "."
         waterfall_service = WaterfallService(
-            cfg, in_freq=nchan, in_time=n_spec // nchan,
-            out_dir=os.path.dirname(cfg.baseband_output_file_prefix) or ".")
+            cfg, in_freq=nchan, in_time=n_spec // nchan, out_dir=out_dir)
+        if cfg.gui_http_port:
+            from srtb_tpu.gui.server import WaterfallHTTPServer
+            gui_server = WaterfallHTTPServer(
+                out_dir, port=cfg.gui_http_port).start()
 
     if cfg.input_file_path and os.path.exists(cfg.input_file_path):
         source = None  # Pipeline builds the file reader
@@ -72,6 +77,8 @@ def main(argv=None) -> int:
         pipe.sinks.append(_Tap())
 
     stats = pipe.run()
+    if gui_server is not None:
+        gui_server.stop()
     log.info(f"[main] done: {stats.segments} segments, "
              f"{stats.signals} with signal, "
              f"{stats.msamples_per_sec:.1f} Msamples/s")

@@ -129,3 +129,28 @@ def test_waterfall_spectrum_sum_count(tmp_path):
     svc.push(wf)
     path = svc.render_pending()
     assert path is not None and os.path.exists(path)
+
+
+def test_waterfall_http_server(tmp_path):
+    """Live viewer: index page lists the latest frame per stream and serves
+    the PNG bytes."""
+    import urllib.request
+    from srtb_tpu.gui.server import WaterfallHTTPServer
+
+    cfg = Config(gui_pixmap_width=16, gui_pixmap_height=8)
+    svc = WaterfallService(cfg, in_freq=32, in_time=32,
+                           out_dir=str(tmp_path))
+    svc.push(np.random.default_rng(0)
+             .standard_normal((2, 32, 32)).astype(np.float32))
+    svc.render_pending()
+
+    srv = WaterfallHTTPServer(str(tmp_path)).start()
+    try:
+        idx = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/").read().decode()
+        assert "waterfall_s0_000000.png" in idx
+        png = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/waterfall_s0_000000.png").read()
+        assert png[:8] == b"\x89PNG\r\n\x1a\n"
+    finally:
+        srv.stop()
