@@ -34,18 +34,27 @@ class _Handler(http.server.BaseHTTPRequestHandler):
 
     def _latest_frames(self):
         pat = re.compile(r"waterfall_s(\d+)_(\d+)\.png$")
-        latest: dict[int, str] = {}
+        latest: dict[int, tuple[int, str]] = {}
         try:
             names = os.listdir(self.directory)
         except OSError:
             names = []
-        for name in sorted(names):
+        for name in names:
             m = pat.match(name)
             if m:
-                latest[int(m.group(1))] = name
-        return latest
+                stream, idx = int(m.group(1)), int(m.group(2))
+                if stream not in latest or idx > latest[stream][0]:
+                    latest[stream] = (idx, name)
+        return {s: name for s, (_, name) in latest.items()}
 
     def do_GET(self):
+        try:
+            self._do_get()
+        except ConnectionError:
+            # browsers abort in-flight <img> loads on every index refresh
+            pass
+
+    def _do_get(self):
         if self.path in ("/", "/index.html"):
             frames = self._latest_frames()
             if frames:

@@ -74,12 +74,16 @@ def write_png(path: str, argb: np.ndarray) -> None:
         [np.zeros((h, 1), dtype=np.uint8),  # filter byte 0 per row
          rgba.reshape(h, w * 4)], axis=1)
     raw = rows.tobytes()
-    with open(path, "wb") as f:
+    # write-then-rename so concurrent readers (the HTTP viewer) never see a
+    # partially written frame
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
         f.write(b"\x89PNG\r\n\x1a\n")
         f.write(_png_chunk(
             b"IHDR", struct.pack(">IIBBBBB", w, h, 8, 6, 0, 0, 0)))
         f.write(_png_chunk(b"IDAT", zlib.compress(raw, 6)))
         f.write(_png_chunk(b"IEND", b""))
+    os.replace(tmp, path)
 
 
 class WaterfallService:

@@ -89,15 +89,36 @@ def ifft_refft_waterfall(spectrum: jnp.ndarray, channel_count: int,
 # poor plan) into two large *batched* FFTs plus elementwise twiddles —
 # exactly the shape XLA tiles well.  This is hard part #1 of SURVEY.md §7.
 
-def _twiddle(n1: int, n2: int, inverse: bool) -> np.ndarray:
-    """w[j1, j2] = exp(+-2*pi*i*j1*j2/n), computed in f64 on host."""
-    j1 = np.arange(n1, dtype=np.float64)[:, None]
-    j2 = np.arange(n2, dtype=np.float64)[None, :]
-    sign = 2.0j if inverse else -2.0j
-    # exact phase reduction: phase = j1*j2/n mod 1 computed in f64 is accurate
-    # enough for n <= 2^32 given j1*j2 < 2^53
-    return np.exp(sign * np.pi * ((j1 * j2) % (n1 * n2)) / (n1 * n2)).astype(
-        np.complex64)
+def _phase_exp(r: jnp.ndarray, n: int, sign: float) -> jnp.ndarray:
+    """exp(i*sign*2*pi*r/n) for an int32 residue array r (0 <= r < ~n).
+
+    The residue is split into high/low halves so each converts to float32
+    exactly; the two sin/cos arguments are combined by angle addition.
+    This keeps the phase accurate for n far beyond f32's 24-bit mantissa
+    without materializing any host-side table.
+    """
+    half = 1 << max(n.bit_length() // 2, 1)
+    scale = jnp.float32(sign * 2.0 * np.pi / n)
+    a = ((r // half) * half).astype(jnp.float32) * scale  # exact multiples
+    b = (r % half).astype(jnp.float32) * scale            # < half: exact
+    # exp(i(a+b)) = exp(ia) * exp(ib)
+    return (jax.lax.complex(jnp.cos(a), jnp.sin(a))
+            * jax.lax.complex(jnp.cos(b), jnp.sin(b)))
+
+
+def _twiddle(n1: int, n2: int, inverse: bool) -> jnp.ndarray:
+    """w[j1, j2] = exp(+-2*pi*i*j1*j2/n), generated inside the trace.
+
+    Materializing this as a host-side constant would bake an n-element
+    complex64 literal into the compiled program (512 MB at n = 2^26), so the
+    table is built from iota on device.  The phase j1*j2/n is reduced mod 1
+    with *integer* arithmetic first — j1*j2 < n fits int32 exactly.
+    """
+    n = n1 * n2
+    j1 = jax.lax.iota(jnp.int32, n1)[:, None]
+    j2 = jax.lax.iota(jnp.int32, n2)[None, :]
+    r = (j1 * j2) % n                      # exact, < n
+    return _phase_exp(r, n, 1.0 if inverse else -1.0)
 
 
 def _split_factor(n: int) -> int:
@@ -114,7 +135,7 @@ def four_step_fft(x: jnp.ndarray, inverse: bool = False) -> jnp.ndarray:
         raise ValueError("four_step_fft requires power-of-two length")
     n1 = _split_factor(n)
     n2 = n // n1
-    tw = jnp.asarray(_twiddle(n1, n2, inverse))
+    tw = _twiddle(n1, n2, inverse)
     # view as [n1, n2] row-major: x[j1*n2 + j2]
     a = x.reshape(*x.shape[:-1], n1, n2)
     # FFT over the n1 axis (columns)
@@ -145,15 +166,17 @@ def rfft_via_c2c(x: jnp.ndarray, use_four_step: bool = False) -> jnp.ndarray:
     z = x.reshape(*x.shape[:-1], m, 2)
     z = jax.lax.complex(z[..., 0], z[..., 1])
     zf = four_step_fft(z) if use_four_step else jnp.fft.fft(z)
-    # Hermitian split: X[k] = F[k] + conj(F[m-k]) pieces
-    k = jnp.arange(m + 1)
-    zf_ext = jnp.concatenate([zf, zf[..., :1]], axis=-1)  # F[m] = F[0]
-    f_k = zf_ext[..., k]
-    f_mk = jnp.conj(zf_ext[..., (m - k) % m])
+    # Hermitian split: X[k] = F[k] + conj(F[m-k]) pieces.  The m-k indexing
+    # is a reverse + shift, written as slices (not a gather, which TPUs
+    # handle poorly at this size): [(m-0)%m, ..., (m-m)%m] = [0, m-1, ..., 0]
+    f_k = jnp.concatenate([zf, zf[..., :1]], axis=-1)      # F[m] = F[0]
+    rev = jnp.flip(zf, axis=-1)                            # [m-1, ..., 0]
+    f_mk = jnp.conj(jnp.concatenate([zf[..., :1], rev], axis=-1))
     even = 0.5 * (f_k + f_mk)
     odd = -0.5j * (f_k - f_mk)
-    w = jnp.exp(jnp.asarray(-2j * np.pi, dtype=zf.dtype)
-                * k.astype(jnp.float32) / n)
+    # w[k] = exp(-2*pi*i*k/n), k in [0, m] — exact hi/lo phase split
+    # (avoids both a baked constant and f32 rounding of k)
+    w = _phase_exp(jax.lax.iota(jnp.int32, m + 1), n, -1.0)
     return even + w * odd
 
 

@@ -32,6 +32,10 @@ def main(argv=None) -> int:
     sinks = None
     waterfall_service = None
     gui_server = None
+    if cfg.gui_http_port and not cfg.gui_enable:
+        # a live viewer port only makes sense with frames being rendered
+        log.info("[main] gui_http_port set: enabling the waterfall service")
+        cfg.gui_enable = True
     if cfg.gui_enable:
         from srtb_tpu.gui.waterfall import WaterfallService
         n_spec = cfg.baseband_input_count // 2
