@@ -97,6 +97,10 @@ class Config:
     # use Pallas fused kernels where available (df64 chirp-multiply,
     # 2-bit unpack+window)
     use_pallas: bool = False
+    # candidate-writer thread count; >0 uses the async writer pool (native
+    # C++ when built — the reference's boost thread pools,
+    # write_signal_pipe.hpp:159-280), 0 writes synchronously
+    writer_thread_count: int = 2
 
     # ------------------------------------------------------------------
     # derived quantities
@@ -125,6 +129,7 @@ class Config:
         "spectrum_channel_count", "signal_detect_max_boxcar_length",
         "thread_query_work_wait_time", "gui_pixmap_width",
         "gui_pixmap_height", "gui_http_port", "n_devices", "log_level",
+        "writer_thread_count",
     })
     _FLOAT_FIELDS = frozenset({
         "baseband_freq_low", "baseband_bandwidth", "baseband_sample_rate",

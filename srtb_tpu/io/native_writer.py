@@ -1,0 +1,199 @@
+"""Asynchronous writer pool.
+
+Python-side interface over the native C++ writer-thread pool
+(``srtb_tpu/native/file_writer.cpp``, built to ``libsrtb_writer.so``), with
+a pure-Python ``ThreadPoolExecutor`` fallback implementing the same
+(path, bytes, fsync) job semantics.
+
+The reference writes candidates asynchronously from two
+boost::asio::thread_pools so the pipeline never blocks on disk — baseband
+``.bin`` blobs are fdatasync'd, spectrum ``.npy``/``.tim`` files are not
+(ref: pipeline/write_signal_pipe.hpp:159-280).  An ``AsyncWriterPool`` is
+the srtb_tpu equivalent: submission copies the payload so the caller can
+reuse its buffer immediately; ``drain()`` blocks until everything queued
+has hit the filesystem.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+import weakref
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "..", "native",
+                         "libsrtb_writer.so")
+
+
+def _load_native():
+    try:
+        lib = ctypes.CDLL(os.path.abspath(_LIB_PATH))
+    except OSError:
+        return None
+    lib.srtb_writer_create.restype = ctypes.c_void_p
+    lib.srtb_writer_create.argtypes = [ctypes.c_int32, ctypes.c_uint64]
+    lib.srtb_writer_submit.restype = ctypes.c_int32
+    lib.srtb_writer_submit.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32]
+    lib.srtb_writer_drain.argtypes = [ctypes.c_void_p]
+    for name in ("srtb_writer_jobs_done", "srtb_writer_bytes_written",
+                 "srtb_writer_errors"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_uint64
+        fn.argtypes = [ctypes.c_void_p]
+    lib.srtb_writer_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+_NATIVE = _load_native()
+
+
+def native_available() -> bool:
+    return _NATIVE is not None
+
+
+class AsyncWriterPool:
+    """Thread-pool writer for (path, bytes, fsync, append) jobs.
+
+    Uses the native C++ pool when ``libsrtb_writer.so`` is built (run
+    ``make -C srtb_tpu/native``), otherwise a Python thread pool with
+    identical semantics.
+    """
+
+    DEFAULT_MAX_QUEUED_BYTES = 1 << 30  # 1 GiB of queued payload copies
+
+    def __init__(self, n_threads: int = 2, prefer_native: bool = True,
+                 max_queued_bytes: int | None = None):
+        self.n_threads = max(1, n_threads)
+        if max_queued_bytes is None:
+            max_queued_bytes = self.DEFAULT_MAX_QUEUED_BYTES
+        self.max_queued_bytes = max_queued_bytes
+        self._lock = threading.Lock()
+        self._space = threading.Condition(self._lock)
+        self._queued_bytes = 0
+        self._py_errors = 0
+        self._py_jobs = 0
+        self._py_bytes = 0
+        if prefer_native and _NATIVE is not None:
+            self._lib = _NATIVE
+            self._h = self._lib.srtb_writer_create(self.n_threads,
+                                                   max_queued_bytes)
+            self._pool = None
+            if not self._h:
+                raise MemoryError("srtb_writer_create failed")
+            # drain+destroy the native pool even if close() is never
+            # called (srtb_writer_destroy joins the C++ threads)
+            self._finalizer = weakref.finalize(
+                self, self._lib.srtb_writer_destroy, self._h)
+        else:
+            self._lib = None
+            self._h = None
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.n_threads,
+                thread_name_prefix="srtb-writer")
+            self._futures = []
+
+    @property
+    def is_native(self) -> bool:
+        return self._h is not None
+
+    # ------------------------------------------------------------------
+
+    def submit(self, path: str, data, *, fsync: bool = False,
+               append: bool = False) -> None:
+        """Queue one write. ``data`` is bytes or a numpy array; it is
+        copied at submission, so the caller may reuse its buffer.
+
+        ``append`` requires a single-thread pool: with more workers the
+        append order would be nondeterministic.
+        """
+        if append and self.n_threads > 1:
+            raise ValueError(
+                "append=True needs n_threads=1 (ordered appends)")
+        buf = np.ascontiguousarray(data).view(np.uint8).reshape(-1) \
+            if isinstance(data, np.ndarray) else \
+            np.frombuffer(bytes(data), dtype=np.uint8)
+        if self._h is not None:
+            ptr = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+            rc = self._lib.srtb_writer_submit(
+                self._h, path.encode(), ptr, buf.size,
+                1 if fsync else 0, 1 if append else 0)
+            if rc != 0:
+                raise RuntimeError(f"srtb_writer_submit failed for {path}")
+            return
+        payload = buf.tobytes()  # copy-at-submit, like the native pool
+        with self._space:
+            # backpressure: bound the RAM held by queued copies (oversized
+            # payloads wait for an empty queue)
+            if self.max_queued_bytes > 0:
+                self._space.wait_for(
+                    lambda: (self._queued_bytes + len(payload)
+                             <= self.max_queued_bytes)
+                    or self._queued_bytes == 0)
+            self._queued_bytes += len(payload)
+            fut = self._pool.submit(self._py_write, path, payload, fsync,
+                                    append)
+            self._futures.append(fut)
+
+    def _py_write(self, path: str, payload: bytes, fsync: bool,
+                  append: bool) -> None:
+        try:
+            with open(path, "ab" if append else "wb") as f:
+                f.write(payload)
+                f.flush()
+                if fsync:
+                    os.fdatasync(f.fileno())
+            with self._space:
+                self._py_jobs += 1
+                self._py_bytes += len(payload)
+                self._queued_bytes -= len(payload)
+                self._space.notify_all()
+        except OSError:
+            with self._space:
+                self._py_jobs += 1
+                self._py_errors += 1
+                self._queued_bytes -= len(payload)
+                self._space.notify_all()
+
+    # ------------------------------------------------------------------
+
+    def drain(self) -> None:
+        """Block until every submitted job has been written (or failed)."""
+        if self._h is not None:
+            self._lib.srtb_writer_drain(self._h)
+            return
+        with self._lock:
+            futures, self._futures = self._futures, []
+        for fut in futures:
+            fut.result()
+
+    def stats(self) -> dict:
+        if self._h is not None:
+            return {
+                "jobs_done": self._lib.srtb_writer_jobs_done(self._h),
+                "bytes_written": self._lib.srtb_writer_bytes_written(self._h),
+                "errors": self._lib.srtb_writer_errors(self._h),
+            }
+        with self._lock:
+            return {"jobs_done": self._py_jobs,
+                    "bytes_written": self._py_bytes,
+                    "errors": self._py_errors}
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._finalizer()  # idempotent drain + destroy
+            self._h = None
+        elif self._pool is not None:
+            self.drain()
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -27,6 +27,15 @@ from srtb_tpu.pipeline.work import (NO_UDP_PACKET_COUNTER, SegmentResultWork)
 from srtb_tpu.utils.logging import log
 
 
+def _npy_bytes(arr: np.ndarray) -> np.ndarray:
+    """Serialize an array in .npy format to a uint8 buffer (cnpy analog —
+    the reference writes .npy via cnpy, write_signal_pipe.hpp:243-244)."""
+    import io as _io
+    bio = _io.BytesIO()
+    np.save(bio, arr)
+    return np.frombuffer(bio.getvalue(), dtype=np.uint8)
+
+
 @dataclass
 class CandidateFiles:
     """Paths written for one positive segment."""
@@ -36,11 +45,22 @@ class CandidateFiles:
 
 
 class WriteSignalSink:
-    """Candidate writer with the reference's piggybank capture policy."""
+    """Candidate writer with the reference's piggybank capture policy.
 
-    def __init__(self, cfg: Config, fdatasync: bool = True):
+    When ``writer_pool`` (an :class:`AsyncWriterPool`) is given, file
+    writes are queued to its (native C++) thread pool and this sink never
+    blocks on disk — the reference's async thread-pool behavior
+    (write_signal_pipe.hpp:159-206 submits to boost thread pools).  Call
+    ``drain()`` before reading the files back.
+    """
+
+    def __init__(self, cfg: Config, fdatasync: bool = True,
+                 writer_pool=None):
         self.cfg = cfg
         self.fdatasync = fdatasync
+        self.pool = writer_pool
+        self._assigned_paths: set[str] = set()
+        self._errors_seen = 0
         self.recent_positive_timestamps: deque[int] = deque()
         self.recent_negative_works: deque[SegmentResultWork] = deque()
         self.written: list[CandidateFiles] = []
@@ -107,11 +127,9 @@ class WriteSignalSink:
         log.info(f"[write_signal] begin writing, file_counter = {counter}")
 
         bin_path = base + ".bin"
-        with open(bin_path, "wb") as f:
-            f.write(np.ascontiguousarray(work.segment.data).tobytes())
-            f.flush()
-            if self.fdatasync:
-                os.fdatasync(f.fileno())
+        self._write_bytes(bin_path,
+                          np.ascontiguousarray(work.segment.data),
+                          fsync=self.fdatasync)
 
         npy_paths = []
         if work.waterfall is not None:
@@ -121,12 +139,14 @@ class WriteSignalSink:
             if wf.ndim == 2:
                 wf = wf[None]
             for i in range(wf.shape[0]):
-                # pick first non-existing index (ref: 230-235)
+                # pick first non-existing index (ref: 230-235); with an
+                # async pool queued-but-unwritten paths count as taken
                 j = i
-                while os.path.exists(f"{base}.{j}.npy"):
+                while (os.path.exists(f"{base}.{j}.npy")
+                       or f"{base}.{j}.npy" in self._assigned_paths):
                     j += 1
                 path = f"{base}.{j}.npy"
-                np.save(path, wf[i].astype(np.complex64))
+                self._write_bytes(path, _npy_bytes(wf[i].astype(np.complex64)))
                 npy_paths.append(path)
 
         tim_paths = []
@@ -137,41 +157,102 @@ class WriteSignalSink:
                 counts = counts[None]
                 series = series[None]
             lengths = work.detect.boxcar_lengths
+            multi = counts.shape[0] > 1
             for s in range(counts.shape[0]):
                 for bi, b in enumerate(lengths):
                     if counts[s, bi] > 0:
-                        path = f"{base}.{b}.tim"
+                        # single-stream keeps the reference's exact name;
+                        # batched multi-polarization results need a stream
+                        # index or the streams would overwrite each other
+                        path = (f"{base}.s{s}.{b}.tim" if multi
+                                else f"{base}.{b}.tim")
                         valid = series.shape[-1] - (b if b > 1 else 0)
-                        series[s, bi, :valid].astype("<f4").tofile(path)
+                        self._write_bytes(
+                            path, series[s, bi, :valid].astype("<f4"))
                         tim_paths.append(path)
 
         self.written.append(CandidateFiles(bin_path, npy_paths, tim_paths))
         log.info(f"[write_signal] finished writing, file_counter = {counter}")
 
+    def _write_bytes(self, path: str, data: np.ndarray, *,
+                     fsync: bool = False) -> None:
+        if self.pool is not None:
+            if path in self._assigned_paths:
+                # same target queued again (e.g. a piggybacked segment
+                # sharing a packet counter): flush first so the later
+                # write deterministically wins instead of racing
+                self.pool.drain()
+                self._assigned_paths.clear()
+            self._assigned_paths.add(path)
+            self.pool.submit(path, data, fsync=fsync)
+            return
+        with open(path, "wb") as f:
+            f.write(data.tobytes())
+            f.flush()
+            if fsync:
+                os.fdatasync(f.fileno())
+
+    def drain(self) -> None:
+        """Wait for queued async writes to land (no-op when synchronous).
+
+        Raises ``RuntimeError`` if any queued write failed — the
+        synchronous path would have raised at the failing ``open``/
+        ``write``, and a silently lost candidate defeats the writer's
+        purpose.
+        """
+        if self.pool is not None:
+            self.pool.drain()
+            self._assigned_paths.clear()
+            errors = self.pool.stats()["errors"]
+            new_errors = errors - self._errors_seen
+            self._errors_seen = errors
+            if new_errors:
+                raise RuntimeError(
+                    f"{new_errors} async candidate write(s) failed "
+                    f"(prefix {self.cfg.baseband_output_file_prefix})")
+
 
 class WriteAllSink:
     """Unconditional append of baseband minus the reserved tail to one file
     per stream (ref: pipeline/write_file_pipe.hpp:41-94, selected when
-    ``baseband_write_all``)."""
+    ``baseband_write_all``).
+
+    Synchronous by default, as in the reference (the write happens inline
+    in the pipe body).  Passing a **single-thread** ``writer_pool`` makes
+    appends asynchronous while keeping their order.
+    """
 
     def __init__(self, cfg: Config, reserved_bytes: int,
-                 data_stream_id: int = 0):
+                 data_stream_id: int = 0, writer_pool=None):
         self.reserved_bytes = reserved_bytes
         path = (cfg.baseband_output_file_prefix
                 + f"stream{data_stream_id}.bin")
         self.path = path
-        self._f = open(path, "ab")
+        self.pool = writer_pool
+        if writer_pool is not None and writer_pool.n_threads != 1:
+            raise ValueError("WriteAllSink needs a 1-thread pool "
+                             "(ordered appends)")
+        self._f = None if writer_pool is not None else open(path, "ab")
 
     def push(self, work: SegmentResultWork, has_signal: bool = False) -> None:
         data = work.segment.data
         end = len(data) - self.reserved_bytes
         if end <= 0:
             end = len(data)
-        self._f.write(np.ascontiguousarray(data[:end]).tobytes())
+        chunk = np.ascontiguousarray(data[:end])
+        if self.pool is not None:
+            self.pool.submit(self.path, chunk, append=True)
+            return
+        self._f.write(chunk.tobytes())
         self._f.flush()
 
+    def drain(self) -> None:
+        if self.pool is not None:
+            self.pool.drain()
+
     def close(self):
-        self._f.close()
+        if self._f is not None:
+            self._f.close()
 
 
 # ----------------------------------------------------------------

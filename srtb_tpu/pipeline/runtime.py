@@ -65,6 +65,7 @@ class Pipeline:
                  keep_waterfall: bool = True):
         self.cfg = cfg
         self.processor = SegmentProcessor(cfg)
+        self._owned_writer_pool = None
         self.checkpoint = None
         if cfg.checkpoint_path:
             from srtb_tpu.pipeline.checkpoint import StreamCheckpoint
@@ -85,7 +86,12 @@ class Pipeline:
                     * self.processor.data_stream_count)
                 sinks = [WriteAllSink(cfg, reserved_bytes)]
             else:
-                sinks = [WriteSignalSink(cfg)]
+                if cfg.writer_thread_count > 0:
+                    from srtb_tpu.io.native_writer import AsyncWriterPool
+                    self._owned_writer_pool = AsyncWriterPool(
+                        cfg.writer_thread_count)
+                sinks = [WriteSignalSink(
+                    cfg, writer_pool=self._owned_writer_pool)]
         self.sinks = sinks
         self.keep_waterfall = keep_waterfall
         self.stats = PipelineStats()
@@ -120,6 +126,9 @@ class Pipeline:
                 pool.release(seg.data)
             drained[0] += 1
             if self.checkpoint is not None:
+                # a checkpointed segment must be durable: flush queued
+                # async candidate writes before recording it as done
+                self._drain_sinks()
                 self.checkpoint.update(drained[0], offset_after)
 
         for i, seg in enumerate(self.source):
@@ -137,10 +146,30 @@ class Pipeline:
 
         for item in pending:
             drain(item)
+        self._drain_sinks()
         self.stats.elapsed_s = time.perf_counter() - start
         log.info(f"[pipeline] {self.stats.segments} segments, "
                  f"{self.stats.msamples_per_sec:.1f} Msamples/s")
         return self.stats
+
+    def _drain_sinks(self) -> None:
+        for sink in self.sinks:
+            if hasattr(sink, "drain"):
+                sink.drain()  # async writer pool: wait for disk
+
+    def close(self) -> None:
+        """Release runtime resources (the owned writer-pool threads).
+        The pool also self-finalizes at GC, so forgetting this leaks
+        nothing — but explicit close gives deterministic shutdown."""
+        if self._owned_writer_pool is not None:
+            self._owned_writer_pool.close()
+            self._owned_writer_pool = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 class DMSearchPipeline:
@@ -275,6 +304,7 @@ class ThreadedPipeline(Pipeline):
                 pool.release(seg.data)
             drained[0] += 1
             if self.checkpoint is not None:
+                self._drain_sinks()  # durability before recording done
                 self.checkpoint.update(drained[0], offset_after)
             return None
 
@@ -292,6 +322,7 @@ class ThreadedPipeline(Pipeline):
         for p in pipes:
             if p.exception is not None:
                 raise p.exception
+        self._drain_sinks()
         self.stats.elapsed_s = time.perf_counter() - start_t
         log.info(f"[pipeline threaded] {self.stats.segments} segments, "
                  f"{self.stats.msamples_per_sec:.1f} Msamples/s")

@@ -80,7 +80,10 @@ def main(argv=None) -> int:
                     waterfall_service.render_pending()
         pipe.sinks.append(_Tap())
 
-    stats = pipe.run()
+    try:
+        stats = pipe.run()
+    finally:
+        pipe.close()
     if gui_server is not None:
         gui_server.stop()
     log.info(f"[main] done: {stats.segments} segments, "
