@@ -101,6 +101,11 @@ class Config:
     # C++ when built — the reference's boost thread pools,
     # write_signal_pipe.hpp:159-280), 0 writes synchronously
     writer_thread_count: int = 2
+    # multi-host process group (jax.distributed); the DCN layer the
+    # reference lacks. coordinator is "host:port" of process 0
+    distributed_coordinator: str = ""
+    distributed_num_processes: int = 1
+    distributed_process_id: int = 0
 
     # ------------------------------------------------------------------
     # derived quantities
@@ -129,7 +134,8 @@ class Config:
         "spectrum_channel_count", "signal_detect_max_boxcar_length",
         "thread_query_work_wait_time", "gui_pixmap_width",
         "gui_pixmap_height", "gui_http_port", "n_devices", "log_level",
-        "writer_thread_count",
+        "writer_thread_count", "distributed_num_processes",
+        "distributed_process_id",
     })
     _FLOAT_FIELDS = frozenset({
         "baseband_freq_low", "baseband_bandwidth", "baseband_sample_rate",

@@ -8,7 +8,9 @@ helpers (src/plot_spectrum.py, plot_tim.py) work unmodified:
 - ``<prefix><counter>.<i>.npy``  complex64 spectrum waterfall, shape
   [freq_bins, time_samples] (ref: write_signal_pipe.hpp:209-246);
 - ``<prefix><counter>.<boxcar>.tim``  raw float32 time series
-  (ref: write_signal_pipe.hpp:249-280);
+  (ref: write_signal_pipe.hpp:249-280); batched multi-polarization
+  results add a stream index: ``<prefix><counter>.s<stream>.<boxcar>.tim``
+  (no reference equivalent — its streams are separate work items);
 - the "piggybank" logic keeps recent negatives and writes them when they
   overlap (within 0.45 segment) a recent positive in another polarization
   (ref: write_signal_pipe.hpp:77-140).
@@ -229,6 +231,7 @@ class WriteAllSink:
                 + f"stream{data_stream_id}.bin")
         self.path = path
         self.pool = writer_pool
+        self._errors_seen = 0
         if writer_pool is not None and writer_pool.n_threads != 1:
             raise ValueError("WriteAllSink needs a 1-thread pool "
                              "(ordered appends)")
@@ -249,6 +252,12 @@ class WriteAllSink:
     def drain(self) -> None:
         if self.pool is not None:
             self.pool.drain()
+            errors = self.pool.stats()["errors"]
+            new_errors = errors - self._errors_seen
+            self._errors_seen = errors
+            if new_errors:
+                raise RuntimeError(
+                    f"{new_errors} async append(s) to {self.path} failed")
 
     def close(self):
         if self._f is not None:

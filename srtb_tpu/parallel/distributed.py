@@ -1,0 +1,113 @@
+"""Multi-host / multi-slice runtime (the DCN layer).
+
+The reference is strictly single-process, single-device — its only
+"network backend" is the ingest UDP stack (SURVEY.md §5.8).  The TPU
+build adds the distributed communication backend the reference lacks:
+``jax.distributed`` process groups (one process per host), XLA
+collectives riding ICI within a slice and DCN across slices.
+
+Topology policy: the ``dm`` (DM-trial) axis is embarrassingly parallel —
+one spectrum broadcast, then zero inter-trial traffic — so it is the axis
+laid across **DCN** slices, while the communication-heavy ``seq`` axis
+(all_to_all / ppermute inside the distributed four-step FFT,
+parallel/dist_fft.py) stays **inside** a slice on ICI.
+``hybrid_dm_seq_mesh`` encodes exactly that placement.
+
+Verified by a real two-process CPU ring in tests/test_distributed.py
+(the CI analog of a DCN pod: cross-process Gloo collectives).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from srtb_tpu.utils.logging import log
+
+
+def initialize(coordinator_address: str, num_processes: int,
+               process_id: int, local_device_ids=None) -> None:
+    """Join (or create) the multi-host process group.
+
+    Call once per host before any jax computation, exactly like
+    ``jax.distributed.initialize`` — this thin wrapper exists so the CLI
+    (``--distributed_coordinator host:port --distributed_num_processes N
+    --distributed_process_id i``) and library users share one entry point
+    with logging.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+    log.info(f"[distributed] process {process_id}/{num_processes} joined "
+             f"via {coordinator_address}: {len(jax.devices())} global / "
+             f"{len(jax.local_devices())} local devices")
+
+
+def maybe_initialize_from_config(cfg) -> bool:
+    """Initialize the process group if the config asks for it.  Returns
+    True when running multi-process."""
+    if cfg.distributed_num_processes <= 1:
+        return False
+    if not cfg.distributed_coordinator:
+        raise ValueError("distributed_num_processes > 1 needs "
+                         "distributed_coordinator host:port")
+    initialize(cfg.distributed_coordinator, cfg.distributed_num_processes,
+               cfg.distributed_process_id)
+    return True
+
+
+def _slice_index(device) -> int:
+    # TPU devices carry slice_index on multi-slice (DCN) deployments;
+    # hosts' CPU devices and single-slice TPUs default to one slice
+    return getattr(device, "slice_index", 0) or 0
+
+
+def hybrid_dm_seq_mesh(n_seq: int | None = None, devices=None) -> Mesh:
+    """("dm", "seq") mesh with dm laid across slices/hosts (DCN) and seq
+    contiguous within a slice (ICI).
+
+    ``n_seq`` defaults to the per-slice device count (pure DM parallelism
+    across slices); it must divide the devices of every slice.
+    """
+    if devices is None:
+        devices = jax.devices()
+    slices: dict[int, list] = {}
+    for d in devices:
+        slices.setdefault(_slice_index(d), []).append(d)
+    counts = {len(v) for v in slices.values()}
+    if len(counts) != 1:
+        raise ValueError(f"uneven slices: { {k: len(v) for k, v in slices.items()} }")
+    per_slice = counts.pop()
+    if n_seq is None:
+        n_seq = per_slice
+    if per_slice % n_seq:
+        raise ValueError(f"n_seq={n_seq} does not divide the "
+                         f"{per_slice} devices per slice")
+    # rows = dm shards: (slice, intra-slice block); cols = seq shard.
+    # Within a row all seq neighbours share a slice -> seq collectives
+    # never cross DCN.
+    rows = []
+    for k in sorted(slices):
+        devs = slices[k]
+        for b in range(per_slice // n_seq):
+            rows.append(devs[b * n_seq:(b + 1) * n_seq])
+    mesh = Mesh(np.asarray(rows), ("dm", "seq"))
+    log.debug(f"[distributed] hybrid mesh dm={len(rows)} seq={n_seq} "
+              f"over {len(slices)} slice(s)")
+    return mesh
+
+
+def process_local_dm_indices(mesh: Mesh, n_trials: int) -> list[int]:
+    """Which DM-trial indices have a shard on this process — lets each
+    host report/write only its own trials' results."""
+    n_dm = mesh.devices.shape[0]
+    local = set()
+    me = jax.process_index()
+    for i, row in enumerate(mesh.devices):
+        if any(d.process_index == me for d in row):
+            for t in range(i, n_trials, n_dm):
+                local.add(t)
+    return sorted(local)

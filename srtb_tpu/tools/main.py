@@ -24,6 +24,10 @@ from srtb_tpu.utils.termination import install_termination_handler
 def main(argv=None) -> int:
     install_termination_handler()
     cfg = Config.from_args(argv)
+    if cfg.distributed_num_processes > 1:
+        from srtb_tpu.parallel.distributed import (
+            maybe_initialize_from_config)
+        maybe_initialize_from_config(cfg)
     if cfg.fft_fftw_wisdom_path != "off":
         from srtb_tpu.utils.compile_cache import enable_compile_cache
         enable_compile_cache(cfg.fft_fftw_wisdom_path)

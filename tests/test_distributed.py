@@ -1,0 +1,142 @@
+"""Multi-host (DCN-analog) tests.
+
+The reference has no distributed layer to test; this validates the one
+the TPU build adds.  Strategy (SURVEY.md §4 implication): a real
+two-process ``jax.distributed`` group on CPU — cross-process Gloo
+collectives standing in for DCN, intra-process virtual devices standing
+in for ICI — plus single-process checks of the hybrid mesh layout.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import numpy as np
+import pytest
+
+from srtb_tpu.parallel import distributed as D
+
+
+def test_hybrid_mesh_single_slice_layout():
+    # 8 virtual CPU devices, no slice_index -> one slice; n_seq=2 must
+    # give a 4x2 ("dm","seq") mesh with seq-contiguous rows
+    mesh = D.hybrid_dm_seq_mesh(n_seq=2)
+    assert mesh.axis_names == ("dm", "seq")
+    assert mesh.devices.shape == (4, 2)
+    flat = [d.id for d in mesh.devices.reshape(-1)]
+    assert flat == sorted(flat)  # contiguous blocks per dm row
+
+
+def test_hybrid_mesh_multi_slice_dm_across_dcn():
+    # fake two slices by wrapping devices; dm rows must never mix slices
+    class FakeDev:
+        def __init__(self, d, s):
+            self._d, self.slice_index, self.id = d, s, d.id
+
+    devs = jax.devices()
+    fake = [FakeDev(d, s) for s, half in
+            enumerate((devs[:4], devs[4:])) for d in half]
+    mesh_devices = D.hybrid_dm_seq_mesh(n_seq=2, devices=fake).devices
+    assert mesh_devices.shape == (4, 2)
+    for row in mesh_devices:
+        assert len({d.slice_index for d in row}) == 1  # seq stays on ICI
+    # dm axis spans both slices
+    assert {row[0].slice_index for row in mesh_devices} == {0, 1}
+
+
+def test_hybrid_mesh_rejects_bad_seq():
+    with pytest.raises(ValueError):
+        D.hybrid_dm_seq_mesh(n_seq=3)  # 3 does not divide 8
+
+
+_WORKER = textwrap.dedent("""
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    from srtb_tpu.parallel import distributed as D
+    D.initialize(f"127.0.0.1:{port}", nproc, pid)
+    assert jax.process_count() == nproc
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = D.hybrid_dm_seq_mesh(n_seq=2)   # 2 procs x 2 devs -> dm=2,seq=2
+    assert mesh.devices.shape == (2, 2)
+    # seq rows must stay within one process (the "slice"/ICI domain)
+    for row in mesh.devices:
+        assert len({d.process_index for d in row}) == 1
+
+    # cross-process collective over the full mesh: global psum of a
+    # (dm, seq)-sharded array
+    f = jax.jit(jax.shard_map(
+        lambda x: jax.lax.psum(jax.lax.psum(x, "seq"), "dm"),
+        mesh=mesh, in_specs=P("dm", "seq"), out_specs=P()))
+    n_dm, n_seq = mesh.devices.shape
+    global_shape = (n_dm * 2, n_seq * 3)
+    sharding = NamedSharding(mesh, P("dm", "seq"))
+
+    def shard_value(index):
+        # value = global row-major index, so the expected sum is exact
+        full = np.arange(np.prod(global_shape), dtype=np.float32)
+        return full.reshape(global_shape)[index]
+
+    arr = jax.make_array_from_callback(global_shape, sharding, shard_value)
+    out = np.asarray(jax.device_get(f(arr)))
+    expected = np.arange(np.prod(global_shape), dtype=np.float32).sum()
+    assert out.reshape(-1).sum() == expected, (out, expected)
+
+    local = D.process_local_dm_indices(mesh, n_trials=4)
+    assert local == [pid, pid + 2], local
+
+    # the sequence-parallel four-step FFT across the process (DCN)
+    # boundary: 4-device seq mesh spanning both processes
+    from srtb_tpu.parallel import mesh as M
+    from srtb_tpu.parallel.dist_fft import dist_fft
+    seq_mesh = M.seq_mesh(4)
+    n = 1 << 10
+    rng = np.random.default_rng(7)
+    host_x = (rng.normal(size=n) + 1j * rng.normal(size=n)
+              ).astype(np.complex64)
+    seq_sharding = NamedSharding(seq_mesh, P("seq"))
+    x = jax.make_array_from_callback(
+        (n,), seq_sharding, lambda idx: host_x[idx])
+    y = dist_fft(x, seq_mesh)
+    expected = np.fft.fft(host_x).astype(np.complex64)
+    for shard in y.addressable_shards:
+        got = np.asarray(shard.data)
+        want = expected[shard.index]
+        assert np.allclose(got, want, rtol=2e-3, atol=2e-2 * n ** 0.5), \
+            np.abs(got - want).max()
+    print(f"WORKER_OK pid={pid}", flush=True)
+""")
+
+
+def test_two_process_group_collectives(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["JAX_PLATFORMS"] = "cpu"
+    # keep the axon sitecustomize (which dials a TPU relay at import) out
+    # of the subprocesses; they must be plain CPU jax
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    port = 12000 + (os.getpid() % 1000)
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(pid), "2", str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"WORKER_OK pid={pid}" in out
