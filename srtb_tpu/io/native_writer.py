@@ -75,6 +75,7 @@ class AsyncWriterPool:
         self._lock = threading.Lock()
         self._space = threading.Condition(self._lock)
         self._queued_bytes = 0
+        self._errors_raised = 0
         self._py_errors = 0
         self._py_jobs = 0
         self._py_bytes = 0
@@ -170,6 +171,17 @@ class AsyncWriterPool:
             futures, self._futures = self._futures, []
         for fut in futures:
             fut.result()
+
+    def raise_new_errors(self, context: str) -> None:
+        """Raise if writes failed since the last call.  The counter is
+        pool-wide: with several sinks sharing one pool, whichever drains
+        first reports the failure (with its own context string)."""
+        errors = self.stats()["errors"]
+        new_errors = errors - self._errors_raised
+        self._errors_raised = errors
+        if new_errors:
+            raise RuntimeError(
+                f"{new_errors} async write(s) failed ({context})")
 
     def stats(self) -> dict:
         if self._h is not None:

@@ -62,7 +62,6 @@ class WriteSignalSink:
         self.fdatasync = fdatasync
         self.pool = writer_pool
         self._assigned_paths: set[str] = set()
-        self._errors_seen = 0
         self.recent_positive_timestamps: deque[int] = deque()
         self.recent_negative_works: deque[SegmentResultWork] = deque()
         self.written: list[CandidateFiles] = []
@@ -205,13 +204,8 @@ class WriteSignalSink:
         if self.pool is not None:
             self.pool.drain()
             self._assigned_paths.clear()
-            errors = self.pool.stats()["errors"]
-            new_errors = errors - self._errors_seen
-            self._errors_seen = errors
-            if new_errors:
-                raise RuntimeError(
-                    f"{new_errors} async candidate write(s) failed "
-                    f"(prefix {self.cfg.baseband_output_file_prefix})")
+            self.pool.raise_new_errors(
+                f"candidate prefix {self.cfg.baseband_output_file_prefix}")
 
 
 class WriteAllSink:
@@ -231,7 +225,6 @@ class WriteAllSink:
                 + f"stream{data_stream_id}.bin")
         self.path = path
         self.pool = writer_pool
-        self._errors_seen = 0
         if writer_pool is not None and writer_pool.n_threads != 1:
             raise ValueError("WriteAllSink needs a 1-thread pool "
                              "(ordered appends)")
@@ -252,12 +245,7 @@ class WriteAllSink:
     def drain(self) -> None:
         if self.pool is not None:
             self.pool.drain()
-            errors = self.pool.stats()["errors"]
-            new_errors = errors - self._errors_seen
-            self._errors_seen = errors
-            if new_errors:
-                raise RuntimeError(
-                    f"{new_errors} async append(s) to {self.path} failed")
+            self.pool.raise_new_errors(f"append to {self.path}")
 
     def close(self):
         if self._f is not None:

@@ -60,9 +60,15 @@ def maybe_initialize_from_config(cfg) -> bool:
 
 
 def _slice_index(device) -> int:
-    # TPU devices carry slice_index on multi-slice (DCN) deployments;
-    # hosts' CPU devices and single-slice TPUs default to one slice
-    return getattr(device, "slice_index", 0) or 0
+    # TPU devices carry slice_index on multi-slice (DCN) deployments.
+    # Devices without it (CPU/GPU process groups, single-slice TPU) fall
+    # back to the owning process: cross-process traffic is the DCN-cost
+    # domain there, so "slice" = process keeps the seq axis on the cheap
+    # side of the boundary
+    s = getattr(device, "slice_index", None)
+    if s is None:
+        return device.process_index
+    return s
 
 
 def hybrid_dm_seq_mesh(n_seq: int | None = None, devices=None) -> Mesh:
@@ -102,12 +108,19 @@ def hybrid_dm_seq_mesh(n_seq: int | None = None, devices=None) -> Mesh:
 
 def process_local_dm_indices(mesh: Mesh, n_trials: int) -> list[int]:
     """Which DM-trial indices have a shard on this process — lets each
-    host report/write only its own trials' results."""
+    host report/write only its own trials' results.
+
+    Layout matches the trial sharding (NamedSharding ``P("dm", ...)`` of
+    the chirp bank / time series): contiguous blocks of
+    ``n_trials // n_dm`` trials per dm row.
+    """
     n_dm = mesh.devices.shape[0]
+    if n_trials % n_dm:
+        raise ValueError(f"n_trials={n_trials} must divide by dm={n_dm}")
+    per_row = n_trials // n_dm
     local = set()
     me = jax.process_index()
     for i, row in enumerate(mesh.devices):
         if any(d.process_index == me for d in row):
-            for t in range(i, n_trials, n_dm):
-                local.add(t)
+            local.update(range(i * per_row, (i + 1) * per_row))
     return sorted(local)

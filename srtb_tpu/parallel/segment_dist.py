@@ -37,10 +37,18 @@ from srtb_tpu.parallel import dm_grid
 
 
 class DistSegmentResult(NamedTuple):
-    zero_count: jnp.ndarray      # [n_dm, S]
-    signal_counts: jnp.ndarray   # [n_dm, S, n_boxcars]
-    snr_peaks: jnp.ndarray       # [n_dm, S, n_boxcars]
-    time_series: jnp.ndarray     # [n_dm, S, T]
+    zero_count: jnp.ndarray      # [n_dm, S]           (replicated)
+    signal_counts: jnp.ndarray   # [n_dm, S, n_boxcars] (replicated)
+    snr_peaks: jnp.ndarray       # [n_dm, S, n_boxcars] (replicated)
+    time_series: jnp.ndarray     # [n_dm, S, T]         (dm-sharded)
+
+
+def _put_sharded(host_array: np.ndarray, sharding: NamedSharding):
+    """Host array -> sharded jax.Array; works in multi-controller runs
+    (every process supplies its local shards by slicing the same host
+    data), unlike a plain ``jax.device_put``."""
+    return jax.make_array_from_callback(
+        host_array.shape, sharding, lambda idx: host_array[idx])
 
 
 class DistSegmentProcessor:
@@ -69,7 +77,7 @@ class DistSegmentProcessor:
 
         f_min, f_c, df = dd.spectrum_frequencies(cfg, self.n_spectrum)
         # [n_dm, 2, n_spec] (re, im) sharded over (dm, -, seq)
-        self.chirp_bank = jax.device_put(
+        self.chirp_bank = _put_sharded(
             np.asarray(dm_grid.build_chirp_bank(
                 self.dm_list, self.n_spectrum, f_min, df, f_c)),
             NamedSharding(mesh, P("dm", None, "seq")))
@@ -79,8 +87,7 @@ class DistSegmentProcessor:
             cfg.baseband_freq_low, cfg.baseband_bandwidth)
         if mask is None:
             mask = np.zeros(self.n_spectrum, dtype=bool)
-        self.rfi_mask = jax.device_put(
-            mask, NamedSharding(mesh, P("seq")))
+        self.rfi_mask = _put_sharded(mask, NamedSharding(mesh, P("seq")))
 
         self.norm_coeff = rfi.normalization_coefficient(
             self.n_spectrum, self.channel_count)
@@ -91,7 +98,7 @@ class DistSegmentProcessor:
             self._body,
             variant=self.fmt.unpack_variant,
             nbits=cfg.baseband_input_bits,
-            n=self.n, n_seq=self.n_seq,
+            n=self.n, n_seq=self.n_seq, n_dm_dev=self.n_dm_devices,
             n_spectrum=self.n_spectrum,
             channel_count=self.channel_count,
             norm_coeff=self.norm_coeff,
@@ -101,18 +108,21 @@ class DistSegmentProcessor:
             snr_threshold=cfg.signal_detect_signal_noise_threshold,
             max_boxcar_length=cfg.signal_detect_max_boxcar_length,
         )
+        # trial summaries leave the step replicated (all_gather over dm in
+        # the body) so every controller process can read them; the bulky
+        # time series stays dm-sharded
         self._step = jax.jit(shard_map(
             body, mesh=mesh,
             in_specs=(P("seq"), P("dm", None, "seq"), P("seq")),
-            out_specs=(P("dm"), P("dm"), P("dm"), P("dm"))))
+            out_specs=(P(), P(), P(), P("dm"))))
 
     # ------------------------------------------------------------------
 
     @staticmethod
     def _body(raw_block, chirp_block, mask_block, *, variant, nbits, n,
-              n_seq, n_spectrum, channel_count, norm_coeff, avg_threshold,
-              sk_threshold, time_reserved_count, snr_threshold,
-              max_boxcar_length):
+              n_seq, n_dm_dev, n_spectrum, channel_count, norm_coeff,
+              avg_threshold, sk_threshold, time_reserved_count,
+              snr_threshold, max_boxcar_length):
         from srtb_tpu.pipeline.segment import unpack_streams
 
         # ---- unpack (local; interleave patterns repeat within shards) ----
@@ -176,13 +186,29 @@ class DistSegmentProcessor:
             return (zero_count, jnp.stack(counts, axis=-1),
                     jnp.stack(peaks, axis=-1), ts)
 
-        return jax.vmap(one_trial)(chirp_block)
+        zc, counts, peaks, ts = jax.vmap(one_trial)(chirp_block)
+
+        # replicate the small per-trial summaries across the dm axis
+        # (multi-host: every controller must be able to materialize them).
+        # scatter-into-zeros + psum is replication the VMA checker can
+        # prove invariant, unlike all_gather
+        dm_idx = jax.lax.axis_index("dm")
+        trials_local = chirp_block.shape[0]
+
+        def replicate_trials(x):
+            full = jnp.zeros((trials_local * n_dm_dev,) + x.shape[1:],
+                             x.dtype)
+            full = jax.lax.dynamic_update_slice_in_dim(
+                full, x, dm_idx * trials_local, axis=0)
+            return jax.lax.psum(full, "dm")
+
+        return (replicate_trials(zc), replicate_trials(counts),
+                replicate_trials(peaks), ts)
 
     # ------------------------------------------------------------------
 
     def process(self, raw) -> DistSegmentResult:
-        raw = jax.device_put(
-            jnp.asarray(raw, dtype=jnp.uint8),
-            NamedSharding(self.mesh, P("seq")))
+        raw = _put_sharded(np.asarray(raw, dtype=np.uint8),
+                           NamedSharding(self.mesh, P("seq")))
         out = self._step(raw, self.chirp_bank, self.rfi_mask)
         return DistSegmentResult(*out)

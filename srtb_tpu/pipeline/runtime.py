@@ -13,6 +13,7 @@ framework is this small host loop with work accounting
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 
@@ -213,7 +214,11 @@ class DMSearchPipeline:
 
         cfg = self.cfg
         start = time.perf_counter()
-        with open(self.trials_path, "a") as trials_file:
+        # multi-controller runs: summaries are replicated, so only the
+        # first process records them (all write identical content)
+        write_records = jax.process_index() == 0
+        with open(self.trials_path if write_records else os.devnull,
+                  "a") as trials_file:
             for i, seg in enumerate(self.source):
                 if max_segments is not None and i >= max_segments:
                     break

@@ -88,8 +88,34 @@ _WORKER = textwrap.dedent("""
     expected = np.arange(np.prod(global_shape), dtype=np.float32).sum()
     assert out.reshape(-1).sum() == expected, (out, expected)
 
+    # contiguous-block trial ownership, matching P("dm") sharding: with
+    # dm=2 rows and 4 trials, row pid owns trials [2*pid, 2*pid+1]
     local = D.process_local_dm_indices(mesh, n_trials=4)
-    assert local == [pid, pid + 2], local
+    assert local == [2 * pid, 2 * pid + 1], local
+
+    # full multi-host segment step: DM trials across the process (DCN)
+    # boundary, sequence sharding within each process (ICI)
+    from srtb_tpu.config import Config
+    from srtb_tpu.parallel.segment_dist import DistSegmentProcessor
+    cfg = Config(
+        baseband_input_count=1 << 14, baseband_input_bits=8,
+        baseband_format_type="simple", baseband_freq_low=1405.0,
+        baseband_bandwidth=64.0, baseband_sample_rate=128e6, dm=30.0,
+        spectrum_channel_count=1 << 6, signal_detect_max_boxcar_length=32,
+        mitigate_rfi_average_method_threshold=100.0,
+        mitigate_rfi_spectral_kurtosis_threshold=2.0,
+        baseband_reserve_sample=False)
+    proc = DistSegmentProcessor(cfg, mesh, dm_list=[0.0, 15.0, 30.0, 60.0])
+    raw = np.random.default_rng(3).integers(
+        0, 256, size=cfg.segment_bytes(1), dtype=np.uint8)
+    res = proc.process(raw)
+    peaks = np.asarray(res.snr_peaks)     # replicated -> readable anywhere
+    counts = np.asarray(res.signal_counts)
+    assert peaks.shape[0] == 4 and np.isfinite(peaks).all()
+    import hashlib
+    digest = hashlib.sha256(
+        peaks.tobytes() + counts.tobytes()).hexdigest()[:16]
+    print(f"WORKER_DIGEST {digest}", flush=True)
 
     # the sequence-parallel four-step FFT across the process (DCN)
     # boundary: 4-device seq mesh spanning both processes
@@ -124,7 +150,10 @@ def test_two_process_group_collectives(tmp_path):
     # of the subprocesses; they must be plain CPU jax
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))
-    port = 12000 + (os.getpid() % 1000)
+    import socket
+    with socket.socket() as s:  # let the OS pick a free port
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
     procs = [subprocess.Popen(
         [sys.executable, str(script), str(pid), "2", str(port)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
@@ -140,3 +169,7 @@ def test_two_process_group_collectives(tmp_path):
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         assert f"WORKER_OK pid={pid}" in out
+    # the replicated trial summaries must be identical on every host
+    digests = {line.split()[1] for out in outs for line in out.splitlines()
+               if line.startswith("WORKER_DIGEST")}
+    assert len(digests) == 1, digests

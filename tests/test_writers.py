@@ -119,6 +119,22 @@ def test_pool_error_accounting(pool, tmp_path):
                 np.zeros(4, dtype=np.uint8))
     pool.drain()
     assert pool.stats()["errors"] == 1
+    with pytest.raises(RuntimeError, match="1 async write"):
+        pool.raise_new_errors("test")
+    pool.raise_new_errors("test")  # already reported: no raise
+
+
+def test_signal_sink_drain_raises_on_failed_write(tmp_path):
+    cfg = _mk_cfg(tmp_path, "errs")
+    with AsyncWriterPool(n_threads=1) as pool:
+        sink = WriteSignalSink(cfg, fdatasync=False, writer_pool=pool)
+        sink.push(_mk_work(), has_signal=True)
+        sink.drain()  # fine
+        import shutil
+        shutil.rmtree(os.path.dirname(cfg.baseband_output_file_prefix))
+        sink.push(_mk_work(counter=99), has_signal=True)
+        with pytest.raises(RuntimeError, match="async write"):
+            sink.drain()
 
 
 # ----------------------------------------------------------------------
