@@ -17,14 +17,18 @@ import numpy as np
 
 
 def main():
+    import os
+
     import jax
 
     from srtb_tpu.config import Config
     from srtb_tpu.pipeline.segment import SegmentProcessor
 
     # J1644-4559 parameters (ref: srtb_config_1644-4559.cfg) at a segment
-    # size that exercises the large-FFT path while fitting one chip
-    n = 1 << 27
+    # size that exercises the large-FFT path while fitting one chip.
+    # SRTB_BENCH_* env knobs allow A/B runs of specific code paths
+    # without changing the headline default.
+    n = 1 << int(os.environ.get("SRTB_BENCH_LOG2N", "27"))
     cfg = Config(
         baseband_input_count=n,
         baseband_input_bits=2,
@@ -40,6 +44,8 @@ def main():
         signal_detect_max_boxcar_length=256,
         mitigate_rfi_freq_list="1418-1422",
         baseband_reserve_sample=False,
+        fft_strategy=os.environ.get("SRTB_BENCH_FFT_STRATEGY", "auto"),
+        use_pallas=bool(int(os.environ.get("SRTB_BENCH_USE_PALLAS", "0"))),
     )
     proc = SegmentProcessor(cfg)
 

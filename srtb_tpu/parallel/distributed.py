@@ -60,15 +60,17 @@ def maybe_initialize_from_config(cfg) -> bool:
 
 
 def _slice_index(device) -> int:
-    # TPU devices carry slice_index on multi-slice (DCN) deployments.
-    # Devices without it (CPU/GPU process groups, single-slice TPU) fall
-    # back to the owning process: cross-process traffic is the DCN-cost
-    # domain there, so "slice" = process keeps the seq axis on the cheap
-    # side of the boundary
+    # TPU devices carry slice_index on multi-slice (DCN) deployments; a
+    # TPU without it is a single slice — ICI spans all its hosts, so the
+    # whole pod is one cheap-communication domain.  On CPU/GPU process
+    # groups the cross-process boundary is the DCN-cost domain, so there
+    # "slice" = owning process.
     s = getattr(device, "slice_index", None)
-    if s is None:
-        return device.process_index
-    return s
+    if s is not None:
+        return s
+    if device.platform == "tpu":
+        return 0
+    return device.process_index
 
 
 def hybrid_dm_seq_mesh(n_seq: int | None = None, devices=None) -> Mesh:

@@ -1,0 +1,43 @@
+"""bench.py is the driver's scoreboard: it must always emit one valid
+JSON line, whatever backend it lands on.  Run it tiny on CPU."""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def test_bench_emits_one_json_line(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    env["SRTB_BENCH_LOG2N"] = "16"
+    out = subprocess.run(
+        [sys.executable, os.path.join(env["PYTHONPATH"], "bench.py")],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert len(lines) == 1, out.stdout
+    rec = json.loads(lines[0])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert rec["value"] > 0 and rec["vs_baseline"] > 0
+
+
+def test_bench_knob_variants(tmp_path):
+    # the A/B knobs must not break the script (four_step + pallas path)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    env["SRTB_BENCH_LOG2N"] = "16"
+    env["SRTB_BENCH_FFT_STRATEGY"] = "four_step"
+    env["SRTB_BENCH_USE_PALLAS"] = "1"
+    out = subprocess.run(
+        [sys.executable, os.path.join(env["PYTHONPATH"], "bench.py")],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads([ln for ln in out.stdout.strip().splitlines()
+                      if ln.startswith("{")][0])
+    assert rec["value"] > 0
