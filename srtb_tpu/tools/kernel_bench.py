@@ -1,0 +1,151 @@
+"""Per-kernel micro-benchmarks.
+
+The reference publishes exactly one set of kernel timings: its GUI
+resample kernel (``resample_spectrum_3``, one work-group per output
+pixel) at wg=64 takes ~16.6 ms on an AMD Radeon VII and ~59.9 ms on an
+NVIDIA RTX A4000 (ref: spectrum/simplify_spectrum.hpp:449-455).  This
+tool times the srtb_tpu equivalents — the resample-as-two-matmuls MXU
+formulation plus the other hot kernels — with the same methodology as
+bench.py (compile once, min over repeats, block_until_ready).
+
+Usage:
+    python -m srtb_tpu.tools.kernel_bench [--log2n 28] [--reps 5]
+
+Prints one JSON line per kernel:
+    {"kernel": ..., "ms": ..., "shape": ..., "gsamples_per_s": ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _time(fn, *args, reps=5):
+    import jax
+    out = fn(*args)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--log2n", type=int, default=28,
+                   help="segment size driving the kernel shapes")
+    p.add_argument("--reps", type=int, default=5)
+    p.add_argument("--pixmap", type=str, default="1080x1920",
+                   help="resample output HxW (reference GUI default)")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from srtb_tpu.ops import dedisperse as dd
+    from srtb_tpu.ops import detect as det
+    from srtb_tpu.ops import rfi
+    from srtb_tpu.ops import spectrum as sp
+    from srtb_tpu.ops import unpack as U
+
+    n = 1 << args.log2n
+    n_spec = n // 2
+    nchan = 1 << 11                      # J1644 config: 2**11 channels
+    wlen = n_spec // nchan
+    out_h, out_w = (int(x) for x in args.pixmap.split("x"))
+    reps = args.reps
+    rng = np.random.default_rng(0)
+    results = []
+
+    def record(kernel, seconds, shape, samples):
+        rec = {"kernel": kernel, "ms": round(seconds * 1e3, 3),
+               "shape": shape,
+               "gsamples_per_s": round(samples / seconds / 1e9, 2)}
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    # ---- resample + normalize + colormap (the published-numbers kernel)
+    power = jax.device_put(
+        rng.random((nchan, wlen), dtype=np.float32))
+    w_freq = jax.device_put(sp.freq_area_weights(nchan, out_h))
+    w_time = jax.device_put(sp.time_interp_weights(wlen, out_w))
+
+    @jax.jit
+    def resample_only(pw, wf, wt):
+        return sp.resample_spectrum(pw, wf, wt)
+
+    dt = _time(resample_only, power, w_freq, w_time, reps=reps)
+    record("resample_spectrum (2 matmuls, MXU)", dt,
+           f"[{nchan},{wlen}]->[{out_h},{out_w}]", nchan * wlen)
+
+    @jax.jit
+    def resample_full(pw, wf, wt):
+        img = sp.resample_spectrum(pw, wf, wt)
+        img = sp.normalize_by_average(img)
+        return sp.generate_pixmap(img)
+
+    dt = _time(resample_full, power, w_freq, w_time, reps=reps)
+    record("resample+normalize+colormap", dt,
+           f"[{nchan},{wlen}]->[{out_h},{out_w}]", nchan * wlen)
+
+    # ---- 2-bit unpack + window ----
+    raw = jax.device_put(rng.integers(0, 256, n // 4, dtype=np.uint8))
+    win = jax.device_put(np.hamming(n).astype(np.float32))
+    unpack2 = jax.jit(lambda b, w: U.unpack(b, 2, w))
+    dt = _time(unpack2, raw, win, reps=reps)
+    record("unpack 2-bit + window", dt, f"[{n // 4}]u8->[{n}]f32", n)
+
+    # ---- chirp multiply (precomputed bank) ----
+    spec_c = jax.device_put(
+        (rng.standard_normal(n_spec, dtype=np.float32)
+         + 1j * rng.standard_normal(n_spec, dtype=np.float32)
+         ).astype(np.complex64))
+    f_min, f_c, df = 1405.0, 1437.0, 64.0 / n_spec
+    chirp = jnp.asarray(dd.chirp_factor_host_ri(n_spec, f_min, df, f_c,
+                                                -478.80))
+    mul = jax.jit(lambda s, c: dd.dedisperse(
+        s[None], jax.lax.complex(c[0], c[1]))[0])
+    dt = _time(mul, spec_c, chirp, reps=reps)
+    record("chirp multiply (HBM bank)", dt, f"[{n_spec}]c64", n_spec)
+
+    # ---- df64 on-the-fly chirp (Pallas, TPU only) ----
+    if jax.default_backend() not in ("cpu",):
+        from srtb_tpu.ops import pallas_kernels as pk
+        spec_ri = jnp.stack([jnp.real(spec_c), jnp.imag(spec_c)])
+        pallas_mul = jax.jit(lambda s: pk.dedisperse_df64(
+            s, f_min, df, f_c, -478.80))
+        try:
+            dt = _time(pallas_mul, spec_ri, reps=reps)
+            record("chirp multiply (Pallas df64 in-kernel)", dt,
+                   f"[{n_spec}]c64", n_spec)
+        except Exception as e:  # pragma: no cover
+            print(json.dumps({"kernel": "pallas df64", "error": str(e)}))
+
+    # ---- spectral kurtosis on the waterfall ----
+    wf_c = jax.device_put(
+        (rng.standard_normal((nchan, wlen), dtype=np.float32)
+         + 1j * rng.standard_normal((nchan, wlen), dtype=np.float32)
+         ).astype(np.complex64))
+    sk = jax.jit(lambda w: rfi.mitigate_rfi_spectral_kurtosis(w[None], 1.05)[0])
+    dt = _time(sk, wf_c, reps=reps)
+    record("spectral kurtosis zap", dt, f"[{nchan},{wlen}]c64", n_spec)
+
+    # ---- detection chain (time series + boxcar ladder) ----
+    detect = jax.jit(lambda w: det.detect(w[None], 0, 8.0, 256))
+    dt = _time(detect, wf_c, reps=reps)
+    record("detect (ts + boxcar ladder 256)", dt, f"[{nchan},{wlen}]c64",
+           n_spec)
+
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -25,6 +25,20 @@ def test_bench_emits_one_json_line(tmp_path):
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
 
 
+def test_kernel_bench_runs():
+    from srtb_tpu.tools import kernel_bench
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = kernel_bench.main(["--log2n", "16", "--reps", "1",
+                                "--pixmap", "64x128"])
+    assert rc == 0
+    lines = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+    assert len(lines) >= 5
+    assert all(rec["ms"] > 0 for rec in lines if "ms" in rec)
+
+
 def test_bench_knob_variants(tmp_path):
     # the A/B knobs must not break the script (four_step + pallas path)
     env = dict(os.environ)
