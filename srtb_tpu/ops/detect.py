@@ -64,14 +64,19 @@ def count_signal(x: jnp.ndarray, snr_threshold: float):
     return count, peak_snr
 
 
+def trimmed_length(time_samples: int, time_reserved_count: int) -> int:
+    """Usable time samples after dropping the reserved (dedispersion-
+    corrupted) tail; keeps everything when the segment is too short
+    (ref: signal_detect_pipe.hpp:291-296 warns and keeps all)."""
+    if time_samples <= time_reserved_count:
+        return time_samples
+    return time_samples - time_reserved_count
+
+
 def detect(waterfall: jnp.ndarray, time_reserved_count: int,
            snr_threshold: float, max_boxcar_length: int) -> DetectResult:
     """Full detection chain on a frequency-major dynamic spectrum."""
-    freq_bins, time_samples = waterfall.shape[-2], waterfall.shape[-1]
-    if time_samples <= time_reserved_count:
-        t = time_samples  # ref: signal_detect_pipe.hpp:291-296 warns, keeps all
-    else:
-        t = time_samples - time_reserved_count
+    t = trimmed_length(waterfall.shape[-1], time_reserved_count)
 
     # zapped channels: first time sample exactly zero (ref: 262-284)
     zero_count = jnp.sum(
@@ -79,6 +84,18 @@ def detect(waterfall: jnp.ndarray, time_reserved_count: int,
 
     # time series: sum power over frequency for the first t samples (ref: 305-316)
     ts = jnp.sum(_norm(waterfall[..., :t]), axis=-2)
+    return detect_from_time_series(ts, zero_count, snr_threshold,
+                                   max_boxcar_length)
+
+
+def detect_from_time_series(ts: jnp.ndarray, zero_count: jnp.ndarray,
+                            snr_threshold: float,
+                            max_boxcar_length: int) -> DetectResult:
+    """Boxcar detection ladder from a (not yet mean-subtracted) power time
+    series ``ts [..., t]`` — the tail of :func:`detect`, split out so fused
+    kernels that already produced the time series (Pallas SK+sum pass) can
+    reuse it."""
+    t = ts.shape[-1]
     ts = ts - jnp.mean(ts, axis=-1, keepdims=True)  # ref: 321-334
 
     lengths = boxcar_lengths(max_boxcar_length, t)

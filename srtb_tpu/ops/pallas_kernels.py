@@ -191,6 +191,156 @@ def dedisperse_df64(spec_ri: jnp.ndarray, f_min: float, df: float,
 # fused 2-bit unpack + window
 # ----------------------------------------------------------------
 
+# ----------------------------------------------------------------
+# fused waterfall post-pass: spectral-kurtosis stats, zap, time series
+# ----------------------------------------------------------------
+
+def _sk_stats_kernel(re_ref, im_ref, s2_ref, s4_ref, fs_ref):
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(1)  # inner grid dim: time tiles
+
+    @pl.when(t == 0)
+    def _init():
+        s2_ref[:] = jnp.zeros_like(s2_ref)
+        s4_ref[:] = jnp.zeros_like(s4_ref)
+
+    re = re_ref[:]
+    im = im_ref[:]
+    p = re * re + im * im                      # [R, TB]
+    rows, tb = p.shape
+    # keep 128 lanes through the reduction; final lane-sum happens outside
+    p3 = p.reshape(rows, tb // _LANES, _LANES)
+    s2_ref[:] += jnp.sum(p3, axis=1)           # [R, 128]
+    s4_ref[:] += jnp.sum(p3 * p3, axis=1)
+
+    @pl.when(t == 0)
+    def _first_samples():
+        fs_ref[:] = p[:, :_LANES]              # power of the first lanes
+
+
+def _sk_apply_kernel(re_ref, im_ref, keep_ref, out_re_ref, out_im_ref,
+                     ts_ref):
+    from jax.experimental import pallas as pl
+
+    f = pl.program_id(1)  # inner grid dim: frequency tiles
+    keep = keep_ref[:, 0:1] != 0.0             # [R, 1] row mask
+    # select, not multiply: a zapped row carrying Inf/NaN must become
+    # exactly zero, matching the jnp path's jnp.where
+    re = jnp.where(keep, re_ref[:], 0.0)
+    im = jnp.where(keep, im_ref[:], 0.0)
+    out_re_ref[:] = re
+    out_im_ref[:] = im
+    p = re * re + im * im                      # [R, TB]
+
+    @pl.when(f == 0)
+    def _init():
+        ts_ref[:] = jnp.zeros_like(ts_ref)
+
+    rows, tb = p.shape
+    ts_ref[:] += jnp.sum(p, axis=0).reshape(tb // _LANES, _LANES)
+
+
+def _sk_tiles(nfreq: int, ntime: int):
+    """(rows, time_block) tiling for the fused SK kernels, or None when
+    the waterfall shape cannot tile (single source of truth for both the
+    capability check and the kernels)."""
+    rows = min(8, nfreq)
+    tb = min(512 * _LANES, ntime)
+    if nfreq % rows or ntime % _LANES or ntime % tb or tb % _LANES:
+        return None
+    return rows, tb
+
+
+def sk_tiling_ok(nfreq: int, ntime: int) -> bool:
+    """Whether the fused SK kernels can tile this waterfall (callers fall
+    back to the jnp ops otherwise, e.g. tiny test/bench shapes)."""
+    return _sk_tiles(nfreq, ntime) is not None
+
+
+def sk_zap_timeseries(wf_ri: jnp.ndarray, sk_threshold: float,
+                      interpret: bool = False):
+    """Fused spectral-kurtosis zap + detection front half in two HBM
+    passes over the waterfall ``wf_ri [2, F, T]`` (re, im):
+
+    pass 1 reads the waterfall once, producing per-row ``s2``/``s4``
+    partial sums and first-sample powers; the tiny SK decision
+    (ref: spectrum/rfi_mitigation.hpp:290-341 thresholds) happens in jnp;
+    pass 2 reads the waterfall again, writes the zapped waterfall and
+    accumulates the frequency-summed power time series
+    (ref: signal_detect_pipe.hpp:305-316) in the same read.
+
+    The jnp path costs ~3 reads + 1 write of the waterfall (SK stats,
+    zap rewrite, time-series sum); this costs 2 reads + 1 write, and the
+    time series comes out "for free" with the zap.
+
+    Returns ``(wf_zapped_ri [2, F, T], zero_count [], ts [T])`` with
+    ``zero_count``/``ts`` matching ops.detect semantics (zapped rows and
+    first-sample-zero rows both count; ts is not yet mean-subtracted).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _, nfreq, ntime = wf_ri.shape
+    m = ntime
+    tiles = _sk_tiles(nfreq, ntime)
+    if tiles is None:
+        raise ValueError(f"bad waterfall tiling [{nfreq}, {ntime}]")
+    rows, tb = tiles
+
+    re, im = wf_ri[0], wf_ri[1]
+
+    # ---- pass 1: stats (grid: freq outer, time inner for accumulation)
+    grid1 = (nfreq // rows, ntime // tb)
+    in_block = pl.BlockSpec((rows, tb), lambda f, t: (f, t),
+                            memory_space=pltpu.VMEM)
+    row_block = pl.BlockSpec((rows, _LANES), lambda f, t: (f, 0),
+                             memory_space=pltpu.VMEM)
+    s2, s4, fs = pl.pallas_call(
+        _sk_stats_kernel,
+        grid=grid1,
+        in_specs=[in_block, in_block],
+        out_specs=[row_block, row_block, row_block],
+        out_shape=[jax.ShapeDtypeStruct((nfreq, _LANES), jnp.float32)] * 3,
+        interpret=interpret,
+    )(re, im)
+
+    # ---- tiny per-row decision in jnp, thresholds shared with
+    # rfi.mitigate_rfi_spectral_kurtosis ----
+    from srtb_tpu.ops.rfi import sk_decision_thresholds
+    thr_low_, thr_high_ = sk_decision_thresholds(m, sk_threshold)
+    s2r = jnp.sum(s2, axis=-1)
+    s4r = jnp.sum(s4, axis=-1)
+    sk = m * s4r / (s2r * s2r)
+    zap = (sk > thr_high_) | (sk < thr_low_)
+    zero_count = jnp.sum(
+        (zap | (fs[:, 0] == 0)).astype(jnp.int32))
+    keep = jnp.broadcast_to((~zap).astype(jnp.float32)[:, None],
+                            (nfreq, _LANES))
+
+    # ---- pass 2: zap + time series (grid: time outer, freq inner) ----
+    grid2 = (ntime // tb, nfreq // rows)
+    in_block2 = pl.BlockSpec((rows, tb), lambda t, f: (f, t),
+                             memory_space=pltpu.VMEM)
+    keep_block = pl.BlockSpec((rows, _LANES), lambda t, f: (f, 0),
+                              memory_space=pltpu.VMEM)
+    ts_block = pl.BlockSpec((tb // _LANES, _LANES), lambda t, f: (t, 0),
+                            memory_space=pltpu.VMEM)
+    out_re, out_im, ts2d = pl.pallas_call(
+        _sk_apply_kernel,
+        grid=grid2,
+        in_specs=[in_block2, in_block2, keep_block],
+        out_specs=[in_block2, in_block2, ts_block],
+        out_shape=[jax.ShapeDtypeStruct((nfreq, ntime), jnp.float32),
+                   jax.ShapeDtypeStruct((nfreq, ntime), jnp.float32),
+                   jax.ShapeDtypeStruct((ntime // _LANES, _LANES),
+                                        jnp.float32)],
+        interpret=interpret,
+    )(re, im, keep)
+
+    return (jnp.stack([out_re, out_im]), zero_count, ts2d.reshape(ntime))
+
+
 def _unpack2_kernel(byte_ref, win_ref, out_ref, *, apply_window):
     b = byte_ref[:].astype(jnp.int32)
     # MSB-first 2-bit fields (ref: unpack.hpp:116-119)

@@ -121,6 +121,19 @@ def mitigate_rfi_manual(spectrum: jnp.ndarray,
 # spectral kurtosis (stage 2)
 # ----------------------------------------------------------------
 
+def sk_decision_thresholds(m: int, sk_threshold: float):
+    """(low, high) acceptance bounds for the SK estimator over M samples:
+    the configured threshold symmetrized around 2, rescaled by
+    (M-1)/(M+1) (ref: spectrum/rfi_mitigation.hpp:290-341).  Shared by
+    the jnp op and the fused Pallas kernel so their zap decisions cannot
+    drift apart."""
+    thr_high = max(sk_threshold, 2.0 - sk_threshold)
+    thr_low = min(sk_threshold, 2.0 - sk_threshold)
+    scale = (m - 1.0) / (m + 1.0)
+    return (np.float32(thr_low * scale + 1.0),
+            np.float32(thr_high * scale + 1.0))
+
+
 def mitigate_rfi_spectral_kurtosis(waterfall: jnp.ndarray,
                                    sk_threshold: float) -> jnp.ndarray:
     """Zap frequency rows of the dynamic spectrum whose spectral kurtosis
@@ -131,11 +144,7 @@ def mitigate_rfi_spectral_kurtosis(waterfall: jnp.ndarray,
     per frequency row over the M time samples.
     """
     m = waterfall.shape[-1]
-    thr_high = max(sk_threshold, 2.0 - sk_threshold)
-    thr_low = min(sk_threshold, 2.0 - sk_threshold)
-    scale = (m - 1.0) / (m + 1.0)
-    thr_high_ = np.float32(thr_high * scale + 1.0)
-    thr_low_ = np.float32(thr_low * scale + 1.0)
+    thr_low_, thr_high_ = sk_decision_thresholds(m, sk_threshold)
 
     x2 = _norm(waterfall)
     s2 = jnp.sum(x2, axis=-1)

@@ -112,9 +112,10 @@ class SegmentProcessor:
         cfg = self.cfg
         use_pallas = cfg.use_pallas and self.fmt.data_stream_count == 1
         interp = getattr(self, "_pallas_interpret", False)
+        if use_pallas:
+            from srtb_tpu.ops import pallas_kernels as pk
         if (use_pallas and cfg.baseband_input_bits == 2
                 and self.fmt.unpack_variant == "simple"):
-            from srtb_tpu.ops import pallas_kernels as pk
             x = pk.unpack_2bit_window(raw, self.window,
                                       interpret=interp)[None, :]
         else:
@@ -125,7 +126,6 @@ class SegmentProcessor:
             spec, cfg.mitigate_rfi_average_method_threshold, self.norm_coeff)
         spec = rfi.mitigate_rfi_manual(spec, self.rfi_mask)
         if use_pallas:
-            from srtb_tpu.ops import pallas_kernels as pk
             spec_ri = jnp.stack([jnp.real(spec[0]), jnp.imag(spec[0])])
             out_ri = pk.dedisperse_df64(spec_ri, self.f_min, self.df,
                                         self.f_c, cfg.dm, interpret=interp)
@@ -134,11 +134,23 @@ class SegmentProcessor:
             chirp = jax.lax.complex(chirp_ri[0], chirp_ri[1])
             spec = dd.dedisperse(spec, chirp)
         wf = F.waterfall_c2c(spec, self.channel_count)  # [S, F, T]
-        wf = rfi.mitigate_rfi_spectral_kurtosis(
-            wf, cfg.mitigate_rfi_spectral_kurtosis_threshold)
-        result = det.detect(wf, self.time_reserved_count,
-                            cfg.signal_detect_signal_noise_threshold,
-                            cfg.signal_detect_max_boxcar_length)
+        if use_pallas and pk.sk_tiling_ok(wf.shape[-2], wf.shape[-1]):
+            wf_ri1 = jnp.stack([jnp.real(wf[0]), jnp.imag(wf[0])])
+            wf_ri1, zero_count, ts = pk.sk_zap_timeseries(
+                wf_ri1, cfg.mitigate_rfi_spectral_kurtosis_threshold,
+                interpret=interp)
+            wf = jax.lax.complex(wf_ri1[0], wf_ri1[1])[None]
+            t = det.trimmed_length(wf.shape[-1], self.time_reserved_count)
+            result = det.detect_from_time_series(
+                ts[None, :t], zero_count[None],
+                cfg.signal_detect_signal_noise_threshold,
+                cfg.signal_detect_max_boxcar_length)
+        else:
+            wf = rfi.mitigate_rfi_spectral_kurtosis(
+                wf, cfg.mitigate_rfi_spectral_kurtosis_threshold)
+            result = det.detect(wf, self.time_reserved_count,
+                                cfg.signal_detect_signal_noise_threshold,
+                                cfg.signal_detect_max_boxcar_length)
         # boundary representation: waterfall leaves jit as stacked (re, im)
         wf_ri = jnp.stack([jnp.real(wf), jnp.imag(wf)])  # [2, S, F, T]
         return wf_ri, result
