@@ -341,29 +341,35 @@ def sk_zap_timeseries(wf_ri: jnp.ndarray, sk_threshold: float,
     return (jnp.stack([out_re, out_im]), zero_count, ts2d.reshape(ntime))
 
 
-def _unpack2_kernel(byte_ref, win_ref, out_ref, *, apply_window):
+def _unpack_subbyte_kernel(byte_ref, win_ref, out_ref, *, nbits,
+                           apply_window):
     b = byte_ref[:].astype(jnp.int32)
-    # MSB-first 2-bit fields (ref: unpack.hpp:116-119)
-    f0 = ((b >> 6) & 3).astype(jnp.float32)
-    f1 = ((b >> 4) & 3).astype(jnp.float32)
-    f2 = ((b >> 2) & 3).astype(jnp.float32)
-    f3 = (b & 3).astype(jnp.float32)
-    # interleave along lanes: [R, C] x4 -> [R, 4C]
-    out = jnp.stack([f0, f1, f2, f3], axis=-1).reshape(
-        b.shape[0], 4 * b.shape[1])
+    per_byte = 8 // nbits
+    mask = (1 << nbits) - 1
+    # MSB-first fields (ref: unpack.hpp:43-140 generic + handwritten
+    # 1/2/4-bit kernels share this bit order)
+    fields = [((b >> (8 - nbits * (j + 1))) & mask).astype(jnp.float32)
+              for j in range(per_byte)]
+    # interleave along lanes: [R, C] x per_byte -> [R, per_byte*C]
+    out = jnp.stack(fields, axis=-1).reshape(
+        b.shape[0], per_byte * b.shape[1])
     if apply_window:
         out = out * win_ref[:]
     out_ref[:] = out
 
 
-def unpack_2bit_window(data: jnp.ndarray,
-                       window: jnp.ndarray | None = None,
-                       interpret: bool = False) -> jnp.ndarray:
-    """uint8 [m] -> f32 [4m], 2-bit MSB-first unpack fused with an optional
-    window multiply, one HBM pass."""
+def unpack_subbyte_window(data: jnp.ndarray, nbits: int,
+                          window: jnp.ndarray | None = None,
+                          interpret: bool = False) -> jnp.ndarray:
+    """uint8 [m] -> f32 [(8/nbits)*m] for nbits in {1, 2, 4}: MSB-first
+    sub-byte unpack fused with an optional window multiply, one HBM pass
+    (ref: unpack.hpp handwritten 1/2/4-bit kernels + fused transform)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    if nbits not in (1, 2, 4):
+        raise ValueError(f"sub-byte unpack needs nbits in 1/2/4, got {nbits}")
+    per_byte = 8 // nbits
     m = data.shape[-1]
     if m % _LANES:
         raise ValueError(f"byte count must be a multiple of {_LANES}")
@@ -376,22 +382,31 @@ def unpack_2bit_window(data: jnp.ndarray,
     bytes2d = data.reshape(rows_total, _LANES)
     apply_window = window is not None
     if window is None:
-        window = jnp.ones((rows_total, 4 * _LANES), dtype=jnp.float32)
+        window = jnp.ones((rows_total, per_byte * _LANES),
+                          dtype=jnp.float32)
     else:
-        window = window.reshape(rows_total, 4 * _LANES)
+        window = window.reshape(rows_total, per_byte * _LANES)
 
-    kernel = functools.partial(_unpack2_kernel, apply_window=apply_window)
+    kernel = functools.partial(_unpack_subbyte_kernel, nbits=nbits,
+                               apply_window=apply_window)
     out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[pl.BlockSpec((rows, _LANES), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
-                  pl.BlockSpec((rows, 4 * _LANES), lambda i: (i, 0),
+                  pl.BlockSpec((rows, per_byte * _LANES), lambda i: (i, 0),
                                memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((rows, 4 * _LANES), lambda i: (i, 0),
+        out_specs=pl.BlockSpec((rows, per_byte * _LANES), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((rows_total, 4 * _LANES),
+        out_shape=jax.ShapeDtypeStruct((rows_total, per_byte * _LANES),
                                        jnp.float32),
         interpret=interpret,
     )(bytes2d, window)
-    return out.reshape(4 * m)
+    return out.reshape(per_byte * m)
+
+
+def unpack_2bit_window(data: jnp.ndarray,
+                       window: jnp.ndarray | None = None,
+                       interpret: bool = False) -> jnp.ndarray:
+    """uint8 [m] -> f32 [4m]; see :func:`unpack_subbyte_window`."""
+    return unpack_subbyte_window(data, 2, window, interpret)

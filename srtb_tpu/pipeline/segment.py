@@ -114,10 +114,11 @@ class SegmentProcessor:
         interp = getattr(self, "_pallas_interpret", False)
         if use_pallas:
             from srtb_tpu.ops import pallas_kernels as pk
-        if (use_pallas and cfg.baseband_input_bits == 2
+        if (use_pallas and cfg.baseband_input_bits in (1, 2, 4)
                 and self.fmt.unpack_variant == "simple"):
-            x = pk.unpack_2bit_window(raw, self.window,
-                                      interpret=interp)[None, :]
+            x = pk.unpack_subbyte_window(raw, cfg.baseband_input_bits,
+                                         self.window,
+                                         interpret=interp)[None, :]
         else:
             x = unpack_streams(raw, self.fmt.unpack_variant,
                                cfg.baseband_input_bits, self.window)

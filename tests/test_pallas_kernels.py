@@ -108,3 +108,17 @@ def test_sk_zap_timeseries_matches_jnp():
                                rtol=1e-4, atol=1e-4)
     assert np.array_equal(np.asarray(got_det.signal_counts),
                           np.asarray(expected_det.signal_counts))
+
+
+@pytest.mark.parametrize("nbits", [1, 2, 4])
+def test_unpack_subbyte_kernel_all_widths(nbits):
+    m = 1 << 10
+    rng = np.random.default_rng(nbits)
+    raw = rng.integers(0, 256, size=m, dtype=np.uint8)
+    n_out = (8 // nbits) * m
+    win = np.hamming(n_out).astype(np.float32)
+    got = np.asarray(pk.unpack_subbyte_window(
+        jnp.asarray(raw), nbits, jnp.asarray(win), interpret=True))
+    expected = np.asarray(U.unpack(jnp.asarray(raw), nbits,
+                                   jnp.asarray(win)))
+    np.testing.assert_allclose(got, expected, rtol=1e-6, atol=1e-6)
