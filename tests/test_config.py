@@ -55,3 +55,30 @@ def test_cli_precedence():
     cfg = Config.from_args(["--dm=10.5", "--baseband-input-count", "2**16"])
     assert cfg.dm == 10.5
     assert cfg.baseband_input_count == 65536
+
+
+def test_reference_config_key_parity():
+    """Every runtime option of the reference (config.hpp srtb::configs +
+    program_options.hpp extras) exists under the same name, so reference
+    users can bring their .cfg files across unchanged."""
+    from dataclasses import fields
+    reference_keys = {
+        # ref: userspace/include/srtb/config.hpp:80-249
+        "baseband_bandwidth", "baseband_format_type", "baseband_freq_low",
+        "baseband_input_bits", "baseband_input_count",
+        "baseband_output_file_prefix", "baseband_reserve_sample",
+        "baseband_sample_rate", "baseband_write_all", "config_file_name",
+        "dm", "fft_fftw_wisdom_path", "gui_enable", "gui_pixmap_height",
+        "gui_pixmap_width", "input_file_offset_bytes", "input_file_path",
+        "mitigate_rfi_average_method_threshold", "mitigate_rfi_freq_list",
+        "mitigate_rfi_spectral_kurtosis_threshold",
+        "signal_detect_channel_threshold", "signal_detect_max_boxcar_length",
+        "signal_detect_signal_noise_threshold", "spectrum_channel_count",
+        "spectrum_sum_count", "thread_query_work_wait_time",
+        # ref: program_options.hpp (CLI-only options)
+        "udp_receiver_address", "udp_receiver_port",
+        "udp_receiver_cpu_preferred", "log_level",
+    }
+    ours = {f.name for f in fields(Config)}
+    missing = reference_keys - ours
+    assert not missing, f"reference options without parity: {missing}"

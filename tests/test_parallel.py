@@ -13,7 +13,7 @@ from srtb_tpu.ops import dedisperse as dd
 from srtb_tpu.parallel import dm_grid, mesh as M
 from srtb_tpu.parallel.segment_dist import DistSegmentProcessor
 from srtb_tpu.pipeline.segment import SegmentProcessor
-from tests.test_pipeline import make_dispersed_baseband
+from srtb_tpu.io.synth import make_dispersed_baseband
 
 
 def _cfg(tmpdir="", n=1 << 14, dm=30.0):
@@ -40,7 +40,7 @@ def raw_segment():
     return make_dispersed_baseband(
         cfg.baseband_input_count, cfg.baseband_freq_low,
         cfg.baseband_bandwidth, cfg.dm,
-        pulse_pos=cfg.baseband_input_count // 2, pulse_amp=25.0)
+        pulse_positions=cfg.baseband_input_count // 2, pulse_amp=25.0)
 
 
 def test_dm_grid_finds_true_dm(raw_segment):
@@ -130,7 +130,7 @@ def test_dm_search_pipeline(tmp_path):
     raw = make_dispersed_baseband(
         cfg.baseband_input_count, cfg.baseband_freq_low,
         cfg.baseband_bandwidth, 30.0,
-        pulse_pos=cfg.baseband_input_count // 2, pulse_amp=25.0)
+        pulse_positions=cfg.baseband_input_count // 2, pulse_amp=25.0)
     path = str(tmp_path / "in.bin")
     raw.tofile(path)
     cfg = cfg.replace(input_file_path=path)

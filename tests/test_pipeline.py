@@ -14,34 +14,9 @@ import numpy as np
 import pytest
 
 from srtb_tpu.config import Config
-from srtb_tpu.ops import dedisperse as dd
+from srtb_tpu.io.synth import make_dispersed_baseband
 from srtb_tpu.pipeline.runtime import Pipeline, has_signal
 from srtb_tpu.pipeline.segment import SegmentProcessor
-
-
-def make_dispersed_baseband(n, f_min, bandwidth, dm, pulse_pos, nbits=8,
-                            pulse_amp=40.0, seed=0):
-    """Synthesize real baseband containing a dispersed impulse: build the
-    analytic signal in the frequency domain, apply the *inverse* chirp
-    (what the ionized medium does), and quantize."""
-    rng = np.random.default_rng(seed)
-    x = rng.standard_normal(n)
-    pulse = np.zeros(n)
-    width = 32
-    pulse[pulse_pos:pulse_pos + width] = \
-        pulse_amp * rng.standard_normal(width)
-    n_spec = n // 2
-    f_c = f_min + bandwidth
-    df = bandwidth / n_spec
-    chirp = dd.chirp_factor_host(n_spec, f_min, df, f_c, dm)
-    spec = np.fft.rfft(pulse)
-    spec[:n_spec] *= np.conj(chirp)  # disperse
-    dispersed_pulse = np.fft.irfft(spec, n)
-    sig = x + dispersed_pulse
-    if nbits == 8:
-        q = np.clip(np.round(sig / sig.std() * 16 + 128), 0, 255)
-        return q.astype(np.uint8)
-    raise ValueError(nbits)
 
 
 @pytest.fixture(scope="module")
@@ -50,7 +25,7 @@ def synthetic_cfg(tmp_path_factory):
     n = 1 << 18
     f_min, bw, dm = 1405.0, 64.0, 60.0
     data = make_dispersed_baseband(n * 2, f_min, bw, dm,
-                                   pulse_pos=n // 2, nbits=8)
+                                   pulse_positions=n // 2, nbits=8)
     path = str(tmp / "baseband.bin")
     data.tofile(path)
     cfg = Config(
