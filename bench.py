@@ -57,8 +57,16 @@ def main():
     wf, res = proc._jit_process(raw_dev, proc.chirp)
     jax.block_until_ready(res.signal_counts)
 
+    # optional profiler capture of the steady state (xprof format)
+    trace_dir = os.environ.get("SRTB_BENCH_TRACE_DIR", "")
+    if trace_dir:
+        from srtb_tpu.utils.tracing import device_trace
+        with device_trace(trace_dir):
+            wf, res = proc._jit_process(raw_dev, proc.chirp)
+            jax.block_until_ready(res.signal_counts)
+
     # steady state: time several segments back to back
-    reps = 5
+    reps = int(os.environ.get("SRTB_BENCH_REPS", "5"))
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()

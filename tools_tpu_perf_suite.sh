@@ -1,0 +1,33 @@
+#!/bin/bash
+# One-shot TPU perf sweep: headline bench + code-path A/Bs + per-kernel
+# numbers, appended as JSON lines to PERF_TPU.jsonl with a variant tag.
+# Run from the repo root on a machine with the TPU visible.
+set -u
+OUT=PERF_TPU.jsonl
+stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
+
+run() {
+  local tag="$1"; shift
+  echo "== $tag =="
+  local line
+  line=$("$@" 2>/dev/null | grep '^{' | tail -1)
+  if [ -n "$line" ]; then
+    echo "{\"ts\": \"$(stamp)\", \"variant\": \"$tag\", \"result\": $line}" >> "$OUT"
+    echo "$line"
+  else
+    echo "{\"ts\": \"$(stamp)\", \"variant\": \"$tag\", \"error\": true}" >> "$OUT"
+  fi
+}
+
+run baseline                    python bench.py
+SRTB_BENCH_USE_PALLAS=1         run pallas python bench.py
+SRTB_BENCH_FFT_STRATEGY=four_step run four_step python bench.py
+SRTB_BENCH_LOG2N=28             run n2_28 python bench.py
+SRTB_BENCH_LOG2N=29             run n2_29 python bench.py
+
+echo "== kernel bench ==" | tee -a /dev/stderr
+python -m srtb_tpu.tools.kernel_bench --log2n 28 --reps 5 2>/dev/null \
+  | while read -r line; do
+      echo "{\"ts\": \"$(stamp)\", \"variant\": \"kernel\", \"result\": $line}" >> "$OUT"
+      echo "$line"
+    done
