@@ -86,6 +86,71 @@ def write_png(path: str, argb: np.ndarray) -> None:
     os.replace(tmp, path)
 
 
+class RequestSizeScheduler:
+    """Adaptive lines-per-update scheduler of the legacy provider: grow
+    3n+1 when the consumer starved last round, halve (min 1) when it had
+    enough (ref: gui/spectrum_image_provider.hpp:79-102)."""
+
+    def __init__(self):
+        self._size = 1
+
+    def set_last_size_too_few(self, too_few: bool) -> None:
+        self._size = (3 * self._size + 1) if too_few else max(
+            1, self._size // 2)
+
+    def get_next_request_size(self) -> int:
+        return self._size
+
+
+class ScrollingWaterfall:
+    """Legacy scrolling-waterfall provider, headless (ref:
+    gui/spectrum_image_provider.hpp:118-330 SpectrumImageProvider +
+    draw_spectrum_work_holder): each pushed power spectrum becomes one
+    pixmap line (frequency along x), lines scroll upward through a
+    persistent image; an adaptive :class:`RequestSizeScheduler` decides
+    how many pending lines to consume per render so the display keeps up
+    with the data rate without dropping to a crawl.
+    """
+
+    def __init__(self, in_freq: int, width: int, height: int):
+        self.width = width
+        self.height = height
+        # area-weighted frequency->pixel resample (no bins dropped), the
+        # same weights family as the simplify path
+        self._w_freq = np.asarray(
+            sp.freq_area_weights(in_freq, width)).T   # [in_freq, width]
+        self._img = np.zeros((height, width), dtype=np.float32)
+        self._pending: list[np.ndarray] = []
+        self.scheduler = RequestSizeScheduler()
+        self.lines_total = 0
+
+    def push_spectrum(self, power: np.ndarray) -> None:
+        """Queue one [in_freq] power spectrum as a future line."""
+        self._pending.append(np.asarray(power, dtype=np.float32))
+
+    def consume(self) -> int:
+        """Scroll in up to request_size pending lines (one UI update);
+        returns the number of lines consumed and adapts the scheduler."""
+        want = self.scheduler.get_next_request_size()
+        take = min(want, len(self._pending))
+        if take:
+            lines = np.stack(self._pending[:take]) @ self._w_freq
+            del self._pending[:take]
+            self._img = np.roll(self._img, -take, axis=0)
+            self._img[-take:] = lines[-self.height:]
+            self.lines_total += take
+        # "too few" = the request size lagged the data rate: backlog
+        # remains after this update, so grow 3n+1 to catch up
+        self.scheduler.set_last_size_too_few(bool(self._pending))
+        return take
+
+    def render(self) -> np.ndarray:
+        """ARGB32 [height, width] of the current scroll window."""
+        import jax.numpy as _jnp
+        img = sp.normalize_by_average(_jnp.asarray(self._img))
+        return np.asarray(sp.generate_pixmap(img))
+
+
 class WaterfallService:
     """Per-stream waterfall file sink with lossy-frame semantics: only the
     most recent segment is rendered; older frames are dropped if rendering

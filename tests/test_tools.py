@@ -154,3 +154,43 @@ def test_waterfall_http_server(tmp_path):
         assert png[:8] == b"\x89PNG\r\n\x1a\n"
     finally:
         srv.stop()
+
+
+def test_scrolling_waterfall_and_scheduler():
+    """Legacy scrolling provider analog: lines scroll through a persistent
+    image; the 3n+1 scheduler grows while a backlog remains and halves
+    once caught up (ref: gui/spectrum_image_provider.hpp:79-102)."""
+    from srtb_tpu.gui.waterfall import RequestSizeScheduler, ScrollingWaterfall
+
+    s = RequestSizeScheduler()
+    assert s.get_next_request_size() == 1
+    s.set_last_size_too_few(True)
+    assert s.get_next_request_size() == 4      # 3*1+1
+    s.set_last_size_too_few(True)
+    assert s.get_next_request_size() == 13     # 3*4+1
+    s.set_last_size_too_few(False)
+    assert s.get_next_request_size() == 6
+    for _ in range(5):
+        s.set_last_size_too_few(False)
+    assert s.get_next_request_size() == 1      # floor at 1
+
+    in_freq, w, h = 64, 32, 16
+    sw = ScrollingWaterfall(in_freq, width=w, height=h)
+    rng = np.random.default_rng(0)
+    for i in range(40):
+        spec = np.zeros(in_freq, dtype=np.float32)
+        spec[:] = 0.1
+        spec[i % in_freq] = float(i + 1)       # marker per line
+        sw.push_spectrum(spec)
+    consumed = 0
+    rounds = 0
+    while consumed < 40 and rounds < 50:
+        consumed += sw.consume()
+        rounds += 1
+    assert consumed == 40 and sw.lines_total == 40
+    # newest line sits at the bottom of the scroll window
+    assert sw._img[-1].max() >= sw._img[0].max()
+    pix = sw.render()
+    assert pix.shape == (h, w) and pix.dtype == np.uint32
+    # catching up took adaptive batches: fewer rounds than lines
+    assert rounds < 40
