@@ -136,19 +136,31 @@ class ScrollingWaterfall:
         if take:
             lines = np.stack(self._pending[:take]) @ self._w_freq
             del self._pending[:take]
-            self._img = np.roll(self._img, -take, axis=0)
-            self._img[-take:] = lines[-self.height:]
+            # scroll down, newest line at the top (ref: update_pixmap
+            # scrolls dy=+lines and paints new lines at y=0)
+            self._img = np.roll(self._img, take, axis=0)
+            keep = lines[-self.height:]
+            self._img[:keep.shape[0]] = keep[::-1]
             self.lines_total += take
-        # "too few" = the request size lagged the data rate: backlog
-        # remains after this update, so grow 3n+1 to catch up
-        self.scheduler.set_last_size_too_few(bool(self._pending))
+        # the reference grows 3n+1 whenever the full request was
+        # satisfied ("still some work in queue, request more") and halves
+        # when the queue ran dry mid-request
+        # (ref: spectrum_image_provider.hpp:218-230)
+        self.scheduler.set_last_size_too_few(take >= want)
         return take
 
     def render(self) -> np.ndarray:
-        """ARGB32 [height, width] of the current scroll window."""
-        import jax.numpy as _jnp
-        img = sp.normalize_by_average(_jnp.asarray(self._img))
-        return np.asarray(sp.generate_pixmap(img))
+        """ARGB32 [height, width] of the current scroll window.
+        Normalization uses only rows that have received data, so a
+        partially-filled window does not push real lines into the
+        overflow color."""
+        filled = min(self.lines_total, self.height)
+        if filled == 0:
+            return np.asarray(sp.generate_pixmap(jnp.asarray(self._img)))
+        avg = float(self._img[:filled].mean())
+        coeff = 1.0 / (2.0 * avg) if avg > np.finfo(np.float32).eps else 1.0
+        return np.asarray(sp.generate_pixmap(
+            jnp.asarray(self._img * np.float32(coeff))))
 
 
 class WaterfallService:

@@ -188,9 +188,17 @@ def test_scrolling_waterfall_and_scheduler():
         consumed += sw.consume()
         rounds += 1
     assert consumed == 40 and sw.lines_total == 40
-    # newest line sits at the bottom of the scroll window
-    assert sw._img[-1].max() >= sw._img[0].max()
+    # newest line sits at the TOP of the scroll window (reference scrolls
+    # down, painting new lines at y=0)
+    assert abs(sw._img[0].max() - 40.1) < 1e-3
     pix = sw.render()
     assert pix.shape == (h, w) and pix.dtype == np.uint32
     # catching up took adaptive batches: fewer rounds than lines
     assert rounds < 40
+    # partially-filled window must not paint data as overflow color
+    from srtb_tpu.ops.spectrum import COLOR_OVERFLOW
+    sw2 = ScrollingWaterfall(in_freq, width=w, height=h)
+    sw2.push_spectrum(np.full(in_freq, 0.5, dtype=np.float32))
+    sw2.consume()
+    pix2 = sw2.render()
+    assert not (pix2[0] == np.uint32(COLOR_OVERFLOW)).any()
