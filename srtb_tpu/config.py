@@ -101,6 +101,10 @@ class Config:
     # C++ when built — the reference's boost thread pools,
     # write_signal_pipe.hpp:159-280), 0 writes synchronously
     writer_thread_count: int = 2
+    # scrolling-waterfall GUI mode: lines contributed per segment
+    # (0 = simple whole-segment frames, like the reference's live
+    # SimpleSpectrumImageProvider vs legacy scrolling provider)
+    gui_scroll_lines: int = 0
     # multi-host process group (jax.distributed); the DCN layer the
     # reference lacks. coordinator is "host:port" of process 0
     distributed_coordinator: str = ""
@@ -135,7 +139,7 @@ class Config:
         "thread_query_work_wait_time", "gui_pixmap_width",
         "gui_pixmap_height", "gui_http_port", "n_devices", "log_level",
         "writer_thread_count", "distributed_num_processes",
-        "distributed_process_id",
+        "distributed_process_id", "gui_scroll_lines",
     })
     _FLOAT_FIELDS = frozenset({
         "baseband_freq_low", "baseband_bandwidth", "baseband_sample_rate",

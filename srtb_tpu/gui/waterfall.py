@@ -166,7 +166,16 @@ class ScrollingWaterfall:
 class WaterfallService:
     """Per-stream waterfall file sink with lossy-frame semantics: only the
     most recent segment is rendered; older frames are dropped if rendering
-    lags (ref: loose_queue_out_functor, framework/pipe_io.hpp:79-94)."""
+    lags (ref: loose_queue_out_functor, framework/pipe_io.hpp:79-94).
+
+    Two modes, like the reference's two image providers:
+    - simple (default): each rendered frame is one whole segment's
+      dynamic spectrum (SimpleSpectrumImageProvider);
+    - scrolling (``gui_scroll_lines > 0``): each segment contributes that
+      many time-averaged spectrum lines to a persistent scrolling image
+      (legacy SpectrumImageProvider), written as
+      ``waterfall_s<id>_scroll.png`` after every update.
+    """
 
     def __init__(self, cfg: Config, in_freq: int, in_time: int,
                  out_dir: str = ".", fmt: str = "png"):
@@ -181,8 +190,33 @@ class WaterfallService:
         # frame rate (ref: config.hpp:196-200 spectrum_sum_count)
         self.sum_count = max(1, cfg.spectrum_sum_count)
         self._accum: dict[int, tuple[int, np.ndarray]] = {}
+        self.scroll_lines = max(0, cfg.gui_scroll_lines)
+        self._scrollers: dict[int, ScrollingWaterfall] = {}
+        self._in_freq = in_freq
+
+    def _scroller(self, stream: int) -> ScrollingWaterfall:
+        if stream not in self._scrollers:
+            self._scrollers[stream] = ScrollingWaterfall(
+                self._in_freq, self.cfg.gui_pixmap_width,
+                self.cfg.gui_pixmap_height)
+        return self._scrollers[stream]
+
+    def _push_scroll(self, wf_ri, stream: int) -> None:
+        wf = np.asarray(wf_ri)
+        if wf.ndim == 4:
+            wf = wf[:, stream]
+        power = wf[0] ** 2 + wf[1] ** 2          # [F, T]
+        k = min(self.scroll_lines, power.shape[-1])
+        chunks = np.array_split(power, k, axis=-1)
+        sw = self._scroller(stream)
+        for c in chunks:  # one time-averaged spectrum line per chunk
+            sw.push_spectrum(c.mean(axis=-1))
+        self._pending = (None, stream)
 
     def push(self, wf_ri, data_stream_id: int = 0) -> None:
+        if self.scroll_lines:
+            self._push_scroll(wf_ri, data_stream_id)
+            return
         if self.sum_count > 1:
             wf = np.asarray(wf_ri)
             if wf.ndim == 4:
@@ -204,6 +238,14 @@ class WaterfallService:
             return None
         wf_ri, stream = self._pending
         self._pending = None
+        if self.scroll_lines:
+            sw = self._scroller(stream)
+            if sw.consume() == 0:
+                return None
+            path = os.path.join(self.out_dir,
+                                f"waterfall_s{stream}_scroll.{self.fmt}")
+            write_png(path, sw.render())
+            return path
         wf = np.asarray(wf_ri)
         if wf.ndim == 4:  # [2, S, F, T] -> this stream
             wf = wf[:, stream]

@@ -202,3 +202,28 @@ def test_scrolling_waterfall_and_scheduler():
     sw2.consume()
     pix2 = sw2.render()
     assert not (pix2[0] == np.uint32(COLOR_OVERFLOW)).any()
+
+
+def test_main_cli_scrolling_gui(tmp_path):
+    """gui_scroll_lines selects the legacy scrolling provider through the
+    real CLI and produces a scroll image."""
+    from srtb_tpu.tools.main import main
+    rng = np.random.default_rng(0)
+    n = 1 << 14
+    rng.integers(0, 256, size=2 * n, dtype=np.uint8).tofile(
+        str(tmp_path / "in.bin"))
+    rc = main([
+        "--input_file_path", str(tmp_path / "in.bin"),
+        "--baseband_input_count", str(n),
+        "--baseband_input_bits", "8",
+        "--spectrum_channel_count", "2**6",
+        "--signal_detect_max_boxcar_length", "16",
+        "--baseband_output_file_prefix", str(tmp_path / "out_"),
+        "--baseband_reserve_sample", "0",
+        "--gui_enable", "1",
+        "--gui_scroll_lines", "4",
+        "--gui_pixmap_width", "32",
+        "--gui_pixmap_height", "24",
+    ])
+    assert rc == 0
+    assert os.path.exists(str(tmp_path / "waterfall_s0_scroll.png"))
