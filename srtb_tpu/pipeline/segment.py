@@ -110,7 +110,7 @@ class SegmentProcessor:
 
     def _process(self, raw: jnp.ndarray, chirp_ri: jnp.ndarray):
         cfg = self.cfg
-        use_pallas = cfg.use_pallas and self.fmt.data_stream_count == 1
+        use_pallas = cfg.use_pallas
         interp = getattr(self, "_pallas_interpret", False)
         if use_pallas:
             from srtb_tpu.ops import pallas_kernels as pk
@@ -126,24 +126,35 @@ class SegmentProcessor:
         spec = rfi.mitigate_rfi_average_and_normalize(
             spec, cfg.mitigate_rfi_average_method_threshold, self.norm_coeff)
         spec = rfi.mitigate_rfi_manual(spec, self.rfi_mask)
+        n_streams = spec.shape[0]
         if use_pallas:
-            spec_ri = jnp.stack([jnp.real(spec[0]), jnp.imag(spec[0])])
-            out_ri = pk.dedisperse_df64(spec_ri, self.f_min, self.df,
-                                        self.f_c, cfg.dm, interpret=interp)
-            spec = jax.lax.complex(out_ri[0], out_ri[1])[None, :]
+            # per-stream fused df64 chirp (S is small and static)
+            outs = []
+            for s in range(n_streams):
+                spec_ri = jnp.stack([jnp.real(spec[s]), jnp.imag(spec[s])])
+                out_ri = pk.dedisperse_df64(spec_ri, self.f_min, self.df,
+                                            self.f_c, cfg.dm,
+                                            interpret=interp)
+                outs.append(jax.lax.complex(out_ri[0], out_ri[1]))
+            spec = jnp.stack(outs)
         else:
             chirp = jax.lax.complex(chirp_ri[0], chirp_ri[1])
             spec = dd.dedisperse(spec, chirp)
         wf = F.waterfall_c2c(spec, self.channel_count)  # [S, F, T]
         if use_pallas and pk.sk_tiling_ok(wf.shape[-2], wf.shape[-1]):
-            wf_ri1 = jnp.stack([jnp.real(wf[0]), jnp.imag(wf[0])])
-            wf_ri1, zero_count, ts = pk.sk_zap_timeseries(
-                wf_ri1, cfg.mitigate_rfi_spectral_kurtosis_threshold,
-                interpret=interp)
-            wf = jax.lax.complex(wf_ri1[0], wf_ri1[1])[None]
+            zapped, zero_counts, ts_rows = [], [], []
+            for s in range(n_streams):
+                wf_ri1 = jnp.stack([jnp.real(wf[s]), jnp.imag(wf[s])])
+                wf_ri1, zc, ts = pk.sk_zap_timeseries(
+                    wf_ri1, cfg.mitigate_rfi_spectral_kurtosis_threshold,
+                    interpret=interp)
+                zapped.append(jax.lax.complex(wf_ri1[0], wf_ri1[1]))
+                zero_counts.append(zc)
+                ts_rows.append(ts)
+            wf = jnp.stack(zapped)
             t = det.trimmed_length(wf.shape[-1], self.time_reserved_count)
             result = det.detect_from_time_series(
-                ts[None, :t], zero_count[None],
+                jnp.stack(ts_rows)[:, :t], jnp.stack(zero_counts),
                 cfg.signal_detect_signal_noise_threshold,
                 cfg.signal_detect_max_boxcar_length)
         else:

@@ -125,3 +125,33 @@ def test_pipeline_pallas_path_matches(synthetic_cfg, tmp_path):
     pipe = Pipeline(cfg2)
     stats = pipe.run()
     assert stats.signals >= 1
+
+
+def test_pallas_path_multi_stream_matches(tmp_path):
+    """use_pallas with a 2-polarization format must match the jnp path's
+    detections stream for stream."""
+    n = 1 << 14
+    rng = np.random.default_rng(9)
+    raw = rng.integers(0, 256, size=2 * n, dtype=np.uint8)
+    base = dict(
+        baseband_input_count=n, baseband_input_bits=8,
+        baseband_format_type="naocpsr_snap1", baseband_freq_low=1405.0,
+        baseband_bandwidth=64.0, baseband_sample_rate=128e6, dm=20.0,
+        spectrum_channel_count=1 << 6,
+        signal_detect_max_boxcar_length=16,
+        mitigate_rfi_average_method_threshold=100.0,
+        mitigate_rfi_spectral_kurtosis_threshold=2.0,
+        baseband_reserve_sample=False)
+    p_ref = SegmentProcessor(Config(**base))
+    p_pal = SegmentProcessor(Config(**base, use_pallas=True))
+    wf_a, res_a = p_ref.process(raw)
+    wf_b, res_b = p_pal.process(raw)
+    assert np.asarray(res_a.signal_counts).shape == \
+        np.asarray(res_b.signal_counts).shape == (2, 5)
+    np.testing.assert_array_equal(np.asarray(res_a.zero_count),
+                                  np.asarray(res_b.zero_count))
+    np.testing.assert_allclose(np.asarray(res_a.time_series),
+                               np.asarray(res_b.time_series),
+                               rtol=1e-3, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(wf_a), np.asarray(wf_b),
+                               rtol=1e-3, atol=1e-2)
