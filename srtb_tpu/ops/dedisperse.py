@@ -83,14 +83,17 @@ def chirp_factor_host(n: int, f_min: float, df: float, f_c: float,
 
 
 def chirp_factor_df64(n: int, f_min: float, df: float, f_c: float, dm,
-                      dtype=jnp.complex64) -> jnp.ndarray:
+                      dtype=jnp.complex64, i0: int = 0,
+                      dm_lo=None) -> jnp.ndarray:
     """Same chirp computed on device with two-float (df64) arithmetic —
-    jittable, dm may be a traced scalar (DM-search grids).
+    jittable, dm may be a traced scalar (DM-search grids).  ``i0``
+    generates the block of channels starting at that global index.
 
     Mirrors phase_factor_v3 with phase_real = dsmath::df64
     (ref: coherent_dedispersion.hpp:31-53,134-150).
     """
-    delta_phi = _chirp_phase_df64(n, f_min, df, f_c, dm)
+    delta_phi = _chirp_phase_df64(n, f_min, df, f_c, dm, i0=i0,
+                                  dm_lo=dm_lo)
     return (jnp.cos(delta_phi) + 1j * jnp.sin(delta_phi)).astype(dtype)
 
 
@@ -108,30 +111,47 @@ def chirp_factor_host_ri(n: int, f_min: float, df: float, f_c: float,
 
 
 def chirp_factor_df64_ri(n: int, f_min: float, df: float, f_c: float,
-                         dm) -> jnp.ndarray:
+                         dm, i0: int = 0, dm_lo=None) -> jnp.ndarray:
     """df64 on-device chirp as stacked (cos, sin) float32 [2, n] — jit-safe
     output dtype on complex-less runtimes."""
-    phase = _chirp_phase_df64(n, f_min, df, f_c, dm)
+    phase = _chirp_phase_df64(n, f_min, df, f_c, dm, i0=i0, dm_lo=dm_lo)
     return jnp.stack([jnp.cos(phase), jnp.sin(phase)])
 
 
-def _chirp_phase_df64(n: int, f_min: float, df: float, f_c: float, dm):
+def _chirp_phase_df64(n: int, f_min: float, df: float, f_c: float, dm,
+                      i0: int = 0, dm_lo=None):
     """delta_phi [n] in f32 via df64 arithmetic (shared by the complex and
-    split-ri chirp generators)."""
-    i = jnp.arange(n, dtype=jnp.float32)
+    split-ri chirp generators).
+
+    ``i0`` offsets the channel index (shard-local generation on a
+    sequence-sharded spectrum).  Indices are split hi/lo from *integers*:
+    a float32 arange is exact only below 2^24, and a channel-index error
+    of even a few samples at 2^27 channels shifts the phase by whole
+    turns (k ~ 1e9 turns scales as ~k/f per MHz).
+    """
+    i_int = jnp.arange(n, dtype=jnp.int32) + jnp.int32(i0)
+    # hi is a multiple of 2^12 (exact in f32 up to 2^36), lo < 2^12
+    i_hi = (i_int & ~0xFFF).astype(jnp.float32)
+    i_lo = (i_int & 0xFFF).astype(jnp.float32)
     f_min_d = ds.df64(jnp.float32(np.float32(f_min)),
                       jnp.float32(np.float64(f_min) - np.float32(f_min)))
     df_d = ds.df64(jnp.float32(np.float32(df)),
                    jnp.float32(np.float64(df) - np.float32(df)))
     f_c_d = ds.df64(jnp.float32(np.float32(f_c)),
                     jnp.float32(np.float64(f_c) - np.float32(f_c)))
-    i_hi = jnp.float32(1 << 12) * jnp.trunc(i / (1 << 12))
-    i_lo = i - i_hi
     df_i = ds.add(ds.mul(df_d, ds.df64(i_hi)), ds.mul(df_d, ds.df64(i_lo)))
     f = ds.add(f_min_d, df_i)
 
-    dm_arr = jnp.asarray(dm, dtype=jnp.float32)
-    dm_d = ds.df64(dm_arr)
+    # dm must be split hi/lo too: truncating e.g. -478.80 to one f32
+    # (2.5e-8 relative) shifts k ~ 1e9 turns by ~25 turns
+    if isinstance(dm, (int, float, np.floating)):
+        dm_d = ds.df64(jnp.float32(np.float32(dm)),
+                       jnp.float32(np.float64(dm) - np.float32(dm)))
+    else:
+        dm_arr = jnp.asarray(dm, dtype=jnp.float32)
+        dm_lo_arr = jnp.zeros_like(dm_arr) if dm_lo is None \
+            else jnp.asarray(dm_lo, dtype=jnp.float32)
+        dm_d = ds.df64(dm_arr, dm_lo_arr)
     D_ = np.float64(D * 1e6)
     D_d = ds.df64(jnp.float32(np.float32(D_)),
                   jnp.float32(D_ - np.float32(D_)))

@@ -13,38 +13,46 @@ ulp(hi)/2`` and value ``hi + lo``.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 _SPLITTER = np.float32(4097.0)  # 2^12 + 1 for f32 Dekker splitting
 
+# The error-free transforms below only work if the compiler evaluates
+# them literally: XLA's simplifier rewrites patterns like (a + b) - a to
+# b, which zeroes every lo component and silently degrades df64 to f32
+# under jit (verified on CPU: the chirp phase lost ~1 rad at k ~ 8e5).
+# optimization_barrier makes the intermediate opaque to the simplifier.
+_ob = jax.lax.optimization_barrier
+
 
 def two_sum(a, b):
     """Error-free sum: a + b = s + e exactly."""
-    s = a + b
-    v = s - a
+    s = _ob(a + b)
+    v = _ob(s - a)
     e = (a - (s - v)) + (b - v)
     return s, e
 
 
 def quick_two_sum(a, b):
     """Error-free sum assuming |a| >= |b|."""
-    s = a + b
+    s = _ob(a + b)
     e = b - (s - a)
     return s, e
 
 
 def _split(a):
     """Dekker split of f32 into high/low halves with <=12-bit mantissas."""
-    t = _SPLITTER * a
-    hi = t - (t - a)
+    t = _ob(_SPLITTER * a)
+    hi = _ob(t - (t - a))
     lo = a - hi
     return hi, lo
 
 
 def two_prod(a, b):
     """Error-free product: a * b = p + e exactly (no FMA assumed)."""
-    p = a * b
+    p = _ob(a * b)
     a_hi, a_lo = _split(a)
     b_hi, b_lo = _split(b)
     e = ((a_hi * b_hi - p) + a_hi * b_lo + a_lo * b_hi) + a_lo * b_lo

@@ -45,23 +45,29 @@ def _pallas_available() -> bool:
 
 
 # ----------------------------------------------------------------
-# df64 helpers usable inside kernels (f32-only, no tuples of refs)
+# df64 helpers usable inside kernels (f32-only, no tuples of refs).
+# optimization_barrier keeps the compiler from simplifying the
+# error-free transforms away (see ops/df64.py — XLA rewrites
+# (a + b) - a to b, zeroing every lo component).
 # ----------------------------------------------------------------
 
+_ob = jax.lax.optimization_barrier
+
+
 def _two_sum(a, b):
-    s = a + b
-    v = s - a
+    s = _ob(a + b)
+    v = _ob(s - a)
     return s, (a - (s - v)) + (b - v)
 
 
 def _split(a):
-    t = jnp.float32(4097.0) * a
-    hi = t - (t - a)
+    t = _ob(jnp.float32(4097.0) * a)
+    hi = _ob(t - (t - a))
     return hi, a - hi
 
 
 def _two_prod(a, b):
-    p = a * b
+    p = _ob(a * b)
     a_hi, a_lo = _split(a)
     b_hi, b_lo = _split(b)
     return p, ((a_hi * b_hi - p) + a_hi * b_lo + a_lo * b_hi) + a_lo * b_lo
@@ -70,14 +76,14 @@ def _two_prod(a, b):
 def _df_add(x_hi, x_lo, y_hi, y_lo):
     s, e = _two_sum(x_hi, y_hi)
     e = e + x_lo + y_lo
-    s2 = s + e
+    s2 = _ob(s + e)
     return s2, e - (s2 - s)
 
 
 def _df_mul(x_hi, x_lo, y_hi, y_lo):
     p, e = _two_prod(x_hi, y_hi)
     e = e + x_hi * y_lo + x_lo * y_hi
-    s = p + e
+    s = _ob(p + e)
     return s, e - (s - p)
 
 
@@ -86,13 +92,16 @@ def _df_div(x_hi, x_lo, y_hi, y_lo):
     p_hi, p_lo = _df_mul(q1, jnp.zeros_like(q1), y_hi, y_lo)
     r_hi, r_lo = _df_add(x_hi, x_lo, -p_hi, -p_lo)
     q2 = r_hi / y_hi
-    s = q1 + q2
+    s = _ob(q1 + q2)
     return s, q2 - (s - q1)
 
 
-def _chirp_phase_block(i, f_min, df, f_c, dm):
-    """delta_phi for channel indices i (f32 array) — df64 arithmetic on
-    split constants, mirroring ops.dedisperse._chirp_phase_df64."""
+def _chirp_phase_block(i_hi, i_lo, f_min, df, f_c, dm):
+    """delta_phi for channel indices i = i_hi + i_lo (both exact f32;
+    split from integers by the caller — a float32 index is exact only
+    below 2^24 and phase errors scale by whole turns beyond it) — df64
+    arithmetic on split constants, mirroring
+    ops.dedisperse._chirp_phase_df64."""
     def c(v):
         hi = np.float32(v)
         return jnp.float32(hi), jnp.float32(np.float64(v) - np.float64(hi))
@@ -103,8 +112,7 @@ def _chirp_phase_block(i, f_min, df, f_c, dm):
     d_hi, d_lo = c(dd.D * 1e6)
     dm_hi, dm_lo = c(dm)
 
-    i_hi = jnp.float32(1 << 12) * jnp.trunc(i / (1 << 12))
-    i_lo = i - i_hi
+    i = i_hi + i_lo  # only used for shape/fill helpers below
     a_hi, a_lo = _df_mul(df_hi, df_lo, i_hi, jnp.zeros_like(i_hi))
     b_hi, b_lo = _df_mul(df_hi, df_lo, i_lo, jnp.zeros_like(i_lo))
     fi_hi, fi_lo = _df_add(a_hi, a_lo, b_hi, b_lo)
@@ -131,17 +139,20 @@ def _chirp_phase_block(i, f_min, df, f_c, dm):
 
 
 def _dedisperse_kernel(re_ref, im_ref, out_re_ref, out_im_ref, *,
-                       f_min, df, f_c, dm, rows):
+                       f_min, df, f_c, dm, rows, i0):
     from jax.experimental import pallas as pl
 
     step = pl.program_id(0)
-    base = step * (rows * _LANES)
-    # global channel index for each element of the block (row-major)
-    row_idx = jax.lax.broadcasted_iota(jnp.float32, (rows, _LANES), 0)
-    lane_idx = jax.lax.broadcasted_iota(jnp.float32, (rows, _LANES), 1)
-    i = jnp.float32(base) + row_idx * _LANES + lane_idx
+    base = i0 + step * (rows * _LANES)
+    # global channel index per element (row-major), built as int32 and
+    # split hi (multiple of 2^12, f32-exact to 2^36) / lo (< 2^12)
+    row_idx = jax.lax.broadcasted_iota(jnp.int32, (rows, _LANES), 0)
+    lane_idx = jax.lax.broadcasted_iota(jnp.int32, (rows, _LANES), 1)
+    i_int = jnp.int32(base) + row_idx * _LANES + lane_idx
+    i_hi = (i_int & ~0xFFF).astype(jnp.float32)
+    i_lo = (i_int & 0xFFF).astype(jnp.float32)
 
-    phase = _chirp_phase_block(i, f_min, df, f_c, dm)
+    phase = _chirp_phase_block(i_hi, i_lo, f_min, df, f_c, dm)
     c = jnp.cos(phase)
     s = jnp.sin(phase)
     re = re_ref[:]
@@ -152,8 +163,9 @@ def _dedisperse_kernel(re_ref, im_ref, out_re_ref, out_im_ref, *,
 
 def dedisperse_df64(spec_ri: jnp.ndarray, f_min: float, df: float,
                     f_c: float, dm: float,
-                    interpret: bool = False) -> jnp.ndarray:
-    """spec_ri [2, n] -> dedispersed [2, n], chirp generated in-kernel.
+                    interpret: bool = False, i0: int = 0) -> jnp.ndarray:
+    """spec_ri [2, n] -> dedispersed [2, n], chirp generated in-kernel;
+    ``i0`` is the global index of the first channel (sequence shards).
 
     n must be a multiple of 128; grid steps cover _ROWS*128 channels each.
     """
@@ -172,7 +184,7 @@ def dedisperse_df64(spec_ri: jnp.ndarray, f_min: float, df: float,
     re = spec_ri[0].reshape(rows_total, _LANES)
     im = spec_ri[1].reshape(rows_total, _LANES)
     kernel = functools.partial(_dedisperse_kernel, f_min=f_min, df=df,
-                               f_c=f_c, dm=dm, rows=rows)
+                               f_c=f_c, dm=dm, rows=rows, i0=int(i0))
     block = pl.BlockSpec((rows, _LANES), lambda i: (i, 0),
                          memory_space=pltpu.VMEM)
     out_re, out_im = pl.pallas_call(

@@ -43,3 +43,51 @@ def test_frac_large_values():
     frac = np.asarray(jax.jit(ds.frac)(k_d))
     expected = np.modf(k)[0]
     np.testing.assert_allclose(frac, expected, atol=2e-5)
+
+
+def test_df64_chirp_high_channel_offset():
+    """Channel indices beyond 2^24 are inexact in float32; the integer
+    hi/lo split must keep the df64 phase accurate at e.g. i ~ 2^27
+    (a 2^28-sample segment's upper channels)."""
+    from srtb_tpu.ops import dedisperse as dd
+    n_total = 1 << 28
+    n_spec = n_total // 2
+    f_min, bw, dm = 1405.0, -64.0, -478.80
+    f_c = f_min + bw
+    df = bw / n_spec
+    i0 = 1 << 26                   # mid-band: worst f32-index phase error
+    block = 1024
+    got = np.asarray(dd.chirp_factor_df64(block, f_min, df, f_c, dm,
+                                          i0=i0))
+    i = np.arange(i0, i0 + block, dtype=np.float64)
+    f = f_min + df * i
+    delta_f = f - f_c
+    k = (dd.D * 1e6) * dm / f * (delta_f / f_c) ** 2
+    expected = np.exp(-2j * np.pi * np.modf(k)[0]).astype(np.complex64)
+    err = np.abs(got - expected)
+    assert err.max() < 5e-3, err.max()
+
+
+def test_df64_survives_jit_compilation():
+    """XLA's simplifier must not strip the error-free transforms: jitted
+    and eager df64 chirp phases have to agree (they diverged by ~1 rad
+    before optimization_barrier was added)."""
+    import jax
+    from srtb_tpu.ops import dedisperse as dd
+    n = 512
+    i0 = (1 << 26) + 1024
+    f_min, bw, dm = 1437.0, -64.0, -478.80
+    f_c = f_min + bw
+    df = bw / (1 << 27)
+    eager = np.asarray(dd._chirp_phase_df64(n, f_min, df, f_c, dm, i0=i0))
+    jitted = np.asarray(jax.jit(
+        lambda: dd._chirp_phase_df64(n, f_min, df, f_c, dm, i0=i0))())
+    np.testing.assert_allclose(jitted, eager, rtol=0, atol=1e-4)
+    # and the jitted phase matches float64 truth
+    i = np.arange(i0, i0 + n, dtype=np.float64)
+    f = f_min + df * i
+    k = (dd.D * 1e6) * dm / f * ((f - f_c) / f_c) ** 2
+    expected = -2 * np.pi * np.modf(k)[0]
+    err = np.abs(jitted - expected)
+    err = np.minimum(err, 2 * np.pi - err)
+    assert err.max() < 2e-3, err.max()

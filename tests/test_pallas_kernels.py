@@ -122,3 +122,29 @@ def test_unpack_subbyte_kernel_all_widths(nbits):
     expected = np.asarray(U.unpack(jnp.asarray(raw), nbits,
                                    jnp.asarray(win)))
     np.testing.assert_allclose(got, expected, rtol=1e-6, atol=1e-6)
+
+
+def test_dedisperse_df64_kernel_high_channel_offset():
+    """The in-kernel chirp must stay phase-accurate when the global
+    channel index exceeds float32's exact-integer range (2^24)."""
+    n = 1 << 12
+    i0 = (1 << 26) + 1024
+    n_spec = 1 << 27
+    f_min, bw, dm = 1405.0 + 32.0, -64.0, -478.80
+    f_c = f_min + bw
+    df = bw / n_spec
+    rng = np.random.default_rng(1)
+    spec = (rng.standard_normal(n) + 1j * rng.standard_normal(n)).astype(
+        np.complex64)
+    spec_ri = jnp.stack([jnp.asarray(spec.real), jnp.asarray(spec.imag)])
+    out_ri = np.asarray(pk.dedisperse_df64(spec_ri, f_min, df, f_c, dm,
+                                           interpret=True, i0=i0))
+    got = out_ri[0] + 1j * out_ri[1]
+
+    i = np.arange(i0, i0 + n, dtype=np.float64)
+    f = f_min + df * i
+    delta_f = f - f_c
+    k = (dd.D * 1e6) * dm / f * (delta_f / f_c) ** 2
+    chirp = np.exp(-2j * np.pi * np.modf(k)[0]).astype(np.complex64)
+    err = np.abs(got - spec * chirp)
+    assert err.max() < 5e-3 * np.abs(spec).max(), err.max()
