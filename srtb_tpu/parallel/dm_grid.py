@@ -44,12 +44,15 @@ def build_chirp_bank(dm_list, n_spectrum: int, f_min: float, df: float,
     host->device transfer of the bank, SURVEY.md §7 step 6)."""
     dm_list = np.asarray(dm_list, dtype=np.float64)
     if on_device and mesh is not None:
-        def gen(dms_block):
-            return jax.vmap(lambda dm: dd.chirp_factor_df64_ri(
-                n_spectrum, f_min, df, f_c, dm))(dms_block)
-        fn = jax.jit(shard_map(gen, mesh=mesh, in_specs=P("dm"),
+        from srtb_tpu.ops import df64 as ds
+        dm_hi, dm_lo = ds.from_float64(dm_list)  # keep full f64 precision
+
+        def gen(hi_block, lo_block):
+            return jax.vmap(lambda h, l: dd.chirp_factor_df64_ri(
+                n_spectrum, f_min, df, f_c, h, dm_lo=l))(hi_block, lo_block)
+        fn = jax.jit(shard_map(gen, mesh=mesh, in_specs=(P("dm"), P("dm")),
                                out_specs=P("dm")))
-        return fn(jnp.asarray(dm_list, dtype=jnp.float32))
+        return fn(jnp.asarray(dm_hi), jnp.asarray(dm_lo))
     bank = np.stack([dd.chirp_factor_host_ri(n_spectrum, f_min, df, f_c, dm)
                      for dm in dm_list])
     if mesh is not None:

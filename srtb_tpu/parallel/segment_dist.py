@@ -55,7 +55,8 @@ class DistSegmentProcessor:
     """Builds the jitted multi-chip step for one baseband segment and a DM
     trial list."""
 
-    def __init__(self, cfg: Config, mesh: Mesh, dm_list=None):
+    def __init__(self, cfg: Config, mesh: Mesh, dm_list=None,
+                 chirp_on_device: bool | None = None):
         self.cfg = cfg
         self.mesh = mesh
         self.fmt = formats.resolve(cfg.baseband_format_type)
@@ -76,11 +77,25 @@ class DistSegmentProcessor:
             raise ValueError("spectrum_channel_count must divide by seq axis")
 
         f_min, f_c, df = dd.spectrum_frequencies(cfg, self.n_spectrum)
-        # [n_dm, 2, n_spec] (re, im) sharded over (dm, -, seq)
-        self.chirp_bank = _put_sharded(
-            np.asarray(dm_grid.build_chirp_bank(
-                self.dm_list, self.n_spectrum, f_min, df, f_c)),
-            NamedSharding(mesh, P("dm", None, "seq")))
+        self.f_min, self.f_c, self.df = f_min, f_c, df
+        # chirp either streams from an HBM bank [n_dm, 2, n_spec] sharded
+        # (dm, -, seq), or is generated per trial inside the step with
+        # df64 (no bank resident in HBM — the better choice when
+        # n_trials * n_spec gets large; default follows use_emulated_fp64)
+        if chirp_on_device is None:
+            chirp_on_device = cfg.use_emulated_fp64
+        self.chirp_on_device = chirp_on_device
+        if chirp_on_device:
+            from srtb_tpu.ops import df64 as ds
+            dm_hi, dm_lo = ds.from_float64(self.dm_list)
+            self.chirp_bank = _put_sharded(
+                np.stack([dm_hi, dm_lo], axis=1),    # [n_dm, 2]
+                NamedSharding(mesh, P("dm", None)))
+        else:
+            self.chirp_bank = _put_sharded(
+                np.asarray(dm_grid.build_chirp_bank(
+                    self.dm_list, self.n_spectrum, f_min, df, f_c)),
+                NamedSharding(mesh, P("dm", None, "seq")))
 
         mask = rfi.rfi_ranges_to_mask(
             rfi.eval_rfi_ranges(cfg.mitigate_rfi_freq_list), self.n_spectrum,
@@ -99,6 +114,8 @@ class DistSegmentProcessor:
             variant=self.fmt.unpack_variant,
             nbits=cfg.baseband_input_bits,
             n=self.n, n_seq=self.n_seq, n_dm_dev=self.n_dm_devices,
+            chirp_on_device=chirp_on_device,
+            f_min=f_min, f_c=f_c, df=df,
             n_spectrum=self.n_spectrum,
             channel_count=self.channel_count,
             norm_coeff=self.norm_coeff,
@@ -111,16 +128,19 @@ class DistSegmentProcessor:
         # trial summaries leave the step replicated (all_gather over dm in
         # the body) so every controller process can read them; the bulky
         # time series stays dm-sharded
+        chirp_spec = P("dm", None) if chirp_on_device \
+            else P("dm", None, "seq")
         self._step = jax.jit(shard_map(
             body, mesh=mesh,
-            in_specs=(P("seq"), P("dm", None, "seq"), P("seq")),
+            in_specs=(P("seq"), chirp_spec, P("seq")),
             out_specs=(P(), P(), P(), P("dm"))))
 
     # ------------------------------------------------------------------
 
     @staticmethod
     def _body(raw_block, chirp_block, mask_block, *, variant, nbits, n,
-              n_seq, n_dm_dev, n_spectrum, channel_count, norm_coeff,
+              n_seq, n_dm_dev, chirp_on_device, f_min, f_c, df,
+              n_spectrum, channel_count, norm_coeff,
               avg_threshold, sk_threshold, time_reserved_count,
               snr_threshold, max_boxcar_length):
         from srtb_tpu.pipeline.segment import unpack_streams
@@ -157,7 +177,17 @@ class DistSegmentProcessor:
         t = wlen - time_reserved_count \
             if wlen > time_reserved_count else wlen
 
-        def one_trial(chirp_ri):
+        def one_trial(chirp_in):
+            if chirp_on_device:
+                # generate this trial's chirp block in-place with df64
+                # (chirp_in is the (dm_hi, dm_lo) pair; no HBM bank)
+                n_local = n_spectrum // n_seq
+                seq_idx = jax.lax.axis_index("seq")
+                chirp_ri = dd.chirp_factor_df64_ri(
+                    n_local, f_min, df, f_c, chirp_in[0],
+                    i0=seq_idx * n_local, dm_lo=chirp_in[1])
+            else:
+                chirp_ri = chirp_in
             s = spec_all * jax.lax.complex(chirp_ri[0], chirp_ri[1])
             # local channels are complete contiguous sub-bands
             wf = s.reshape(n_streams, ch_local, wlen)

@@ -166,3 +166,24 @@ def test_dist_segment_two_streams():
     np.testing.assert_array_equal(
         np.asarray(res.signal_counts)[0],
         np.asarray(res_single.signal_counts))
+
+
+def test_dist_segment_chirp_on_device_matches_bank(raw_segment):
+    """On-the-fly df64 chirp generation inside the sharded step (no HBM
+    chirp bank) must reproduce the host-f64 bank's detections."""
+    cfg = _cfg()
+    mesh = M.make_mesh(n_dm=2, n_seq=4)
+    dms = [0.0, 15.0, 30.0, 45.0]
+    bank = DistSegmentProcessor(cfg, mesh, dm_list=dms,
+                                chirp_on_device=False)
+    otf = DistSegmentProcessor(cfg, mesh, dm_list=dms,
+                               chirp_on_device=True)
+    res_a = bank.process(raw_segment)
+    res_b = otf.process(raw_segment)
+    np.testing.assert_array_equal(np.asarray(res_a.zero_count),
+                                  np.asarray(res_b.zero_count))
+    np.testing.assert_allclose(np.asarray(res_a.time_series),
+                               np.asarray(res_b.time_series),
+                               rtol=2e-3, atol=2e-2)
+    np.testing.assert_array_equal(np.asarray(res_a.signal_counts),
+                                  np.asarray(res_b.signal_counts))
