@@ -186,6 +186,10 @@ class WaterfallService:
             in_freq, in_time, cfg.gui_pixmap_height, cfg.gui_pixmap_width)
         self.frame_counter = {}
         self._pending = None
+        # scroll mode: every stream with queued-but-unrendered lines (a
+        # single last-tag slot would starve earlier streams when several
+        # are pushed between render_pending calls)
+        self._pending_scroll: set[int] = set()
         # sum several segments' power before drawing, reducing host-side
         # frame rate (ref: config.hpp:196-200 spectrum_sum_count)
         self.sum_count = max(1, cfg.spectrum_sum_count)
@@ -211,7 +215,7 @@ class WaterfallService:
         sw = self._scroller(stream)
         for c in chunks:  # one time-averaged spectrum line per chunk
             sw.push_spectrum(c.mean(axis=-1))
-        self._pending = (None, stream)
+        self._pending_scroll.add(stream)
 
     def push(self, wf_ri, data_stream_id: int = 0) -> None:
         if self.scroll_lines:
@@ -234,18 +238,24 @@ class WaterfallService:
         self._pending = (wf_ri, data_stream_id)
 
     def render_pending(self) -> str | None:
+        if self.scroll_lines:
+            # render every stream with queued lines; return the last path
+            # (None when nothing was consumed anywhere)
+            path = None
+            for stream in sorted(self._pending_scroll):
+                sw = self._scroller(stream)
+                if sw.consume() == 0:
+                    continue
+                p = os.path.join(self.out_dir,
+                                 f"waterfall_s{stream}_scroll.{self.fmt}")
+                write_png(p, sw.render())
+                path = p
+            self._pending_scroll.clear()
+            return path
         if self._pending is None:
             return None
         wf_ri, stream = self._pending
         self._pending = None
-        if self.scroll_lines:
-            sw = self._scroller(stream)
-            if sw.consume() == 0:
-                return None
-            path = os.path.join(self.out_dir,
-                                f"waterfall_s{stream}_scroll.{self.fmt}")
-            write_png(path, sw.render())
-            return path
         wf = np.asarray(wf_ri)
         if wf.ndim == 4:  # [2, S, F, T] -> this stream
             wf = wf[:, stream]

@@ -136,27 +136,36 @@ class AsyncWriterPool:
                              <= self.max_queued_bytes)
                     or self._queued_bytes == 0)
             self._queued_bytes += len(payload)
+            # prune cleanly-completed futures so a long checkpoint-less run
+            # doesn't accumulate them until the final drain; keep failed
+            # ones so drain() can still surface their exception
+            self._futures = [f for f in self._futures
+                             if not f.done() or f.exception() is not None]
             fut = self._pool.submit(self._py_write, path, payload, fsync,
                                     append)
             self._futures.append(fut)
 
     def _py_write(self, path: str, payload: bytes, fsync: bool,
                   append: bool) -> None:
+        # accounting must run for ANY exception type, or the backpressure
+        # window shrinks permanently and later submits block forever
+        ok = False
         try:
             with open(path, "ab" if append else "wb") as f:
                 f.write(payload)
                 f.flush()
                 if fsync:
                     os.fdatasync(f.fileno())
-            with self._space:
-                self._py_jobs += 1
-                self._py_bytes += len(payload)
-                self._queued_bytes -= len(payload)
-                self._space.notify_all()
+            ok = True
         except OSError:
+            pass  # counted below; surfaced via raise_new_errors()
+        finally:
             with self._space:
                 self._py_jobs += 1
-                self._py_errors += 1
+                if ok:
+                    self._py_bytes += len(payload)
+                else:
+                    self._py_errors += 1
                 self._queued_bytes -= len(payload)
                 self._space.notify_all()
 

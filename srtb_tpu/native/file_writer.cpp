@@ -46,6 +46,7 @@ struct WriterPool {
   size_t in_flight = 0;        // queued + running
   size_t queued_bytes = 0;     // payload bytes queued + being written
   size_t max_queued_bytes = 0; // submit blocks above this (0 = unbounded)
+  size_t active_submitters = 0;  // threads inside srtb_writer_submit
 
   // statistics (ref keeps per-write logs; we expose counters)
   std::atomic<uint64_t> jobs_done{0};
@@ -137,6 +138,8 @@ int32_t srtb_writer_submit(WriterPool* pool, const char* path,
   {
     std::unique_lock<std::mutex> lk(pool->mu);
     if (pool->stopping) return -1;
+    pool->active_submitters++;
+    int32_t rc = 0;
     if (pool->max_queued_bytes > 0) {
       // block until the job fits (oversized jobs wait for an empty queue)
       pool->cv_drain.wait(lk, [&] {
@@ -145,11 +148,19 @@ int32_t srtb_writer_submit(WriterPool* pool, const char* path,
                    pool->max_queued_bytes ||
                pool->queued_bytes == 0;
       });
-      if (pool->stopping) return -1;
+      if (pool->stopping) rc = -1;
     }
-    pool->queued_bytes += job.data.size();
-    pool->jobs.push_back(std::move(job));
-    pool->in_flight++;
+    if (rc == 0) {
+      pool->queued_bytes += job.data.size();
+      pool->jobs.push_back(std::move(job));
+      pool->in_flight++;
+    }
+    pool->active_submitters--;
+    lk.unlock();
+    // a destroyer may be waiting for active_submitters to reach zero
+    // before freeing the pool — wake it on every exit path
+    pool->cv_drain.notify_all();
+    if (rc != 0) return rc;
   }
   pool->cv_push.notify_one();
   return 0;
@@ -170,13 +181,23 @@ uint64_t srtb_writer_bytes_written(WriterPool* pool) {
 uint64_t srtb_writer_errors(WriterPool* pool) { return pool->errors.load(); }
 
 // Drain, stop the workers and free the pool.
+//
+// A submitter blocked in the backpressure wait when destroy begins is
+// woken via cv_drain, returns -1 on the stopping flag, and destroy waits
+// for it to leave submit() (active_submitters == 0) before freeing the
+// pool — so submit-vs-destroy is safe for already-entered calls.  Calls
+// *entered after* destroy returns are still use-after-free (the pointer
+// is dead); the Python wrapper's close() serializes that.
 void srtb_writer_destroy(WriterPool* pool) {
   if (!pool) return;
   {
-    std::lock_guard<std::mutex> lk(pool->mu);
+    std::unique_lock<std::mutex> lk(pool->mu);
     pool->stopping = true;
+    pool->cv_push.notify_all();
+    pool->cv_drain.notify_all();  // wake backpressure waiters in submit
+    pool->cv_drain.wait(lk, [&] { return pool->active_submitters == 0; });
   }
-  pool->cv_push.notify_all();
+  pool->cv_push.notify_all();  // workers may have missed the first notify
   for (auto& t : pool->threads) t.join();
   delete pool;
 }

@@ -129,6 +129,14 @@ def _chirp_phase_df64(n: int, f_min: float, df: float, f_c: float, dm,
     of even a few samples at 2^27 channels shifts the phase by whole
     turns (k ~ 1e9 turns scales as ~k/f per MHz).
     """
+    # int32 channel indices: silently wrong at/beyond 2^31 channels.
+    # i0 may be traced (shard-local offset); guard what is static here.
+    if isinstance(i0, (int, np.integer)):
+        if i0 + n > 2**31 - 1:
+            raise ValueError(
+                f"channel index i0+n = {i0 + n} overflows int32")
+    elif n > 2**31 - 1:
+        raise ValueError(f"n = {n} overflows int32 channel indices")
     i_int = jnp.arange(n, dtype=jnp.int32) + jnp.int32(i0)
     # hi is a multiple of 2^12 (exact in f32 up to 2^36), lo < 2^12
     i_hi = (i_int & ~0xFFF).astype(jnp.float32)

@@ -43,16 +43,27 @@ def c2c_backward(x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
     return jnp.fft.ifft(x, axis=axis, norm="forward")
 
 
-def waterfall_c2c(spectrum: jnp.ndarray, channel_count: int) -> jnp.ndarray:
+def waterfall_c2c(spectrum: jnp.ndarray, channel_count: int,
+                  dewindow: jnp.ndarray | None = None) -> jnp.ndarray:
     """Dedispersed spectrum (n/2 complex) -> dynamic spectrum
     ``[channel_count, watfft_len]`` via per-row unnormalized backward C2C
     (ref: fft_pipe.hpp:285-372).  Rows are coarse frequency channels; columns
-    are time samples within the segment."""
+    are time samples within the segment.
+
+    ``dewindow``: watfft_len divisors to de-apply after the backward
+    transform, as the reference does for non-rectangle windows
+    (ref: fft_pipe.hpp:346-359).  Callers must pass *pre-sanitized*
+    coefficients from ``window.dewindow_coefficients`` (zero hann edges
+    already replaced by 1 — the single home of that guard).
+    """
     n = spectrum.shape[-1]
     watfft_len = n // channel_count
     x = spectrum[..., :channel_count * watfft_len]
     x = x.reshape(*spectrum.shape[:-1], channel_count, watfft_len)
-    return c2c_backward(x, axis=-1)
+    wf = c2c_backward(x, axis=-1)
+    if dewindow is not None:
+        wf = wf / dewindow
+    return wf
 
 
 def ifft_refft_waterfall(spectrum: jnp.ndarray, channel_count: int,

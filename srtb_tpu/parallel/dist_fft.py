@@ -30,6 +30,8 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from srtb_tpu.ops.fft import _phase_exp
+
 
 def _local_transpose_a2a(x_block, axis_name, n_dev):
     """Global [R, C] -> [C, R] transpose of a row-sharded matrix:
@@ -58,13 +60,17 @@ def _dist_fft_block(x_block, *, axis_name, n1, n2, n_dev, inverse):
         bt = jnp.fft.ifft(at, axis=-1, norm="forward")
     else:
         bt = jnp.fft.fft(at, axis=-1)
-    # twiddle: row j2 (global), column k1: exp(sign*pi*... k1*j2/n)
+    # twiddle: row j2 (global), column k1: exp(sign*2*pi*i*k1*j2/n).
+    # The residue k1*j2 < n1*n2 = n fits int32 exactly for n <= 2^30, and
+    # _phase_exp splits it hi/lo so the f32 phase stays exact at large n
+    # (same precision discipline as ops/fft.py:_twiddle; a plain f32
+    # ratio product here diverges for shards >= 2^24).
     idx = jax.lax.axis_index(axis_name)
-    j2 = idx * (n2 // n_dev) + jnp.arange(n2 // n_dev)
-    k1 = jnp.arange(n1)
-    phase = (j2[:, None].astype(jnp.float32) / np.float32(n1)) \
-        * (k1[None, :].astype(jnp.float32) / np.float32(n2))
-    tw = jnp.exp(jnp.asarray(sign * np.pi, dtype=bt.dtype) * phase)
+    j2 = (idx * (n2 // n_dev)
+          + jax.lax.iota(jnp.int32, n2 // n_dev)).astype(jnp.int32)
+    k1 = jax.lax.iota(jnp.int32, n1)
+    r = j2[:, None] * k1[None, :]
+    tw = _phase_exp(r, n1 * n2, 1.0 if inverse else -1.0)
     bt = bt * tw
 
     # transpose back: rows k1 local again
@@ -85,6 +91,11 @@ def dist_fft(x, mesh: Mesh, axis_name: str = "seq",
     order with the same sharding."""
     n = x.shape[-1]
     n_dev = mesh.shape[axis_name]
+    if n > 1 << 30:
+        # the twiddle residue j2*k1 is int32; products stay < n, so 2^30
+        # is a safe static ceiling (2^31 would need int64 residues)
+        raise ValueError(f"n={n} exceeds the int32 twiddle-residue ceiling "
+                         "of 2^30; split the segment or use int64 residues")
     log2n = n.bit_length() - 1
     n1 = 1 << (log2n // 2)
     n2 = n // n1
@@ -124,9 +135,11 @@ def _dist_rfft_post_block(zf_block, *, axis_name, m, n_dev):
     even = 0.5 * (f_k + f_mk)
     odd = -0.5j * (f_k - f_mk)
     idx = jax.lax.axis_index(axis_name)
-    k = idx * (m // n_dev) + jnp.arange(m // n_dev)
-    w = jnp.exp(jnp.asarray(-1j * np.pi, dtype=zf_block.dtype)
-                * (k.astype(jnp.float32) / np.float32(m)))
+    k = (idx * (m // n_dev)
+         + jax.lax.iota(jnp.int32, m // n_dev)).astype(jnp.int32)
+    # w[k] = exp(-i*pi*k/m) = exp(-2*pi*i*k/(2m)) via the exact hi/lo
+    # phase split (a raw f32 k/m loses bits of phase for m >= 2^24).
+    w = _phase_exp(k, 2 * m, -1.0)
     return even + w * odd
 
 

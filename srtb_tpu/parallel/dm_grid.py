@@ -62,14 +62,15 @@ def build_chirp_bank(dm_list, n_spectrum: int, f_min: float, df: float,
 
 
 def _trial_body(spec_ri, chirp_block, *, channel_count, time_reserved_count,
-                snr_threshold, max_boxcar_length, sk_threshold):
+                snr_threshold, max_boxcar_length, sk_threshold,
+                dewindow=None):
     """Per-device: run all local DM trials on the replicated spectrum."""
     spec = jax.lax.complex(spec_ri[0], spec_ri[1])
 
     def one(chirp_ri):
         chirp = jax.lax.complex(chirp_ri[0], chirp_ri[1])
         s = dd.dedisperse(spec, chirp)
-        wf = F.waterfall_c2c(s, channel_count)
+        wf = F.waterfall_c2c(s, channel_count, dewindow)
         wf = rfi.mitigate_rfi_spectral_kurtosis(wf, sk_threshold)
         r = det.detect(wf, time_reserved_count, snr_threshold,
                        max_boxcar_length)
@@ -81,19 +82,24 @@ def _trial_body(spec_ri, chirp_block, *, channel_count, time_reserved_count,
 def dm_trial_search(spectrum_ri: jnp.ndarray, chirp_bank: jnp.ndarray,
                     dm_list, mesh: Mesh, *, channel_count: int,
                     time_reserved_count: int, snr_threshold: float,
-                    max_boxcar_length: int,
-                    sk_threshold: float) -> DMTrialResult:
+                    max_boxcar_length: int, sk_threshold: float,
+                    dewindow=None) -> DMTrialResult:
     """Run the DM grid on one segment's (RFI-cleaned) spectrum.
 
     ``spectrum_ri`` [2, n_spectrum] (re, im) is replicated (XLA broadcasts
     it over ICI); ``chirp_bank`` [n_dm, 2, n_spectrum] is sharded over the
-    ``dm`` axis.
+    ``dm`` axis.  ``dewindow``: pre-sanitized watfft-window divisors
+    (window.dewindow_coefficients) when the spectrum was produced with a
+    non-rectangle window — keeps this path consistent with the single-chip
+    and DistSegmentProcessor paths.
     """
     body = partial(_trial_body, channel_count=channel_count,
                    time_reserved_count=time_reserved_count,
                    snr_threshold=snr_threshold,
                    max_boxcar_length=max_boxcar_length,
-                   sk_threshold=sk_threshold)
+                   sk_threshold=sk_threshold,
+                   dewindow=None if dewindow is None
+                   else jnp.asarray(dewindow))
     fn = shard_map(body, mesh=mesh,
                    in_specs=(P(), P("dm", None, None)),
                    out_specs=P("dm"))

@@ -32,6 +32,7 @@ from srtb_tpu.ops import dedisperse as dd
 from srtb_tpu.ops import detect as det
 from srtb_tpu.ops import rfi
 from srtb_tpu.ops import unpack as U
+from srtb_tpu.ops import window as W
 from srtb_tpu.parallel import dist_fft as DF
 from srtb_tpu.parallel import dm_grid
 
@@ -56,7 +57,8 @@ class DistSegmentProcessor:
     trial list."""
 
     def __init__(self, cfg: Config, mesh: Mesh, dm_list=None,
-                 chirp_on_device: bool | None = None):
+                 chirp_on_device: bool | None = None,
+                 window_name: str = W.DEFAULT_WINDOW):
         self.cfg = cfg
         self.mesh = mesh
         self.fmt = formats.resolve(cfg.baseband_format_type)
@@ -104,6 +106,16 @@ class DistSegmentProcessor:
             mask = np.zeros(self.n_spectrum, dtype=bool)
         self.rfi_mask = _put_sharded(mask, NamedSharding(mesh, P("seq")))
 
+        # unpack window, sharded over seq (each device windows its own
+        # contiguous sample block); watfft-length de-window divided out of
+        # the dynamic spectrum after the per-row backward C2C, same as the
+        # single-chip path (ref: fft_pipe.hpp:346-359)
+        win = W.window_coefficients(window_name, n)
+        self.window = None if win is None \
+            else _put_sharded(win, NamedSharding(mesh, P("seq")))
+        watfft_dewindow = W.dewindow_coefficients(window_name,
+                                                  self.watfft_len)
+
         self.norm_coeff = rfi.normalization_coefficient(
             self.n_spectrum, self.channel_count)
         self.nsamps_reserved = dd.nsamps_reserved(cfg)
@@ -115,6 +127,8 @@ class DistSegmentProcessor:
             nbits=cfg.baseband_input_bits,
             n=self.n, n_seq=self.n_seq, n_dm_dev=self.n_dm_devices,
             chirp_on_device=chirp_on_device,
+            has_window=self.window is not None,
+            watfft_dewindow=watfft_dewindow,
             f_min=f_min, f_c=f_c, df=df,
             n_spectrum=self.n_spectrum,
             channel_count=self.channel_count,
@@ -130,23 +144,30 @@ class DistSegmentProcessor:
         # time series stays dm-sharded
         chirp_spec = P("dm", None) if chirp_on_device \
             else P("dm", None, "seq")
+        in_specs = [P("seq"), chirp_spec, P("seq")]
+        if self.window is not None:
+            in_specs.append(P("seq"))
         self._step = jax.jit(shard_map(
             body, mesh=mesh,
-            in_specs=(P("seq"), chirp_spec, P("seq")),
+            in_specs=tuple(in_specs),
             out_specs=(P(), P(), P(), P("dm"))))
 
     # ------------------------------------------------------------------
 
     @staticmethod
-    def _body(raw_block, chirp_block, mask_block, *, variant, nbits, n,
+    def _body(raw_block, chirp_block, mask_block, *rest, variant, nbits, n,
               n_seq, n_dm_dev, chirp_on_device, f_min, f_c, df,
               n_spectrum, channel_count, norm_coeff,
               avg_threshold, sk_threshold, time_reserved_count,
-              snr_threshold, max_boxcar_length):
+              snr_threshold, max_boxcar_length,
+              has_window=False, watfft_dewindow=None):
         from srtb_tpu.pipeline.segment import unpack_streams
 
-        # ---- unpack (local; interleave patterns repeat within shards) ----
-        xs = unpack_streams(raw_block, variant, nbits, None)  # [S, n/n_seq]
+        # ---- unpack (local; each device windows its own contiguous
+        # sample block with its seq-shard of the global window) ----
+        window_block = rest[0] if has_window else None
+        xs = unpack_streams(raw_block, variant, nbits,
+                            window_block)             # [S, n/n_seq]
         n_streams = xs.shape[0]
 
         # ---- distributed R2C FFT per stream, drop Nyquist ----
@@ -192,6 +213,8 @@ class DistSegmentProcessor:
             # local channels are complete contiguous sub-bands
             wf = s.reshape(n_streams, ch_local, wlen)
             wf = jnp.fft.ifft(wf, axis=-1, norm="forward")
+            if watfft_dewindow is not None:
+                wf = wf / watfft_dewindow
             wf = rfi.mitigate_rfi_spectral_kurtosis(wf, sk_threshold)
             # global zapped-channel count per stream
             zero_count = jax.lax.psum(
@@ -240,5 +263,8 @@ class DistSegmentProcessor:
     def process(self, raw) -> DistSegmentResult:
         raw = _put_sharded(np.asarray(raw, dtype=np.uint8),
                            NamedSharding(self.mesh, P("seq")))
-        out = self._step(raw, self.chirp_bank, self.rfi_mask)
+        args = [raw, self.chirp_bank, self.rfi_mask]
+        if self.window is not None:
+            args.append(self.window)
+        out = self._step(*args)
         return DistSegmentResult(*out)

@@ -41,16 +41,25 @@ class PipelineStats:
         return self.samples / self.elapsed_s / 1e6 if self.elapsed_s else 0.0
 
 
-def has_signal(cfg: Config, detect_result, stream: int | None = None) -> bool:
+def has_signal(cfg: Config, detect_result, stream: int | None = None,
+               frequency_bin_count: int | None = None) -> bool:
     """The reference's gating: skip when too many channels are zapped
     (ref: signal_detect_pipe.hpp:343-345), else positive when any boxcar
-    fired."""
+    fired.
+
+    ``frequency_bin_count`` is the *actual* row count of the waterfall the
+    detection ran on (the reference reads it off the work item,
+    signal_detect_pipe.hpp:343-345); callers that have the waterfall should
+    pass its shape so a trimmed or alternate-path spectrum doesn't silently
+    mis-scale the gate.  Falls back to the configured channel count.
+    """
     zero_count = np.asarray(detect_result.zero_count)
     counts = np.asarray(detect_result.signal_counts)
     if zero_count.ndim == 0:
         zero_count = zero_count[None]
         counts = counts[None]
-    freq_bins = cfg.spectrum_channel_count
+    freq_bins = (frequency_bin_count if frequency_bin_count is not None
+                 else cfg.spectrum_channel_count)
     ok = zero_count < cfg.signal_detect_channel_threshold * freq_bins
     fired = counts.sum(axis=-1) > 0
     per_stream = ok & fired
@@ -113,7 +122,10 @@ class Pipeline:
                 segment=seg,
                 waterfall=wf if self.keep_waterfall else None,
                 detect=det_res)
-            positive = has_signal(cfg, det_res)
+            positive = has_signal(
+                cfg, det_res,
+                frequency_bin_count=(wf.shape[-2] if wf is not None
+                                     else None))
             if positive:
                 self.stats.signals += 1
                 log.info("[pipeline] signal detected in segment "
@@ -299,7 +311,10 @@ class ThreadedPipeline(Pipeline):
                 segment=seg,
                 waterfall=wf if self.keep_waterfall else None,
                 detect=det_res)
-            positive = has_signal(cfg, det_res)
+            positive = has_signal(
+                cfg, det_res,
+                frequency_bin_count=(wf.shape[-2] if wf is not None
+                                     else None))
             if positive:
                 self.stats.signals += 1
             for sink in self.sinks:

@@ -70,6 +70,12 @@ class SegmentProcessor:
         # ---- precomputed constants ----
         win = W.window_coefficients(window_name, n)
         self.window = None if win is None else jnp.asarray(win)
+        # watfft-length window to divide out of the dynamic spectrum after
+        # the backward C2C (ref: fft_pipe.hpp:346-359); zero edges already
+        # sanitized to 1 by dewindow_coefficients
+        wat_win = W.dewindow_coefficients(window_name, self.watfft_len)
+        self.watfft_dewindow = None if wat_win is None \
+            else jnp.asarray(wat_win)
 
         f_min, f_c, df = dd.spectrum_frequencies(cfg, self.n_spectrum)
         self.f_min, self.f_c, self.df = f_min, f_c, df
@@ -140,7 +146,8 @@ class SegmentProcessor:
         else:
             chirp = jax.lax.complex(chirp_ri[0], chirp_ri[1])
             spec = dd.dedisperse(spec, chirp)
-        wf = F.waterfall_c2c(spec, self.channel_count)  # [S, F, T]
+        wf = F.waterfall_c2c(spec, self.channel_count,
+                             self.watfft_dewindow)      # [S, F, T]
         if use_pallas and pk.sk_tiling_ok(wf.shape[-2], wf.shape[-1]):
             zapped, zero_counts, ts_rows = [], [], []
             for s in range(n_streams):

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from srtb_tpu.utils.logging import log
+from srtb_tpu.utils.platform import apply_platform_env
 
 
 def correlate(x1: np.ndarray, x2: np.ndarray) -> np.ndarray:
@@ -38,6 +39,7 @@ def correlate(x1: np.ndarray, x2: np.ndarray) -> np.ndarray:
 
 
 def main(argv=None) -> int:
+    apply_platform_env()
     argv = sys.argv[1:] if argv is None else argv
     in_file_1 = argv[0] if len(argv) > 0 else "pol_1.bin"
     in_file_2 = argv[1] if len(argv) > 1 else "pol_2.bin"

@@ -22,6 +22,7 @@ import json
 import time
 
 import numpy as np
+from srtb_tpu.utils.platform import apply_platform_env
 
 
 def _time(fn, *args, reps=5):
@@ -38,6 +39,7 @@ def _time(fn, *args, reps=5):
 
 
 def main(argv=None) -> int:
+    apply_platform_env()
     p = argparse.ArgumentParser()
     p.add_argument("--log2n", type=int, default=28,
                    help="segment size driving the kernel shapes")

@@ -11,6 +11,7 @@ import glob
 import sys
 
 import numpy as np
+from srtb_tpu.utils.platform import apply_platform_env
 
 
 def plot_one(path: str) -> str:
@@ -40,6 +41,7 @@ def plot_one(path: str) -> str:
 
 
 def main(argv=None) -> int:
+    apply_platform_env()
     argv = sys.argv[1:] if argv is None else argv
     paths = []
     for pattern in (argv or ["*.npy"]):

@@ -1,0 +1,26 @@
+"""Honor JAX_PLATFORMS in environments that force a platform plugin.
+
+Some deployments force an accelerator platform via ``jax.config`` at
+interpreter startup (sitecustomize); programmatic config wins over the
+``JAX_PLATFORMS`` environment variable, so ``JAX_PLATFORMS=cpu <tool>``
+silently still targets the (possibly unreachable) accelerator.  Every
+entry point calls :func:`apply_platform_env` before first device use to
+restore the documented env-var semantics (same dance as
+tests/conftest.py and bench.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_env() -> None:
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if not platforms:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", platforms)
+    except Exception:  # backend already initialized: keep whatever is up
+        pass

@@ -21,8 +21,38 @@ def test_bench_emits_one_json_line(tmp_path):
              if ln.startswith("{")]
     assert len(lines) == 1, out.stdout
     rec = json.loads(lines[0])
-    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
+    # roofline fields (PERF.md): fast must be falsifiable.  roofline_frac
+    # itself only appears on accelerator runs (no v5e peak to compare a
+    # CPU measurement against)
+    assert {"achieved_gbps", "model_gflops", "model_hbm_gb"} <= set(rec)
+    assert rec["achieved_gbps"] > 0
+
+
+def test_bench_survives_unreachable_accelerator(tmp_path):
+    """The round-1 failure mode: accelerator backend init hangs/crashes.
+    bench.py must still exit 0 with one JSON line (CPU fallback)."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    # pin the probe to a platform that cannot exist so the fallback branch
+    # runs deterministically on any machine, healthy accelerator or not
+    env["SRTB_BENCH_PROBE_PLATFORM"] = "no_such_platform"
+    env["SRTB_BENCH_INIT_TIMEOUT"] = "30"
+    env["SRTB_BENCH_LOG2N"] = "16"  # small on every platform
+    out = subprocess.run(
+        [sys.executable, os.path.join(env["PYTHONPATH"], "bench.py")],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert len(lines) == 1, out.stdout
+    rec = json.loads(lines[0])
+    assert rec["value"] > 0  # CPU fallback still measured something
+    assert rec["platform"] == "cpu"
+    assert rec.get("accelerator_error"), rec  # fallback branch really ran
 
 
 def test_kernel_bench_runs():

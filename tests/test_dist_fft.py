@@ -43,6 +43,38 @@ def test_dist_rfft(seq_mesh8, log2n):
                                rtol=1e-3, atol=3e-2 * np.sqrt(n))
 
 
+def test_dist_fft_large_n_twiddle_precision(seq_mesh8):
+    """At n >= 2^24 a twiddle phase computed as a plain f32 ratio product
+    loses enough mantissa to corrupt whole bins; the hi/lo integer-split
+    phase (ops/fft.py:_phase_exp) must hold relative RMS error near f32
+    roundoff against a float64 oracle."""
+    n = 1 << 24
+    rng = np.random.default_rng(24)
+    x64 = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+    x = x64.astype(np.complex64)
+    got = np.asarray(DF.dist_fft(jnp.asarray(x), seq_mesh8))
+    expected = np.fft.fft(x64)  # float64 oracle
+    rel_rms = (np.linalg.norm(got - expected)
+               / np.linalg.norm(expected))
+    # exact twiddles leave only local-FFT f32 roundoff (~1e-6 * sqrt(log n));
+    # the old f32 ratio-product twiddle fails this by orders of magnitude
+    assert rel_rms < 5e-6, f"rel RMS {rel_rms:.2e}"
+
+
+def test_dist_rfft_large_n_twiddle_precision(seq_mesh8):
+    """Same large-n precision discipline for the Hermitian post-process
+    twiddle exp(-i*pi*k/m) of the distributed R2C."""
+    n = 1 << 24
+    rng = np.random.default_rng(42)
+    x64 = rng.standard_normal(n)
+    x = x64.astype(np.float32)
+    got = np.asarray(DF.dist_rfft_drop_nyquist(jnp.asarray(x), seq_mesh8))
+    expected = np.fft.rfft(x64)[:-1]  # float64 oracle
+    rel_rms = (np.linalg.norm(got - expected)
+               / np.linalg.norm(expected))
+    assert rel_rms < 5e-6, f"rel RMS {rel_rms:.2e}"
+
+
 def test_dist_fft_output_sharding(seq_mesh8):
     n = 1 << 12
     x = jnp.asarray(np.random.default_rng(0).standard_normal(n)

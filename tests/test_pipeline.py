@@ -91,6 +91,62 @@ def test_pipeline_without_dedispersion_misses_pulse(synthetic_cfg, tmp_path):
     assert stats.signals == 0
 
 
+def test_hamming_window_waterfall_matches_numpy_oracle():
+    """Non-rectangle windows must be applied at unpack AND divided back out
+    of the dynamic spectrum after the backward C2C (ref: fft_pipe.hpp:
+    346-359) — a float64 numpy transliteration of the whole chain is the
+    oracle."""
+    from srtb_tpu.ops import rfi as R
+    from srtb_tpu.ops import window as W
+    from srtb_tpu.pipeline.segment import waterfall_to_numpy
+
+    n, channels = 1 << 12, 1 << 5
+    rng = np.random.default_rng(3)
+    raw = rng.integers(0, 256, size=n, dtype=np.uint8)
+    cfg = Config(
+        baseband_input_count=n, baseband_input_bits=8,
+        baseband_format_type="simple", baseband_freq_low=1405.0,
+        baseband_bandwidth=64.0, baseband_sample_rate=128e6, dm=0.0,
+        spectrum_channel_count=channels,
+        signal_detect_max_boxcar_length=8,
+        mitigate_rfi_average_method_threshold=1e9,
+        mitigate_rfi_spectral_kurtosis_threshold=1e9,
+        baseband_reserve_sample=False)
+    proc = SegmentProcessor(cfg, window_name="hamming")
+    wf = waterfall_to_numpy(proc.process(raw)[0])[0]
+
+    # numpy float64 oracle (dm=0 -> unit chirp; RFI thresholds disabled)
+    x = raw.astype(np.float64) * W.window_coefficients(
+        "hamming", n, dtype=np.float64)
+    spec = np.fft.rfft(x)[:-1] * R.normalization_coefficient(
+        n // 2, channels)
+    wlen = (n // 2) // channels
+    expect = np.fft.ifft(spec.reshape(channels, wlen), axis=-1) * wlen
+    expect = expect / W.window_coefficients("hamming", wlen,
+                                            dtype=np.float64)
+    np.testing.assert_allclose(wf, expect.astype(np.complex64),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_hann_window_zero_edges_stay_finite():
+    """Hann coefficients are exactly zero at the row edges; the de-apply
+    must not produce inf/nan there (guarded division — the one deliberate
+    deviation from the reference's raw divide)."""
+    n, channels = 1 << 12, 1 << 5
+    raw = np.random.default_rng(4).integers(0, 256, size=n, dtype=np.uint8)
+    cfg = Config(
+        baseband_input_count=n, baseband_input_bits=8,
+        baseband_format_type="simple", baseband_freq_low=1405.0,
+        baseband_bandwidth=64.0, baseband_sample_rate=128e6, dm=5.0,
+        spectrum_channel_count=channels,
+        signal_detect_max_boxcar_length=8,
+        baseband_reserve_sample=False)
+    proc = SegmentProcessor(cfg, window_name="hann")
+    wf_ri, res = proc.process(raw)
+    assert np.isfinite(np.asarray(wf_ri)).all()
+    assert np.isfinite(np.asarray(res.time_series)).all()
+
+
 def test_has_signal_channel_threshold_gate():
     """When too many channels are zapped the segment must be ignored
     (ref: signal_detect_pipe.hpp:343-345)."""

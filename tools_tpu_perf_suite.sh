@@ -19,11 +19,15 @@ run() {
   fi
 }
 
-run baseline                    python bench.py
-SRTB_BENCH_USE_PALLAS=1         run pallas python bench.py
-SRTB_BENCH_FFT_STRATEGY=four_step run four_step python bench.py
-SRTB_BENCH_LOG2N=28             run n2_28 python bench.py
-SRTB_BENCH_LOG2N=29             run n2_29 python bench.py
+run baseline   python bench.py
+run pallas     env SRTB_BENCH_USE_PALLAS=1 python bench.py
+run four_step  env SRTB_BENCH_FFT_STRATEGY=four_step python bench.py
+run monolithic env SRTB_BENCH_FFT_STRATEGY=monolithic python bench.py
+run n2_28      env SRTB_BENCH_LOG2N=28 python bench.py
+run n2_29      env SRTB_BENCH_LOG2N=29 python bench.py
+run n2_30      env SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 python bench.py
+run n2_30_4s   env SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 \
+                   SRTB_BENCH_FFT_STRATEGY=four_step python bench.py
 
 echo "== kernel bench ==" | tee -a /dev/stderr
 python -m srtb_tpu.tools.kernel_bench --log2n 28 --reps 5 2>/dev/null \
