@@ -109,14 +109,11 @@ def roofline_model(n: int, channel_count: int, nbits: int):
     return flops, bytes_moved
 
 
-def run_bench(platform, platform_error):
+def run_bench(platform_error):
     import jax
 
-    # some environments force a platform via jax.config at interpreter
-    # startup (sitecustomize) — programmatic config beats JAX_PLATFORMS,
-    # so the fallback must be forced back the same way (see
-    # tests/conftest.py for the same dance)
-    jax.config.update("jax_platforms", platform)
+    from srtb_tpu.utils.platform import apply_platform_env
+    apply_platform_env()  # main() put the chosen platform in JAX_PLATFORMS
 
     from srtb_tpu.config import Config
     from srtb_tpu.pipeline.segment import SegmentProcessor
@@ -214,7 +211,7 @@ def main():
     platform, err = pick_platform()
     os.environ["JAX_PLATFORMS"] = platform
     try:
-        run_bench(platform, err)
+        run_bench(err)
     except Exception as e:  # always land a JSON diagnostic, never rc != 0
         emit({
             "metric": "coherent_dedispersion_pipeline_throughput",

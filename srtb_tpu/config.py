@@ -52,6 +52,11 @@ class Config:
     udp_receiver_address: list = field(default_factory=lambda: ["10.0.1.2"])
     udp_receiver_port: list = field(default_factory=lambda: [12004])
     udp_receiver_cpu_preferred: list = field(default_factory=lambda: [0])
+    # "block": counter-aligned blocks with reorder tolerance
+    # (udp_receive_block_worker, ref: udp_receiver.hpp:180-272);
+    # "continuous": strictly sequential gap-free stream, payloads straddle
+    # segment boundaries (continuous_udp_receiver_worker, ref: 42-168)
+    udp_receiver_mode: str = "block"
 
     input_file_path: str = ""
     input_file_offset_bytes: int = 0

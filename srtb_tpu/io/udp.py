@@ -65,6 +65,15 @@ def counter_kind_for(fmt: formats.PacketFormat) -> int:
     return COUNTER_VDIF67 if fmt.name.startswith("gznupsr") else COUNTER_LE64
 
 
+def parse_packet_counter(fmt: formats.PacketFormat, pkt: bytes) -> int:
+    """Packet counter from the header (LE64 at offset 0, or VDIF words
+    6|7 for gznupsr formats — ref: io/udp/udp_receiver.hpp backends)."""
+    if counter_kind_for(fmt) == COUNTER_VDIF67:
+        w6, w7 = struct.unpack_from("<2I", pkt, 24)
+        return w6 | (w7 << 32)
+    return struct.unpack_from("<Q", pkt)[0]
+
+
 class NativeBlockReceiver:
     """Block receiver backed by the C++ recvmmsg implementation."""
 
@@ -130,10 +139,7 @@ class PythonBlockReceiver:
         self.lost_packets = 0
 
     def _parse_counter(self, pkt: bytes) -> int:
-        if counter_kind_for(self.fmt) == COUNTER_VDIF67:
-            w6, w7 = struct.unpack_from("<2I", pkt, 24)
-            return w6 | (w7 << 32)
-        return struct.unpack_from("<Q", pkt)[0]
+        return parse_packet_counter(self.fmt, pkt)
 
     def receive_block(self, out: np.ndarray) -> tuple[int, int, int]:
         fmt = self.fmt
@@ -179,6 +185,110 @@ class PythonBlockReceiver:
         self._sock.close()
 
 
+class PythonContinuousReceiver:
+    """The reference's *continuous* receive worker
+    (continuous_udp_receiver_worker, ref: io/udp/udp_receiver.hpp:42-168),
+    as opposed to the block worker above: packets are consumed strictly
+    sequentially, a packet's payload may straddle block boundaries (the
+    unread tail carries over to the next call), and lost packets are
+    zero-filled inline — ``lost * payload`` zeros injected exactly where
+    the missing data would have been, also carrying across calls.  This
+    keeps the delivered byte stream gap-free and continuous, at the cost
+    of no reorder tolerance.
+
+    Deviation from the reference: a late/duplicate packet (counter <=
+    last seen) is dropped instead of underflowing the unsigned lost-count
+    arithmetic (udp_receiver.hpp:135 would zero-fill ~2^64 bytes).
+    """
+
+    def __init__(self, addr: str, port: int, fmt: formats.PacketFormat,
+                 rcvbuf_bytes: int = 1 << 26):
+        self.fmt = fmt
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                                  rcvbuf_bytes)
+        except OSError:
+            pass
+        self._sock.bind((addr, port))
+        self._leftover = b""     # unread payload tail of the last packet
+        self._zeros_pending = 0  # zero-fill bytes still owed to the stream
+        self._last_counter: int | None = None
+        self.total_packets = 0
+        self.lost_packets = 0
+
+    def receive_block(self, out: np.ndarray) -> tuple[int, int, int]:
+        """Fill ``out`` (uint8, any size) with the next stretch of the
+        continuous stream.  Returns (block_counter, lost,
+        packets_received_this_call).
+
+        ``block_counter`` is the counter of the packet the block's FIRST
+        BYTE belongs to — when the block opens with carried-over payload
+        it is the carried packet's counter, and when it opens inside a
+        zero-filled gap it is the (lost) counter that gap stands for.
+        (The reference returns the first counter *received during the
+        call* instead, udp_receiver.hpp:77-86; that labels straddled
+        segments off by the carryover length, so downstream
+        ``counter * payload`` time reconstruction would drift — a
+        deliberate improvement, not an oversight.)
+        """
+        fmt = self.fmt
+        payload = fmt.payload_bytes
+        cap = out.nbytes
+        pos = 0
+        if self._zeros_pending > 0 and self._last_counter is not None:
+            # block opens inside the zero-filled gap that precedes
+            # _last_counter's payload: gap packets count back from it
+            gap_packets = -(-self._zeros_pending // payload)  # ceil
+            first_counter = self._last_counter - gap_packets
+        elif self._leftover:
+            first_counter = self._last_counter
+        else:
+            first_counter = None  # set by the first packet received
+        seen = 0
+        lost_this = 0
+        while pos < cap:
+            if self._zeros_pending > 0:
+                k = min(self._zeros_pending, cap - pos)
+                out[pos:pos + k] = 0
+                self._zeros_pending -= k
+                pos += k
+            elif self._leftover:
+                k = min(len(self._leftover), cap - pos)
+                out[pos:pos + k] = np.frombuffer(self._leftover, np.uint8,
+                                                 count=k)
+                self._leftover = self._leftover[k:]
+                pos += k
+            else:
+                pkt, _ = self._sock.recvfrom(fmt.packet_payload_size + 64)
+                if len(pkt) < fmt.packet_payload_size:
+                    continue
+                c = parse_packet_counter(fmt, pkt)
+                if self._last_counter is None:
+                    lost = 0
+                elif c > self._last_counter:
+                    lost = c - self._last_counter - 1
+                else:
+                    continue  # late/duplicate: stream already moved past
+                if first_counter is None:
+                    first_counter = c
+                seen += 1
+                lost_this += lost
+                self._zeros_pending += lost * payload
+                self._last_counter = c
+                self._leftover = pkt[
+                    fmt.packet_header_size:fmt.packet_header_size + payload]
+        self.total_packets += seen
+        self.lost_packets += lost_this
+        if first_counter is None:
+            first_counter = self._last_counter or 0
+        return first_counter, lost_this, seen
+
+    def close(self):
+        self._sock.close()
+
+
 class UdpReceiverSource:
     """Yields SegmentWork blocks from a UDP stream
     (ref: pipeline/udp_receiver_pipe.hpp:106-155)."""
@@ -194,14 +304,26 @@ class UdpReceiverSource:
             min(receiver_id, len(cfg.udp_receiver_address) - 1)]
         port = cfg.udp_receiver_port[
             min(receiver_id, len(cfg.udp_receiver_port) - 1)]
+        mode = getattr(cfg, "udp_receiver_mode", "block")
+        if mode not in ("block", "continuous"):
+            raise ValueError(f"unknown udp_receiver_mode {mode!r}")
         if use_native is None:
-            use_native = _NATIVE is not None
-        cls = NativeBlockReceiver if use_native else PythonBlockReceiver
+            use_native = _NATIVE is not None and mode == "block"
+        if mode == "continuous":
+            # the continuous worker is sequential by construction; the
+            # native recvmmsg path currently implements only the block
+            # worker (its recvmmsg batching conflicts with strict
+            # in-order straddling delivery)
+            cls = PythonContinuousReceiver
+        else:
+            cls = NativeBlockReceiver if use_native else PythonBlockReceiver
         self.receiver = cls(addr, port, self.fmt)
         self.data_stream_id = receiver_id
         self.segment_bytes = cfg.segment_bytes(self.fmt.data_stream_count)
         payload = self.fmt.payload_bytes
-        if self.segment_bytes % payload:
+        if mode == "block" and self.segment_bytes % payload:
+            # the continuous worker straddles packet payloads across
+            # segments, so it has no multiple-of-payload requirement
             raise ValueError(
                 f"segment bytes {self.segment_bytes} not a multiple of "
                 f"packet payload {payload}")

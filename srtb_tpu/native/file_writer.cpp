@@ -154,16 +154,16 @@ int32_t srtb_writer_submit(WriterPool* pool, const char* path,
       pool->queued_bytes += job.data.size();
       pool->jobs.push_back(std::move(job));
       pool->in_flight++;
+      pool->cv_push.notify_one();
     }
     pool->active_submitters--;
-    lk.unlock();
-    // a destroyer may be waiting for active_submitters to reach zero
-    // before freeing the pool — wake it on every exit path
+    // notify while still holding mu: a destroyer waiting for
+    // active_submitters == 0 can then only delete the pool after our
+    // unique_lock releases — no pool access happens after the unlock,
+    // so submit-vs-destroy cannot use freed memory
     pool->cv_drain.notify_all();
-    if (rc != 0) return rc;
+    return rc;
   }
-  pool->cv_push.notify_one();
-  return 0;
 }
 
 // Block until every submitted job has been written (or failed).

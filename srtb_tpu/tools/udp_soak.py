@@ -1,0 +1,144 @@
+"""Loopback UDP ingest soak: measure the receiver's sustained packet rate,
+throughput and loss accounting against the real-time requirement.
+
+The J1644-4559 configuration needs 128 MSa/s x 2 bit = 32 MB/s = 0.256
+Gbit/s of baseband off the wire (ref: srtb_config_1644-4559.cfg:22-29);
+deployment notes in the reference tune 2 GiB socket buffers and ~4096-byte
+MTUs for this (ref: README.md:260-291).  This tool blasts
+counter-sequential packets over loopback as fast as the sender can and
+reports what the receiver actually sustained.
+
+Usage:
+    python -m srtb_tpu.tools.udp_soak [--packets N] [--impl native|python|continuous]
+
+Prints one JSON line:
+  {"pps": ..., "gbps": ..., "payload_bytes": ..., "received": ...,
+   "lost": ..., "loss_rate": ..., "required_gbps": 0.256, "margin": ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+from srtb_tpu.io import formats, udp
+
+# 128 MSa/s * 2 bit / 8 = 32 MB/s of payload
+REQUIRED_GBPS = 128e6 * 2 / 8 * 8 / 1e9
+
+
+def _sender(port: int, fmt, n_packets: int, started: threading.Event,
+            pace_pps: float = 0.0):
+    """Blast (or pace) counter-sequential packets, then trail off with a
+    slow flush so in-progress blocks at the receiver always complete even
+    when tail packets of the main burst were dropped."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.connect(("127.0.0.1", port))
+    payload = b"\xab" * fmt.payload_bytes
+    header_size = fmt.packet_header_size
+
+    def send(c):
+        if header_size >= 8:
+            header = struct.pack("<Q", c) + b"\x00" * (header_size - 8)
+        else:
+            header = b""
+        try:
+            sock.send(header + payload)
+        except OSError:
+            pass  # receiver-side buffer overflow shows up as loss
+
+    started.wait()
+    chunk = 32
+    t0 = time.perf_counter()
+    for c in range(n_packets):
+        send(c)
+        if pace_pps and c % chunk == chunk - 1:
+            target = (c + 1) / pace_pps
+            lag = target - (time.perf_counter() - t0)
+            if lag > 0:
+                time.sleep(lag)
+    # flush: paced trailer packets push any partially-assembled block over
+    # its boundary (these arrive after the timed region ends)
+    for c in range(n_packets, n_packets + 4 * 64):
+        send(c)
+        time.sleep(0.0005)
+    sock.close()
+
+
+def run_soak(n_packets: int = 20000, impl: str = "auto",
+             packets_per_block: int = 64, port: int = 42100,
+             pace_gbps: float = 0.0) -> dict:
+    """``pace_gbps > 0`` throttles the sender to that payload rate —
+    used to demonstrate loss-free ingest at the real-time requirement;
+    0 blasts at full speed to find the ceiling."""
+    fmt = formats.FASTMB_ROACH2  # 8-byte counter header + 4096-byte payload
+    if impl == "auto":
+        impl = "native" if udp._NATIVE is not None else "python"
+    if impl == "native":
+        rx = udp.NativeBlockReceiver("127.0.0.1", port, fmt)
+    elif impl == "continuous":
+        rx = udp.PythonContinuousReceiver("127.0.0.1", port, fmt,
+                                          rcvbuf_bytes=1 << 28)
+    else:
+        rx = udp.PythonBlockReceiver("127.0.0.1", port, fmt,
+                                     rcvbuf_bytes=1 << 28)
+
+    pace_pps = pace_gbps * 1e9 / 8 / fmt.payload_bytes if pace_gbps else 0.0
+    started = threading.Event()
+    sender = threading.Thread(target=_sender,
+                              args=(port, fmt, n_packets, started,
+                                    pace_pps))
+    sender.start()
+
+    block = np.zeros(packets_per_block * fmt.payload_bytes, dtype=np.uint8)
+    n_blocks = n_packets // packets_per_block
+    started.set()
+    t0 = time.perf_counter()
+    received_payload_bytes = 0
+    for _ in range(n_blocks - 1):  # leave sender headroom for the tail
+        rx.receive_block(block)
+        received_payload_bytes += block.nbytes
+    dt = time.perf_counter() - t0
+    sender.join()
+    total, lost = rx.total_packets, rx.lost_packets
+    rx.close()
+
+    gbps = received_payload_bytes * 8 / dt / 1e9
+    pps = received_payload_bytes / fmt.payload_bytes / dt
+    return {
+        "impl": impl,
+        "pace_gbps": pace_gbps,
+        "pps": round(pps),
+        "gbps": round(gbps, 3),
+        "payload_bytes": fmt.payload_bytes,
+        "received": int(total),
+        "lost": int(lost),
+        "loss_rate": round(lost / max(total + lost, 1), 5),
+        "required_gbps": round(REQUIRED_GBPS, 3),
+        "margin": round(gbps / REQUIRED_GBPS, 1),
+        "seconds": round(dt, 3),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--packets", type=int, default=20000)
+    p.add_argument("--impl", default="auto",
+                   choices=["auto", "native", "python", "continuous"])
+    p.add_argument("--port", type=int, default=42100)
+    p.add_argument("--pace-gbps", type=float, default=0.0)
+    args = p.parse_args(argv)
+    print(json.dumps(run_soak(args.packets, args.impl, port=args.port,
+                              pace_gbps=args.pace_gbps)))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())
