@@ -115,6 +115,11 @@ def run_bench(platform_error):
     from srtb_tpu.utils.platform import apply_platform_env
     apply_platform_env()  # main() put the chosen platform in JAX_PLATFORMS
 
+    # FFTW-wisdom analog: reuse compiled programs across bench runs (the
+    # staged 2^30 plan compiles for ~10 min cold, O(seconds) cached)
+    from srtb_tpu.utils.compile_cache import enable_compile_cache
+    enable_compile_cache()
+
     from srtb_tpu.config import Config
     from srtb_tpu.pipeline.segment import SegmentProcessor
 
@@ -154,29 +159,41 @@ def run_bench(platform_error):
     raw = rng.integers(0, 256, size=cfg.segment_bytes(1), dtype=np.uint8)
     raw_dev = jax.device_put(raw)
 
-    # warmup / compile
+    # warmup / compile.  Sync via a host fetch of the (tiny) counts:
+    # on some TPU tunnels block_until_ready returns silently on an
+    # errored async execution — the error only surfaces at value fetch,
+    # and a bench that never fetches would time failures as ~0 s.
     t0 = time.perf_counter()
-    wf, res = proc._jit_process(raw_dev, proc.chirp)
-    jax.block_until_ready(res.signal_counts)
+    wf, res = proc.run_device(raw_dev)
+    np.asarray(res.signal_counts)
     compile_s = time.perf_counter() - t0
+    del wf, res  # a retained 4 GB waterfall would OOM the next 2^30 run
 
     # optional profiler capture of the steady state (xprof format)
     trace_dir = os.environ.get("SRTB_BENCH_TRACE_DIR", "")
     if trace_dir:
         from srtb_tpu.utils.tracing import device_trace
         with device_trace(trace_dir):
-            wf, res = proc._jit_process(raw_dev, proc.chirp)
-            jax.block_until_ready(res.signal_counts)
+            wf, res = proc.run_device(raw_dev)
+            np.asarray(res.signal_counts)
+            del wf, res
 
-    # steady state: time several segments back to back
+    # Steady state: dispatch `reps` segments back to back and sync once.
+    # This measures streaming throughput the way the runtime actually
+    # streams (no host sync between segments); a per-segment host fetch
+    # would add the tunnel's ~60 ms dispatch+sync RTT to every segment
+    # and understate throughput by up to 3x at 2^27.  Dropping each
+    # waterfall handle right after dispatch lets its 4 GB free as soon
+    # as its segment completes (2^30 would OOM otherwise).
     reps = int(os.environ.get("SRTB_BENCH_REPS", "5"))
-    times = []
+    t0 = time.perf_counter()
+    last = None
     for _ in range(reps):
-        t0 = time.perf_counter()
-        wf, res = proc._jit_process(raw_dev, proc.chirp)
-        jax.block_until_ready(res.signal_counts)
-        times.append(time.perf_counter() - t0)
-    dt = min(times)
+        wf, res = proc.run_device(raw_dev)
+        last = res.signal_counts
+        del wf, res
+    np.asarray(last)
+    dt = (time.perf_counter() - t0) / reps
 
     samples_per_sec = n / dt
     msamples = samples_per_sec / 1e6

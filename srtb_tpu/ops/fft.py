@@ -60,7 +60,9 @@ def waterfall_c2c(spectrum: jnp.ndarray, channel_count: int,
     watfft_len = n // channel_count
     x = spectrum[..., :channel_count * watfft_len]
     x = x.reshape(*spectrum.shape[:-1], channel_count, watfft_len)
-    wf = c2c_backward(x, axis=-1)
+    # row lengths beyond the XLA cap (coarse channelizations of long
+    # segments, e.g. [2048, 2^17]) go through the four-step path
+    wf = _fft_minor(x, inverse=True)
     if dewindow is not None:
         wf = wf / dewindow
     return wf
@@ -78,7 +80,7 @@ def ifft_refft_waterfall(spectrum: jnp.ndarray, channel_count: int,
     Output is time-major: [n_chunks(time), channel_count(freq)] — the
     orientation consumed by signal_detect_pipe variant 1.
     """
-    td = c2c_backward(spectrum)
+    td = _fft_minor(spectrum, inverse=True)
     n = td.shape[-1]
     if 0 < nsamps_reserved_complex < n:
         td = td[..., : n - nsamps_reserved_complex]
@@ -138,62 +140,148 @@ def _split_factor(n: int) -> int:
     return 1 << (log2n // 2)
 
 
-def four_step_fft(x: jnp.ndarray, inverse: bool = False) -> jnp.ndarray:
-    """1-D C2C FFT of power-of-two length via the four-step algorithm.
-    Unnormalized in both directions (matching c2c_forward / c2c_backward)."""
+# Longest 1-D (possibly batched) FFT handed to XLA's TPU FFT directly.
+# Measured on a v5e: batched rows of 2^17+ decompose internally to a
+# [..., 128, 128, 8] form whose minor dim pads 8 -> 128 lanes, a 16x HBM
+# blowup that OOMs the chip at pipeline sizes (e.g. waterfall
+# [2048, 2^17] wants 2x16 GB of scratch); 2^16 and below tile cleanly.
+_XLA_FFT_LEN_CAP = 1 << 16
+
+
+def _fft_minor(x: jnp.ndarray, inverse: bool) -> jnp.ndarray:
+    """FFT along the minor (last) axis, recursing into the four-step
+    decomposition for lengths XLA's TPU FFT handles badly."""
+    if x.shape[-1] > _XLA_FFT_LEN_CAP:
+        return four_step_fft(x, inverse)
+    if inverse:
+        return jnp.fft.ifft(x, axis=-1, norm="forward")
+    return jnp.fft.fft(x, axis=-1)
+
+
+def four_step_stage1(x: jnp.ndarray, inverse: bool = False) -> jnp.ndarray:
+    """First half of the four-step FFT: [..., n] -> A[..., n2, k1].
+
+    Splitting the decomposition in two lets very large segments run the
+    two halves as *separate XLA programs* (pipeline/segment.py staged
+    mode), freeing each program's transpose/FFT scratch before the next
+    starts — the difference between fitting and OOMing a 2^30-sample
+    segment in 16 GB of HBM.
+    """
     n = x.shape[-1]
     if n & (n - 1):
         raise ValueError("four_step_fft requires power-of-two length")
     n1 = _split_factor(n)
     n2 = n // n1
-    tw = _twiddle(n1, n2, inverse)
     # view as [n1, n2] row-major: x[j1*n2 + j2]
     a = x.reshape(*x.shape[:-1], n1, n2)
-    # FFT over the n1 axis (columns)
-    if inverse:
-        a = jnp.fft.ifft(a, axis=-2, norm="forward")
-    else:
-        a = jnp.fft.fft(a, axis=-2)
-    a = a * tw
-    if inverse:
-        a = jnp.fft.ifft(a, axis=-1, norm="forward")
-    else:
-        a = jnp.fft.fft(a, axis=-1)
-    # result index k = k2*n1 + k1 -> transpose to linear order
+    # step 1: FFT_n1 over j1 for each j2 — transpose so n1 is minor
+    a = jnp.swapaxes(a, -1, -2)            # [j2, j1]
+    return _fft_minor(a, inverse)          # A[j2, k1]
+
+
+def four_step_stage2(a: jnp.ndarray, inverse: bool = False) -> jnp.ndarray:
+    """Second half of the four-step FFT: A[..., n2, k1] -> X[..., n]."""
+    n2, n1 = a.shape[-2], a.shape[-1]
+    n = n1 * n2
+    # step 2: twiddle w[j2, k1] = exp(-+2*pi*i*j2*k1/n); generated from
+    # iota inside the trace (fuses into the multiply, nothing materialized)
+    a = a * _twiddle(n2, n1, inverse)
+    # step 3: FFT_n2 over j2 for each k1 — transpose so n2 is minor
+    a = jnp.swapaxes(a, -1, -2)            # [k1, j2]
+    a = _fft_minor(a, inverse)             # C[k1, k2]
+    # result index k = k2*n1 + k1 -> [k2, k1] then flatten
     a = jnp.swapaxes(a, -1, -2)
-    return a.reshape(*x.shape[:-1], n)
+    return a.reshape(*a.shape[:-2], n)
 
 
-def rfft_via_c2c(x: jnp.ndarray, use_four_step: bool = False) -> jnp.ndarray:
+def four_step_fft(x: jnp.ndarray, inverse: bool = False) -> jnp.ndarray:
+    """1-D C2C FFT of power-of-two length via the four-step algorithm.
+    Unnormalized in both directions (matching c2c_forward / c2c_backward).
+    Leading dims batch.
+
+    Every sub-FFT runs along the *minor* axis with explicit transposes
+    between steps — XLA's TPU FFT on a non-minor axis (and any row
+    length > 2^16, see _XLA_FFT_LEN_CAP) triggers internal padded
+    reshapes that are both slow and HBM-hungry, so the decomposition
+    keeps the layout work visible: transpose -> batched FFT -> twiddle ->
+    transpose -> batched FFT -> transpose, all row lengths <= 2^16.
+    """
+    return four_step_stage2(four_step_stage1(x, inverse), inverse)
+
+
+def rfft_via_c2c(x: jnp.ndarray, use_four_step: bool = False,
+                 drop_nyquist: bool = False) -> jnp.ndarray:
     """R2C FFT of 2m reals via one m-point C2C plus Hermitian post-process,
-    returning m+1 bins (like rfft).  This is the half-size C2C trick the
-    reference implements in fft/fft_1d_r2c_post_process.hpp:33-82 and
-    naive_fft.hpp:219-261; combined with four_step_fft it covers segment
-    sizes beyond what a monolithic XLA R2C handles."""
+    returning m+1 bins (like rfft), or exactly m bins with
+    ``drop_nyquist`` (the pipeline convention, ref: fft_pipe.hpp:75-77).
+    This is the half-size C2C trick the reference implements in
+    fft/fft_1d_r2c_post_process.hpp:33-82 and naive_fft.hpp:219-261;
+    combined with four_step_fft it covers segment sizes beyond what a
+    monolithic XLA R2C handles.
+
+    ``drop_nyquist`` is not just a convenience: at segment sizes the
+    m+1-bin form concatenates edge bins onto three 2m-byte arrays, and
+    those odd-length copies put the peak HBM of a 2^30-sample compile
+    over a v5e's capacity.  The m-bin form keeps every array exactly
+    length m: F[(m-k) mod m] is a flip + roll that XLA fuses into the
+    elementwise Hermitian combine."""
+    z = pack_even_odd(x)
+    zf = four_step_fft(z) if use_four_step else jnp.fft.fft(z)
+    return hermitian_rfft_post(zf, drop_nyquist)
+
+
+def pack_even_odd(x: jnp.ndarray) -> jnp.ndarray:
+    """Pack 2m reals into m complex (even -> re, odd -> im) for the
+    half-size C2C trick.  NOT x.reshape(m, 2): a materialized [m, 2] f32
+    pads its minor dim 2 -> 128 lanes on TPU (T(8,128) layout), a 64x HBM
+    blowup that OOMs compiles at segment sizes (observed: 128 GB scratch
+    for n = 2^29).  Slicing even/odd lanes out of 256-lane rows keeps
+    every intermediate lane-dense."""
     n = x.shape[-1]
     if n % 2:
         raise ValueError("even length required")
     m = n // 2
-    z = x.reshape(*x.shape[:-1], m, 2)
-    z = jax.lax.complex(z[..., 0], z[..., 1])
-    zf = four_step_fft(z) if use_four_step else jnp.fft.fft(z)
-    # Hermitian split: X[k] = F[k] + conj(F[m-k]) pieces.  The m-k indexing
-    # is a reverse + shift, written as slices (not a gather, which TPUs
-    # handle poorly at this size): [(m-0)%m, ..., (m-m)%m] = [0, m-1, ..., 0]
-    f_k = jnp.concatenate([zf, zf[..., :1]], axis=-1)      # F[m] = F[0]
-    rev = jnp.flip(zf, axis=-1)                            # [m-1, ..., 0]
-    f_mk = jnp.conj(jnp.concatenate([zf[..., :1], rev], axis=-1))
+    if n % 256 == 0:
+        x2 = x.reshape(*x.shape[:-1], n // 256, 256)
+        re = x2[..., 0::2].reshape(*x.shape[:-1], m)
+        im = x2[..., 1::2].reshape(*x.shape[:-1], m)
+    else:  # tiny inputs (tests); layout padding is harmless here
+        x2 = x.reshape(*x.shape[:-1], m, 2)
+        re, im = x2[..., 0], x2[..., 1]
+    return jax.lax.complex(re, im)
+
+
+def hermitian_rfft_post(zf: jnp.ndarray,
+                        drop_nyquist: bool = False) -> jnp.ndarray:
+    """Hermitian post-process of the packed half-size C2C: F[m] -> X of
+    the 2m-real rfft (ref: fft/fft_1d_r2c_post_process.hpp:33-82).
+    X[k] = F[k] + conj(F[m-k]) pieces; the m-k indexing is a reverse +
+    shift, written as flip/roll/concat (not a gather, which TPUs handle
+    poorly at this size)."""
+    m = zf.shape[-1]
+    n = 2 * m
+    if drop_nyquist:
+        f_k = zf                                           # k in [0, m)
+        # [(m-0)%m, m-1, ..., 1] = roll(flip(zf), 1)
+        f_mk = jnp.conj(jnp.roll(jnp.flip(zf, axis=-1), 1, axis=-1))
+        w = _phase_exp(jax.lax.iota(jnp.int32, m), n, -1.0)
+    else:
+        f_k = jnp.concatenate([zf, zf[..., :1]], axis=-1)  # F[m] = F[0]
+        rev = jnp.flip(zf, axis=-1)                        # [m-1, ..., 0]
+        f_mk = jnp.conj(jnp.concatenate([zf[..., :1], rev], axis=-1))
+        # w[k] = exp(-2*pi*i*k/n), k in [0, m] — exact hi/lo phase split
+        # (avoids both a baked constant and f32 rounding of k)
+        w = _phase_exp(jax.lax.iota(jnp.int32, m + 1), n, -1.0)
     even = 0.5 * (f_k + f_mk)
     odd = -0.5j * (f_k - f_mk)
-    # w[k] = exp(-2*pi*i*k/n), k in [0, m] — exact hi/lo phase split
-    # (avoids both a baked constant and f32 rounding of k)
-    w = _phase_exp(jax.lax.iota(jnp.int32, m + 1), n, -1.0)
     return even + w * odd
 
 
-# Threshold above which the segment R2C switches to the chunked four-step
-# path.  2^27 complex C2C is well within one v5e chip; tune with bench.py.
-LARGE_FFT_THRESHOLD = 1 << 27
+# Threshold (packed C2C length, = n/2) above which the segment R2C
+# switches to the four-step path.  Tuned on a v5e: the monolithic XLA R2C
+# works and wins through n = 2^29; at n = 2^30 XLA's compile OOMs
+# (PERF_TPU.jsonl n2_29/n2_30 A/Bs), so only 2^30+ takes the four-step.
+LARGE_FFT_THRESHOLD = 1 << 28
 
 
 def segment_rfft(x: jnp.ndarray, strategy: str = "auto") -> jnp.ndarray:
@@ -209,7 +297,7 @@ def segment_rfft(x: jnp.ndarray, strategy: str = "auto") -> jnp.ndarray:
         strategy = "four_step" if n // 2 > LARGE_FFT_THRESHOLD \
             else "monolithic"
     if strategy == "four_step":
-        return rfft_via_c2c(x, use_four_step=True)[..., :-1]
+        return rfft_via_c2c(x, use_four_step=True, drop_nyquist=True)
     if strategy == "monolithic":
         return rfft_drop_nyquist(x)
     raise ValueError(f"unknown fft strategy {strategy!r}")

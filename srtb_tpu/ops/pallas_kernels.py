@@ -46,12 +46,30 @@ def _pallas_available() -> bool:
 
 # ----------------------------------------------------------------
 # df64 helpers usable inside kernels (f32-only, no tuples of refs).
-# optimization_barrier keeps the compiler from simplifying the
-# error-free transforms away (see ops/df64.py — XLA rewrites
-# (a + b) - a to b, zeroing every lo component).
+#
+# The error-free transforms only survive a compiler that won't rewrite
+# (a + b) - a to b.  Which guard that takes depends on who compiles the
+# kernel body:
+#   * interpret=True runs the kernel as ordinary XLA ops, and XLA's
+#     algebraic simplifier DOES that rewrite — optimization_barrier is
+#     required (same as ops/df64.py; dropping it measurably zeroes every
+#     lo component, test_dedisperse_df64_kernel_high_channel_offset).
+#   * interpret=False lowers via Mosaic, which does not implement
+#     optimization_barrier (NotImplementedError on a real chip) and does
+#     not need it: its MLIR arith lowering keeps IEEE semantics.
+#     Verified empirically on a v5e — the non-interpret kernel matches
+#     the float64 chirp oracle at |k| ~ 1e9 turns, which would be off by
+#     whole turns if any lo component were simplified away
+#     (tests/test_pallas_kernels.py "mosaic" cases).
+# The switch is set by each pallas_call wrapper around kernel tracing
+# (tracing happens inside pl.pallas_call, so set/restore is exact).
 # ----------------------------------------------------------------
 
-_ob = jax.lax.optimization_barrier
+_USE_OB = True
+
+
+def _ob(x):
+    return jax.lax.optimization_barrier(x) if _USE_OB else x
 
 
 def _two_sum(a, b):
@@ -187,15 +205,22 @@ def dedisperse_df64(spec_ri: jnp.ndarray, f_min: float, df: float,
                                f_c=f_c, dm=dm, rows=rows, i0=int(i0))
     block = pl.BlockSpec((rows, _LANES), lambda i: (i, 0),
                          memory_space=pltpu.VMEM)
-    out_re, out_im = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[block, block],
-        out_specs=[block, block],
-        out_shape=[jax.ShapeDtypeStruct((rows_total, _LANES), jnp.float32),
-                   jax.ShapeDtypeStruct((rows_total, _LANES), jnp.float32)],
-        interpret=interpret,
-    )(re, im)
+    global _USE_OB
+    saved, _USE_OB = _USE_OB, bool(interpret)
+    try:
+        out_re, out_im = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[block, block],
+            out_specs=[block, block],
+            out_shape=[jax.ShapeDtypeStruct((rows_total, _LANES),
+                                            jnp.float32),
+                       jax.ShapeDtypeStruct((rows_total, _LANES),
+                                            jnp.float32)],
+            interpret=interpret,
+        )(re, im)
+    finally:
+        _USE_OB = saved
     return jnp.stack([out_re.reshape(n), out_im.reshape(n)])
 
 
@@ -256,9 +281,11 @@ def _sk_apply_kernel(re_ref, im_ref, keep_ref, out_re_ref, out_im_ref,
 def _sk_tiles(nfreq: int, ntime: int):
     """(rows, time_block) tiling for the fused SK kernels, or None when
     the waterfall shape cannot tile (single source of truth for both the
-    capability check and the kernels)."""
+    capability check and the kernels).  tb is capped at 256 lanes-rows:
+    512 puts the [rows, tb] f32 blocks at 16.25 MB of scoped VMEM, just
+    over the 16 MB Mosaic stack limit on v5e."""
     rows = min(8, nfreq)
-    tb = min(512 * _LANES, ntime)
+    tb = min(256 * _LANES, ntime)
     if nfreq % rows or ntime % _LANES or ntime % tb or tb % _LANES:
         return None
     return rows, tb
@@ -351,6 +378,18 @@ def sk_zap_timeseries(wf_ri: jnp.ndarray, sk_threshold: float,
     )(re, im, keep)
 
     return (jnp.stack([out_re, out_im]), zero_count, ts2d.reshape(ntime))
+
+
+# Sub-byte unpack needs a lane interleave (out[4c+j] = field_j(byte[c])),
+# which Mosaic cannot lower today: every legal spelling (stack+reshape,
+# repeat, per-field slice-assign then flatten) either raises
+# "infer-vector-layout: unsupported shape cast" on a real chip or lands
+# the fields in blocked, not sample, order.  The kernel stays for
+# interpret-mode CI parity and as the reference spelling; real-TPU
+# segments take the XLA unpack (ops/unpack.py), whose shift/mask chain
+# XLA fuses into the FFT input anyway — unpack is a few percent of an
+# FFT-dominated pipeline, so nothing measurable is lost.
+UNPACK_MOSAIC_OK = False
 
 
 def _unpack_subbyte_kernel(byte_ref, win_ref, out_ref, *, nbits,

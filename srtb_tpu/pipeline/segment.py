@@ -51,12 +51,30 @@ def unpack_streams(raw: jnp.ndarray, variant: str, nbits: int,
     raise ValueError(f"unknown unpack variant {variant!r}")
 
 
+# Segments at or above this sample count execute as three XLA programs
+# instead of one fused program: a 2^30-sample segment's fused graph needs
+# > 16 GB of HBM scratch on a v5e even with the four-step FFT (the two
+# transposes + batched FFTs + Hermitian combine all overlap in one
+# program's lifetime), while the staged plan frees each program's
+# temporaries before the next starts and never materializes a chirp bank.
+STAGED_MIN_N = 1 << 30
+
+
 class SegmentProcessor:
     """Builds and owns the jitted per-segment device function plus its
-    precomputed constants (chirp, window, RFI mask, normalization)."""
+    precomputed constants (chirp, window, RFI mask, normalization).
+
+    Execution plans:
+    - **fused** (default): the whole device chain is one jitted program.
+    - **staged** (n >= STAGED_MIN_N, or ``staged=True``): three jitted
+      programs — (a) unpack + pack + four-step first half, (b) four-step
+      second half + Hermitian post-process, (c) RFI + in-step df64 chirp
+      + waterfall + detect.  Boundaries are stacked (re, im) float32.
+    """
 
     def __init__(self, cfg: Config, window_name: str = W.DEFAULT_WINDOW,
-                 compute_chirp_on_device: bool | None = None):
+                 compute_chirp_on_device: bool | None = None,
+                 staged: bool | None = None):
         self.cfg = cfg
         self.fmt = formats.resolve(cfg.baseband_format_type)
         n = cfg.baseband_input_count
@@ -79,19 +97,27 @@ class SegmentProcessor:
 
         f_min, f_c, df = dd.spectrum_frequencies(cfg, self.n_spectrum)
         self.f_min, self.f_c, self.df = f_min, f_c, df
+        self.staged = (self.n >= STAGED_MIN_N) if staged is None else staged
         # the chirp crosses the host->device boundary as stacked (re, im)
         # float32 [2, n]: some TPU runtimes can't transfer complex buffers,
         # and split re/im is the natural VPU layout anyway; complex exists
-        # only inside jit
-        if compute_chirp_on_device is None:
-            compute_chirp_on_device = cfg.use_emulated_fp64
-        if compute_chirp_on_device:
-            self.chirp = jax.jit(
-                lambda: dd.chirp_factor_df64_ri(self.n_spectrum, f_min, df,
-                                                f_c, cfg.dm))()
+        # only inside jit.  The staged plan never materializes a bank —
+        # at n = 2^30 it would occupy 4 GB of HBM for the segment's whole
+        # lifetime — and instead computes the df64 chirp inside stage (c).
+        if self.staged or cfg.use_pallas:
+            # staged and Pallas plans compute the chirp in-step; a
+            # precomputed bank would sit dead in HBM (2 GB at n = 2^29)
+            self.chirp = None
         else:
-            self.chirp = jnp.asarray(dd.chirp_factor_host_ri(
-                self.n_spectrum, f_min, df, f_c, cfg.dm))
+            if compute_chirp_on_device is None:
+                compute_chirp_on_device = cfg.use_emulated_fp64
+            if compute_chirp_on_device:
+                self.chirp = jax.jit(
+                    lambda: dd.chirp_factor_df64_ri(self.n_spectrum, f_min,
+                                                    df, f_c, cfg.dm))()
+            else:
+                self.chirp = jnp.asarray(dd.chirp_factor_host_ri(
+                    self.n_spectrum, f_min, df, f_c, cfg.dm))
 
         mask = rfi.rfi_ranges_to_mask(
             rfi.eval_rfi_ranges(cfg.mitigate_rfi_freq_list), self.n_spectrum,
@@ -108,33 +134,79 @@ class SegmentProcessor:
         # Pallas kernels need interpret mode off-TPU (CPU CI)
         self._pallas_interpret = jax.default_backend() not in ("tpu", "axon")
         self._jit_process = jax.jit(self._process)
+        self._jit_stage_a = jax.jit(self._stage_a)
+        # the staged intermediates are consumed exactly once, so stages
+        # donate their inputs — without this the 4 GB boundary array of a
+        # 2^30 segment stays live across the next program's entire temp
+        # footprint and the chain ResourceExhausts at runtime even though
+        # each program compiled within budget
+        self._jit_stage_b = jax.jit(self._stage_b, donate_argnums=(0,))
+        self._jit_stage_c = jax.jit(self._stage_c, donate_argnums=(0,))
         log.debug(f"[segment] n={n} spectrum={self.n_spectrum} "
                   f"channels={self.channel_count} watfft={self.watfft_len} "
-                  f"reserved={self.nsamps_reserved}")
+                  f"reserved={self.nsamps_reserved} staged={self.staged}")
 
     # ------------------------------------------------------------------
 
+    def _unpack(self, raw: jnp.ndarray) -> jnp.ndarray:
+        """raw bytes -> windowed float32 samples [S, n]."""
+        cfg = self.cfg
+        interp = getattr(self, "_pallas_interpret", False)
+        from srtb_tpu.ops import pallas_kernels as pk
+        if (cfg.use_pallas and cfg.baseband_input_bits in (1, 2, 4)
+                and self.fmt.unpack_variant == "simple"
+                and (interp or pk.UNPACK_MOSAIC_OK)):
+            return pk.unpack_subbyte_window(raw, cfg.baseband_input_bits,
+                                            self.window,
+                                            interpret=interp)[None, :]
+        return unpack_streams(raw, self.fmt.unpack_variant,
+                              cfg.baseband_input_bits, self.window)
+
     def _process(self, raw: jnp.ndarray, chirp_ri: jnp.ndarray):
+        x = self._unpack(raw)
+        spec = F.segment_rfft(x, self.cfg.fft_strategy)    # [S, n/2]
+        return self._spectrum_tail(spec, chirp_ri)
+
+    # ---- staged plan: three programs with (re, im) f32 boundaries ----
+
+    def _stage_a(self, raw: jnp.ndarray):
+        """unpack + even/odd pack + four-step first half."""
+        x = self._unpack(raw)
+        a = F.four_step_stage1(F.pack_even_odd(x))     # [S, n2, n1]
+        return jnp.stack([jnp.real(a), jnp.imag(a)])
+
+    def _stage_b(self, a_ri: jnp.ndarray):
+        """four-step second half + Hermitian post -> spectrum [S, n/2]."""
+        a = jax.lax.complex(a_ri[0], a_ri[1])
+        spec = F.hermitian_rfft_post(F.four_step_stage2(a),
+                                     drop_nyquist=True)
+        return jnp.stack([jnp.real(spec), jnp.imag(spec)])
+
+    def _stage_c(self, spec_ri: jnp.ndarray):
+        """RFI s1 + in-step chirp + waterfall + RFI s2 + detect."""
+        spec = jax.lax.complex(spec_ri[0], spec_ri[1])
+        return self._spectrum_tail(spec, None)
+
+    def _spectrum_tail(self, spec: jnp.ndarray, chirp_ri):
+        """Shared device chain from the raw spectrum onward.  With
+        ``chirp_ri=None`` the df64 chirp is generated inside the trace
+        (fuses into the multiply; nothing bank-sized is materialized)."""
         cfg = self.cfg
         use_pallas = cfg.use_pallas
         interp = getattr(self, "_pallas_interpret", False)
-        if use_pallas:
-            from srtb_tpu.ops import pallas_kernels as pk
-        if (use_pallas and cfg.baseband_input_bits in (1, 2, 4)
-                and self.fmt.unpack_variant == "simple"):
-            x = pk.unpack_subbyte_window(raw, cfg.baseband_input_bits,
-                                         self.window,
-                                         interpret=interp)[None, :]
-        else:
-            x = unpack_streams(raw, self.fmt.unpack_variant,
-                               cfg.baseband_input_bits, self.window)
-        spec = F.segment_rfft(x, cfg.fft_strategy)    # [S, n/2]
+        from srtb_tpu.ops import pallas_kernels as pk
         spec = rfi.mitigate_rfi_average_and_normalize(
             spec, cfg.mitigate_rfi_average_method_threshold, self.norm_coeff)
         spec = rfi.mitigate_rfi_manual(spec, self.rfi_mask)
         n_streams = spec.shape[0]
-        if use_pallas:
-            # per-stream fused df64 chirp (S is small and static)
+        if use_pallas or chirp_ri is None:
+            # Per-stream fused df64 chirp, phase computed in-register
+            # (S is small and static).  This is also the only in-step
+            # form that fits a 2^30 segment: the XLA df64 chirp's
+            # optimization_barriers block fusion, so its ~12 error-free-
+            # transform intermediates each materialize a 2 GB plane
+            # (observed 24 GB peak); the Pallas kernel touches HBM only
+            # for the spectrum in/out.
             outs = []
             for s in range(n_streams):
                 spec_ri = jnp.stack([jnp.real(spec[s]), jnp.imag(spec[s])])
@@ -189,7 +261,14 @@ class SegmentProcessor:
         if raw.shape != (expected,):
             raise ValueError(
                 f"segment must be {expected} bytes, got {raw.shape}")
-        return self._jit_process(raw, self.chirp)
+        return self.run_device(raw)
+
+    def run_device(self, raw: jnp.ndarray):
+        """Run one segment on an already-device-resident byte array,
+        dispatching between the fused and staged execution plans."""
+        if not self.staged:
+            return self._jit_process(raw, self.chirp)
+        return self._jit_stage_c(self._jit_stage_b(self._jit_stage_a(raw)))
 
     @property
     def data_stream_count(self) -> int:

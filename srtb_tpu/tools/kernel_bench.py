@@ -25,17 +25,50 @@ import numpy as np
 from srtb_tpu.utils.platform import apply_platform_env
 
 
+# Iterations of the on-device timing loop per host sync.  Remote-tunnel
+# TPU runtimes (axon) cost ~60-65 ms per dispatch+sync round trip —
+# enough to bury every sub-10 ms kernel (and `block_until_ready` alone
+# is not even a reliable sync there: single-dispatch timings came back
+# physically impossible, e.g. 24 us for a 536 MB-read matmul).  The
+# timer therefore runs INNER_ITERS executions inside one jitted
+# lax.scan, each iteration's input carrying a data dependency on the
+# previous output (defeats any client-side pipelining or dedup), and
+# pays one host fetch per measurement.
+_INNER_ITERS = 16
+
+
 def _time(fn, *args, reps=5):
+    """Best-of-reps mean kernel time over a dependency-chained on-device
+    loop.  The chaining adds one read+write copy of args[0] per
+    iteration — a known, stated bias (e.g. +~1.3 ms for a 512 MB input
+    at HBM speed), far smaller than the ~60 ms per-sync RTT it avoids.
+    """
     import jax
-    out = fn(*args)
-    jax.block_until_ready(out)
+    import jax.numpy as jnp
+
+    @jax.jit
+    def loop(*args_):
+        def body(c, _):
+            a = args_[0] + c.astype(args_[0].dtype)  # depend on prev iter
+            out = fn(a, *args_[1:])
+            leaf = jax.tree_util.tree_leaves(out)[0]
+            nxt = jnp.ravel(leaf)[0].astype(jnp.float32)
+            # exactly-zero carry the simplifier cannot prove is zero
+            # (x*0 folds for integer kernels and DCEs the whole body)
+            zero = nxt - jax.lax.optimization_barrier(nxt)
+            return zero, ()
+
+        c, _ = jax.lax.scan(body, jnp.float32(0.0), None,
+                            length=_INNER_ITERS)
+        return c
+
+    np.asarray(loop(*args))                  # compile + warm + sync
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
-        out = fn(*args)
-        jax.block_until_ready(out)
+        np.asarray(loop(*args))
         best = min(best, time.perf_counter() - t0)
-    return best
+    return best / _INNER_ITERS
 
 
 def main(argv=None) -> int:
@@ -97,18 +130,38 @@ def main(argv=None) -> int:
     record("resample+normalize+colormap", dt,
            f"[{nchan},{wlen}]->[{out_h},{out_w}]", nchan * wlen)
 
-    # ---- 2-bit unpack + window ----
+    # ---- 2-bit unpack + window (blocked field order) ----
+    # The product unpack (ops/unpack.py) interleaves fields into sample
+    # order; standalone, XLA materializes its [bytes, 4] intermediate
+    # whose minor dim pads 4 -> 128 lanes (16x HBM, OOM at segment
+    # sizes).  In the pipeline the interleave always fuses into the FFT
+    # feed (proved by the 2^30 runs, where the padded form would be
+    # 128 GB), so the honest standalone throughput measurement is the
+    # same bit-extract + window traffic in a lane-dense blocked order.
     raw = jax.device_put(rng.integers(0, 256, n // 4, dtype=np.uint8))
-    win = jax.device_put(np.hamming(n).astype(np.float32))
-    unpack2 = jax.jit(lambda b, w: U.unpack(b, 2, w))
-    dt = _time(unpack2, raw, win, reps=reps)
-    record("unpack 2-bit + window", dt, f"[{n // 4}]u8->[{n}]f32", n)
+    win_b = jax.device_put(
+        rng.random(n, dtype=np.float32).reshape(n // 512, 512) + 0.5)
+
+    @jax.jit
+    def unpack2_blocked(b, w):
+        b2 = b.reshape(-1, 128).astype(jnp.int32)
+        fields = [((b2 >> s) & 3).astype(jnp.float32)
+                  for s in (6, 4, 2, 0)]
+        return jnp.concatenate(fields, axis=-1) * w
+
+    dt = _time(unpack2_blocked, raw, win_b, reps=reps)
+    record("unpack 2-bit + window (blocked order)", dt,
+           f"[{n // 4}]u8->[{n}]f32", n)
+
+    # complex arrays are built on device from real transfers: some TPU
+    # runtimes (axon tunnel) cannot transfer complex64 host<->device, and
+    # one failed complex transfer poisons all later transfers
+    spec_re = jax.device_put(rng.standard_normal(n_spec, dtype=np.float32))
+    spec_im = jax.device_put(rng.standard_normal(n_spec, dtype=np.float32))
+    to_c = jax.jit(jax.lax.complex)
+    spec_c = to_c(spec_re, spec_im)
 
     # ---- chirp multiply (precomputed bank) ----
-    spec_c = jax.device_put(
-        (rng.standard_normal(n_spec, dtype=np.float32)
-         + 1j * rng.standard_normal(n_spec, dtype=np.float32)
-         ).astype(np.complex64))
     f_min, f_c, df = 1405.0, 1437.0, 64.0 / n_spec
     chirp = jnp.asarray(dd.chirp_factor_host_ri(n_spec, f_min, df, f_c,
                                                 -478.80))
@@ -120,7 +173,7 @@ def main(argv=None) -> int:
     # ---- df64 on-the-fly chirp (Pallas, TPU only) ----
     if jax.default_backend() not in ("cpu",):
         from srtb_tpu.ops import pallas_kernels as pk
-        spec_ri = jnp.stack([jnp.real(spec_c), jnp.imag(spec_c)])
+        spec_ri = jnp.stack([spec_re, spec_im])
         pallas_mul = jax.jit(lambda s: pk.dedisperse_df64(
             s, f_min, df, f_c, -478.80))
         try:
@@ -131,13 +184,27 @@ def main(argv=None) -> int:
             print(json.dumps({"kernel": "pallas df64", "error": str(e)}))
 
     # ---- spectral kurtosis on the waterfall ----
-    wf_c = jax.device_put(
-        (rng.standard_normal((nchan, wlen), dtype=np.float32)
-         + 1j * rng.standard_normal((nchan, wlen), dtype=np.float32)
-         ).astype(np.complex64))
+    wf_re = jax.device_put(
+        rng.standard_normal((nchan, wlen)).astype(np.float32))
+    wf_im = jax.device_put(
+        rng.standard_normal((nchan, wlen)).astype(np.float32))
+    wf_c = to_c(wf_re, wf_im)
     sk = jax.jit(lambda w: rfi.mitigate_rfi_spectral_kurtosis(w[None], 1.05)[0])
     dt = _time(sk, wf_c, reps=reps)
     record("spectral kurtosis zap", dt, f"[{nchan},{wlen}]c64", n_spec)
+
+    # ---- fused Pallas SK zap + time series (vs sk + detect ts pass) ----
+    if jax.default_backend() not in ("cpu",):
+        from srtb_tpu.ops import pallas_kernels as pk
+        if pk.sk_tiling_ok(nchan, wlen):
+            wf_ri = jnp.stack([wf_re, wf_im])
+            fused = jax.jit(lambda w: pk.sk_zap_timeseries(w, 1.05))
+            try:
+                dt = _time(fused, wf_ri, reps=reps)
+                record("SK zap + time series (Pallas fused)", dt,
+                       f"[{nchan},{wlen}]c64", n_spec)
+            except Exception as e:  # pragma: no cover
+                print(json.dumps({"kernel": "pallas sk", "error": str(e)}))
 
     # ---- detection chain (time series + boxcar ladder) ----
     detect = jax.jit(lambda w: det.detect(w[None], 0, 8.0, 256))

@@ -8,15 +8,21 @@ CPU-only runners (ref: .circleci/config.yml, SURVEY.md §4): CPU JAX is the
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# SRTB_TEST_TPU=1 keeps the session on the real accelerator so the
+# non-interpret Pallas cases run on actual hardware (Mosaic lowering);
+# intended for targeted runs (pytest tests/test_pallas_kernels.py), not
+# the full suite — multi-device mesh tests need the 8-device CPU mesh.
+if not os.environ.get("SRTB_TEST_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
-# some environments force a TPU platform plugin via jax.config at interpreter
-# startup (sitecustomize); programmatic config wins over env vars, so force
-# it back to CPU the same way before any backend is initialized.
-import jax  # noqa: E402
+    # some environments force a TPU platform plugin via jax.config at
+    # interpreter startup (sitecustomize); programmatic config wins over
+    # env vars, so force it back to CPU the same way before any backend
+    # is initialized.
+    import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")

@@ -1,7 +1,15 @@
-"""Pallas kernel tests in interpret mode (CPU), validated against the jnp
-reference ops — the same generic-vs-handwritten self-consistency strategy
-as the reference's unpack tests."""
+"""Pallas kernel tests validated against the jnp reference ops — the same
+generic-vs-handwritten self-consistency strategy as the reference's unpack
+tests.
 
+Every case runs in interpret mode (CPU CI) and, when a real TPU is
+present and ``SRTB_TEST_TPU=1`` (see conftest), again non-interpret so
+the Mosaic lowering itself is exercised — interpret mode routinely
+accepts kernels Mosaic rejects (layouts, unsupported primitives)."""
+
+import os
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -11,7 +19,17 @@ from srtb_tpu.ops import pallas_kernels as pk
 from srtb_tpu.ops import unpack as U
 
 
-def test_dedisperse_df64_kernel_matches_host_chirp():
+@pytest.fixture(params=["interpret", "mosaic"])
+def interpret(request):
+    if request.param == "mosaic":
+        if not (os.environ.get("SRTB_TEST_TPU")
+                and jax.default_backend() == "tpu"):
+            pytest.skip("real TPU run needs SRTB_TEST_TPU=1 and a chip")
+        return False
+    return True
+
+
+def test_dedisperse_df64_kernel_matches_host_chirp(interpret):
     n = 1 << 15
     f_min, bw, dm = 1405.0, 64.0, 150.0
     f_c = f_min + bw
@@ -22,7 +40,7 @@ def test_dedisperse_df64_kernel_matches_host_chirp():
     spec_ri = jnp.stack([jnp.asarray(spec.real), jnp.asarray(spec.imag)])
 
     out_ri = np.asarray(pk.dedisperse_df64(spec_ri, f_min, df, f_c, dm,
-                                           interpret=True))
+                                           interpret=interpret))
     got = out_ri[0] + 1j * out_ri[1]
     expected = spec * dd.chirp_factor_host(n, f_min, df, f_c, dm)
     # df64 phase error ~1e-5 turns; compare phasors
@@ -30,7 +48,7 @@ def test_dedisperse_df64_kernel_matches_host_chirp():
     assert np.max(err) < 5e-3 * np.max(np.abs(spec))
 
 
-def test_dedisperse_df64_kernel_high_dm():
+def test_dedisperse_df64_kernel_high_dm(interpret):
     """|k| ~ 1e9 regime (J1644-style high DM)."""
     n = 1 << 12
     f_min, bw, dm = 1437.0, -64.0, -478.80
@@ -39,7 +57,7 @@ def test_dedisperse_df64_kernel_high_dm():
     spec = np.ones(n, dtype=np.complex64)
     spec_ri = jnp.stack([jnp.ones(n, jnp.float32), jnp.zeros(n, jnp.float32)])
     out_ri = np.asarray(pk.dedisperse_df64(spec_ri, f_min, df, f_c, dm,
-                                           interpret=True))
+                                           interpret=interpret))
     got = out_ri[0] + 1j * out_ri[1]
     expected = np.asarray(dd.chirp_factor_host(n, f_min, df, f_c, dm))
     # unit-magnitude phasors with df64-level phase accuracy
@@ -49,8 +67,16 @@ def test_dedisperse_df64_kernel_high_dm():
     del spec
 
 
+def _xfail_unpack_mosaic(interpret):
+    if not interpret and not pk.UNPACK_MOSAIC_OK:
+        pytest.xfail("sub-byte lane interleave not lowerable by Mosaic "
+                     "(infer-vector-layout: unsupported shape cast); "
+                     "real-TPU segments use the XLA unpack instead")
+
+
 @pytest.mark.parametrize("with_window", [False, True])
-def test_unpack_2bit_kernel(with_window):
+def test_unpack_2bit_kernel(with_window, interpret):
+    _xfail_unpack_mosaic(interpret)
     rng = np.random.default_rng(1)
     m = 1 << 12
     data = rng.integers(0, 256, size=m, dtype=np.uint8)
@@ -59,14 +85,23 @@ def test_unpack_2bit_kernel(with_window):
     got = np.asarray(pk.unpack_2bit_window(
         jnp.asarray(data),
         None if window is None else jnp.asarray(window),
-        interpret=True))
+        interpret=interpret))
     expected = U.unpack_oracle(data, 2)
     if window is not None:
         expected = expected * window
     np.testing.assert_allclose(got, expected, rtol=1e-6)
 
 
-def test_sk_zap_timeseries_matches_jnp():
+def test_sk_zap_timeseries_matches_jnp(interpret):
+    """Fused SK kernel vs an independent float64 numpy oracle.
+
+    Deliberately no complex device arrays: some TPU runtimes (the axon
+    tunnel) cannot transfer complex64 host<->device, and one failed
+    complex transfer poisons every later transfer in the process — the
+    kernel's own boundary is (re, im) f32, so the test honors it too.
+    Threshold 1.2 keeps every row's SK decision >= 0.1 from a boundary
+    (at 1.05 a clean row sat 1.4e-4 from the cut: f32-reorder flaky).
+    """
     from srtb_tpu.ops import detect as det
     from srtb_tpu.ops import rfi
 
@@ -79,52 +114,68 @@ def test_sk_zap_timeseries_matches_jnp():
     wf[7] = 0.0
     wf[12] *= 5.0 * np.sin(np.arange(ntime) * 0.3) ** 2
 
-    sk_threshold = 1.05
-    wf_ri = jnp.stack([jnp.asarray(wf.real), jnp.asarray(wf.imag)])
+    sk_threshold = 1.2
+    wf_ri = jnp.stack([jnp.asarray(wf.real.copy()),
+                       jnp.asarray(wf.imag.copy())])
     out_ri, zero_count, ts = pk.sk_zap_timeseries(wf_ri, sk_threshold,
-                                                  interpret=True)
+                                                  interpret=interpret)
 
-    expected_wf = rfi.mitigate_rfi_spectral_kurtosis(
-        jnp.asarray(wf)[None], sk_threshold)[0]
-    got_wf = np.asarray(out_ri[0]) + 1j * np.asarray(out_ri[1])
-    np.testing.assert_allclose(got_wf, np.asarray(expected_wf),
-                               rtol=1e-5, atol=1e-5)
+    # float64 oracle of the SK decision (formula:
+    # spectrum/rfi_mitigation.hpp:290-341, thresholds shared via
+    # sk_decision_thresholds so the decision rule cannot drift)
+    x2 = np.abs(wf.astype(np.complex128)) ** 2
+    s2 = x2.sum(-1)
+    s4 = (x2 * x2).sum(-1)
+    with np.errstate(invalid="ignore"):
+        sk = ntime * s4 / (s2 * s2)
+    thr_low, thr_high = rfi.sk_decision_thresholds(ntime, sk_threshold)
+    zap = (sk > thr_high) | (sk < thr_low)
+    margin = np.nanmin(np.minimum(np.abs(sk - thr_low),
+                                  np.abs(sk - thr_high)))
+    assert margin > 0.05, f"borderline SK row (margin {margin})"
+    expected_wf = np.where(zap[:, None], 0, wf).astype(np.complex64)
     # some but not all rows must be zapped for the test to mean anything
-    zapped_rows = int((np.abs(np.asarray(expected_wf)).sum(-1) == 0).sum())
-    assert 0 < zapped_rows < nfreq
+    assert 0 < int(zap.sum()) < nfreq
 
-    expected_det = det.detect(expected_wf[None], 0, 8.0, 64)
-    assert int(zero_count) == int(expected_det.zero_count[0])
-    expected_ts_raw = np.abs(np.asarray(expected_wf)) ** 2
-    np.testing.assert_allclose(np.asarray(ts),
-                               expected_ts_raw.sum(axis=0),
+    got_wf = np.asarray(out_ri[0]) + 1j * np.asarray(out_ri[1])
+    np.testing.assert_allclose(got_wf, expected_wf, rtol=1e-5, atol=1e-5)
+
+    expected_zero = int((zap | (x2[:, 0] == 0)).sum())
+    assert int(zero_count) == expected_zero
+    expected_ts = np.abs(expected_wf) ** 2
+    np.testing.assert_allclose(np.asarray(ts), expected_ts.sum(axis=0),
                                rtol=1e-4, atol=1e-4)
 
-    # chained through the split-out ladder: full DetectResult parity
+    # chained through the split-out ladder: DetectResult consistency on
+    # real-only inputs (no complex crosses the device boundary)
     got_det = det.detect_from_time_series(
         jnp.asarray(ts)[None], jnp.asarray([zero_count]), 8.0, 64)
+    ref_det = det.detect_from_time_series(
+        jnp.asarray(expected_ts.sum(axis=0).astype(np.float32))[None],
+        jnp.asarray([expected_zero]), 8.0, 64)
     np.testing.assert_allclose(np.asarray(got_det.time_series),
-                               np.asarray(expected_det.time_series),
+                               np.asarray(ref_det.time_series),
                                rtol=1e-4, atol=1e-4)
     assert np.array_equal(np.asarray(got_det.signal_counts),
-                          np.asarray(expected_det.signal_counts))
+                          np.asarray(ref_det.signal_counts))
 
 
 @pytest.mark.parametrize("nbits", [1, 2, 4])
-def test_unpack_subbyte_kernel_all_widths(nbits):
+def test_unpack_subbyte_kernel_all_widths(nbits, interpret):
+    _xfail_unpack_mosaic(interpret)
     m = 1 << 10
     rng = np.random.default_rng(nbits)
     raw = rng.integers(0, 256, size=m, dtype=np.uint8)
     n_out = (8 // nbits) * m
     win = np.hamming(n_out).astype(np.float32)
     got = np.asarray(pk.unpack_subbyte_window(
-        jnp.asarray(raw), nbits, jnp.asarray(win), interpret=True))
+        jnp.asarray(raw), nbits, jnp.asarray(win), interpret=interpret))
     expected = np.asarray(U.unpack(jnp.asarray(raw), nbits,
                                    jnp.asarray(win)))
     np.testing.assert_allclose(got, expected, rtol=1e-6, atol=1e-6)
 
 
-def test_dedisperse_df64_kernel_high_channel_offset():
+def test_dedisperse_df64_kernel_high_channel_offset(interpret):
     """The in-kernel chirp must stay phase-accurate when the global
     channel index exceeds float32's exact-integer range (2^24)."""
     n = 1 << 12
@@ -138,7 +189,7 @@ def test_dedisperse_df64_kernel_high_channel_offset():
         np.complex64)
     spec_ri = jnp.stack([jnp.asarray(spec.real), jnp.asarray(spec.imag)])
     out_ri = np.asarray(pk.dedisperse_df64(spec_ri, f_min, df, f_c, dm,
-                                           interpret=True, i0=i0))
+                                           interpret=interpret, i0=i0))
     got = out_ri[0] + 1j * out_ri[1]
 
     i = np.arange(i0, i0 + n, dtype=np.float64)

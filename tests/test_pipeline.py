@@ -211,3 +211,26 @@ def test_pallas_path_multi_stream_matches(tmp_path):
                                rtol=1e-3, atol=1e-2)
     np.testing.assert_allclose(np.asarray(wf_a), np.asarray(wf_b),
                                rtol=1e-3, atol=1e-2)
+
+
+def test_staged_matches_fused(synthetic_cfg):
+    """The staged three-program plan (used for 2^30-class segments, with
+    the chirp generated in-step) must reproduce the fused plan's output.
+    The chirp differs by construction (host f64 bank vs in-trace df64),
+    so tolerances are df64-level, not bitwise."""
+    cfg = synthetic_cfg
+    fused = SegmentProcessor(cfg)
+    staged = SegmentProcessor(cfg, staged=True)
+    assert staged.chirp is None  # no bank materialized
+    raw = np.fromfile(cfg.input_file_path, dtype=np.uint8,
+                      count=cfg.baseband_input_count)
+    wf_f, res_f = fused.process(raw)
+    wf_s, res_s = staged.process(raw)
+    wf_f, wf_s = np.asarray(wf_f), np.asarray(wf_s)
+    scale = np.abs(wf_f).max()
+    np.testing.assert_allclose(wf_s, wf_f, atol=5e-3 * scale, rtol=0)
+    assert np.array_equal(np.asarray(res_f.signal_counts),
+                          np.asarray(res_s.signal_counts))
+    ts_f = np.asarray(res_f.time_series)
+    np.testing.assert_allclose(np.asarray(res_s.time_series), ts_f,
+                               rtol=0, atol=5e-3 * np.abs(ts_f).max())
