@@ -57,6 +57,13 @@ class Config:
     # "continuous": strictly sequential gap-free stream, payloads straddle
     # segment boundaries (continuous_udp_receiver_worker, ref: 42-168)
     udp_receiver_mode: str = "block"
+    # packet provider for block mode (ref dispatch:
+    # udp_receiver_pipe.hpp:158-187): "recvmmsg" = batched syscalls
+    # (native, default), "packet_ring" = AF_PACKET TPACKET_V3 mmap ring
+    # (native, needs CAP_NET_RAW), "recvfrom" = pure-Python fallback
+    udp_packet_provider: str = "recvmmsg"
+    # interface the packet_ring provider captures on
+    udp_packet_ring_interface: str = "lo"
 
     input_file_path: str = ""
     input_file_offset_bytes: int = 0

@@ -9,7 +9,8 @@ counter-sequential packets over loopback as fast as the sender can and
 reports what the receiver actually sustained.
 
 Usage:
-    python -m srtb_tpu.tools.udp_soak [--packets N] [--impl native|python|continuous]
+    python -m srtb_tpu.tools.udp_soak [--packets N] \
+        [--impl native|packet_ring|python|continuous]
 
 Prints one JSON line:
   {"pps": ..., "gbps": ..., "payload_bytes": ..., "received": ...,
@@ -82,6 +83,8 @@ def run_soak(n_packets: int = 20000, impl: str = "auto",
         impl = "native" if udp._NATIVE is not None else "python"
     if impl == "native":
         rx = udp.NativeBlockReceiver("127.0.0.1", port, fmt)
+    elif impl == "packet_ring":
+        rx = udp.PacketRingReceiver("", port, fmt, interface="lo")
     elif impl == "continuous":
         rx = udp.PythonContinuousReceiver("127.0.0.1", port, fmt,
                                           rcvbuf_bytes=1 << 28)
@@ -130,7 +133,8 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--packets", type=int, default=20000)
     p.add_argument("--impl", default="auto",
-                   choices=["auto", "native", "python", "continuous"])
+                   choices=["auto", "native", "packet_ring", "python",
+                            "continuous"])
     p.add_argument("--port", type=int, default=42100)
     p.add_argument("--pace-gbps", type=float, default=0.0)
     args = p.parse_args(argv)

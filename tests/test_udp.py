@@ -221,13 +221,25 @@ def test_udp_source_continuous_mode():
     np.testing.assert_array_equal(seg2.data[half:half + payload], 22)
 
 
-def test_ingest_sustains_realtime_rate():
+@pytest.mark.parametrize("impl", ["default", "packet_ring"])
+def test_ingest_sustains_realtime_rate(impl):
     """Loopback soak at 2x the J1644-4559 wire rate (0.512 Gbps of
     payload) must be loss-free — the regression gate for the measured
     ingest ceiling recorded in PERF.md."""
     from srtb_tpu.tools.udp_soak import run_soak, REQUIRED_GBPS
-    impl = "native" if udp._NATIVE is not None else "python"
-    res = run_soak(n_packets=8000, impl=impl, port=42150,
+    if impl == "default":
+        impl = "native" if udp._NATIVE is not None else "python"
+        port = 42150
+    else:
+        if udp._NATIVE is None:
+            pytest.skip("native lib not built")
+        port = 42152
+        try:
+            probe = udp.PacketRingReceiver("", 42199, formats.FASTMB_ROACH2)
+            probe.close()
+        except OSError:
+            pytest.skip("AF_PACKET ring unavailable (needs CAP_NET_RAW)")
+    res = run_soak(n_packets=8000, impl=impl, port=port,
                    pace_gbps=2 * REQUIRED_GBPS)
     assert res["lost"] == 0, res
     assert res["gbps"] >= 1.5 * REQUIRED_GBPS, res
@@ -276,3 +288,150 @@ def test_gznupsr_block_assembly():
     assert (first, lost, total) == (5, 0, 2)
     np.testing.assert_array_equal(out[:payload], 5)
     np.testing.assert_array_equal(out[payload:], 6)
+
+
+# ----------------------------------------------------------------
+# AF_PACKET TPACKET_V3 ring provider (native/packet_ring.cpp)
+# ----------------------------------------------------------------
+
+def _make_ring(fmt, port):
+    if udp._NATIVE is None:
+        pytest.skip("native lib not built")
+    try:
+        return udp.PacketRingReceiver("", port, fmt, interface="lo")
+    except OSError:
+        pytest.skip("AF_PACKET ring unavailable (needs CAP_NET_RAW)")
+
+
+def test_packet_ring_block_assembly_with_loss_and_reorder():
+    """Mirror of the recvmmsg block case on the TPACKET_V3 ring: loss is
+    zero-filled and accounted, reordering within a block is tolerated,
+    and loopback's duplicate (outgoing) copies are filtered out."""
+    fmt = formats.FASTMB_ROACH2
+    payload = fmt.payload_bytes
+    port = 42030
+    rx = _make_ring(fmt, port)
+
+    packets_per_block = 4
+    counters = [0, 3, 1, 4]
+
+    def payload_fn(c):
+        return bytes([c % 251]) * payload
+
+    sender = threading.Thread(
+        target=_send_packets, args=(port, fmt, counters, payload_fn))
+    sender.start()
+    out = np.zeros(packets_per_block * payload, dtype=np.uint8)
+    first, lost, total = rx.receive_block(out)
+    sender.join()
+
+    assert first == 0
+    assert total == packets_per_block
+    assert lost == 1  # counter 2 missing
+    np.testing.assert_array_equal(out[:payload], 0)
+    np.testing.assert_array_equal(out[payload:2 * payload], 1)
+    np.testing.assert_array_equal(out[2 * payload:3 * payload], 0)  # lost
+    np.testing.assert_array_equal(out[3 * payload:4 * payload], 3)
+
+    # the overflow packet (counter 4) must open the next block
+    sender2 = threading.Thread(
+        target=_send_packets, args=(port, fmt, [5, 6, 7], payload_fn))
+    sender2.start()
+    out2 = np.zeros(packets_per_block * payload, dtype=np.uint8)
+    first2, lost2, total2 = rx.receive_block(out2)
+    sender2.join()
+    rx.close()
+    assert first2 == 4
+    assert lost2 == 0
+    np.testing.assert_array_equal(out2[:payload], 4)
+    np.testing.assert_array_equal(out2[3 * payload:], 7)
+
+
+def test_packet_ring_filters_foreign_traffic():
+    """Datagrams to a different port or of the wrong size must not
+    disturb block assembly (the ring sees every packet on the interface,
+    so the port/size filter is load-bearing, not cosmetic)."""
+    fmt = formats.FASTMB_ROACH2
+    payload = fmt.payload_bytes
+    port = 42031
+    rx = _make_ring(fmt, port)
+
+    def payload_fn(c):
+        return bytes([c % 251]) * payload
+
+    def send_mixed():
+        noise = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        time.sleep(0.1)
+        # wrong port, and wrong-size datagram to the right port
+        noise.sendto(b"x" * 100, ("127.0.0.1", port + 1))
+        noise.sendto(b"y" * 32, ("127.0.0.1", port))
+        noise.close()
+        _send_packets(port, fmt, [10, 11], payload_fn)
+
+    sender = threading.Thread(target=send_mixed)
+    sender.start()
+    out = np.zeros(2 * payload, dtype=np.uint8)
+    first, lost, total = rx.receive_block(out)
+    sender.join()
+    rx.close()
+    assert (first, lost, total) == (10, 0, 2)
+    np.testing.assert_array_equal(out[:payload], 10 % 251)
+    np.testing.assert_array_equal(out[payload:], 11 % 251)
+
+
+def test_udp_source_packet_ring_provider():
+    """Config-level selection: udp_packet_provider=packet_ring yields
+    segments through UdpReceiverSource like the recvmmsg provider."""
+    if udp._NATIVE is None:
+        pytest.skip("native lib not built")
+    fmt = formats.FASTMB_ROACH2
+    payload = fmt.payload_bytes
+    port = 42032
+    cfg = Config(
+        baseband_input_count=payload * 2,
+        baseband_input_bits=8,
+        baseband_format_type="fastmb_roach2",
+        udp_receiver_address=["127.0.0.1"],
+        udp_receiver_port=[port],
+        udp_packet_provider="packet_ring",
+        udp_packet_ring_interface="lo",
+        baseband_reserve_sample=False,
+    )
+    try:
+        src = udp.UdpReceiverSource(cfg)
+    except OSError:
+        pytest.skip("AF_PACKET ring unavailable (needs CAP_NET_RAW)")
+    assert isinstance(src.receiver, udp.PacketRingReceiver)
+
+    def payload_fn(c):
+        return bytes([c % 251]) * payload
+
+    sender = threading.Thread(
+        target=_send_packets, args=(port, fmt, [0, 1], payload_fn))
+    sender.start()
+    seg = next(src)
+    sender.join()
+    src.close()
+    assert seg.udp_packet_counter == 0
+    np.testing.assert_array_equal(seg.data[:payload], 0)
+    np.testing.assert_array_equal(seg.data[payload:], 1)
+
+
+def test_incompatible_provider_combos_are_refused():
+    """Explicitly configured but contradictory provider combinations must
+    error, not silently downgrade to a lossier receiver."""
+    fmt_kwargs = dict(
+        baseband_input_count=formats.FASTMB_ROACH2.payload_bytes,
+        baseband_input_bits=8,
+        baseband_format_type="fastmb_roach2",
+        udp_receiver_address=["127.0.0.1"],
+        udp_receiver_port=[42198],
+        baseband_reserve_sample=False,
+    )
+    with pytest.raises(ValueError, match="packet_ring"):
+        udp.UdpReceiverSource(Config(udp_receiver_mode="continuous",
+                                     udp_packet_provider="packet_ring",
+                                     **fmt_kwargs))
+    with pytest.raises(ValueError, match="recvfrom"):
+        udp.UdpReceiverSource(Config(udp_packet_provider="recvfrom",
+                                     **fmt_kwargs), use_native=True)
