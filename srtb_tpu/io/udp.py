@@ -411,6 +411,14 @@ class UdpReceiverSource:
             raise ValueError(
                 "use_native=True contradicts udp_packet_provider="
                 "'recvfrom' (the Python fallback)")
+        if provider == "packet_ring" and mode == "block" and (
+                _NATIVE is None or use_native is False):
+            # refuse-don't-downgrade, same policy as above: an explicit
+            # ring request must not silently become the lossy recvfrom
+            # fallback
+            raise ValueError(
+                "udp_packet_provider='packet_ring' needs the native lib "
+                "(make -C srtb_tpu/native) and use_native != False")
         if use_native is None:
             use_native = (_NATIVE is not None and mode == "block"
                           and provider != "recvfrom")

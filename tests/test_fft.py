@@ -6,6 +6,7 @@ four-step decomposition and the half-size-C2C R2C trick
 (ref: fft/fft_1d_r2c_post_process.hpp, naive_fft.hpp:219-261).
 """
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -100,3 +101,51 @@ def test_ifft_refft_waterfall():
     assert out.shape == (batch, channel_count)
     np.testing.assert_allclose(out, expected.astype(np.complex64),
                                rtol=1e-3, atol=0.5)
+
+
+class TestMxuFFT:
+    """DFT-matmul FFT (ops/mxu_fft.py) vs float64 numpy — same oracle
+    discipline as the four-step cases.  Runs on CPU via the identical
+    einsum graph the TPU executes on its MXU."""
+
+    def test_c2c_forward_and_inverse(self):
+        from srtb_tpu.ops.mxu_fft import mxu_fft
+        rng = np.random.default_rng(3)
+        n = 1 << 16
+        x = (rng.standard_normal(n)
+             + 1j * rng.standard_normal(n)).astype(np.complex64)
+        got = np.asarray(jax.jit(mxu_fft)(jnp.asarray(x)))
+        ref = np.fft.fft(x.astype(np.complex128))
+        err = np.abs(got - ref) / np.abs(ref).mean()
+        assert err.max() < 5e-5
+        # unnormalized inverse: ifft(fft(x)) == n * x
+        rt = np.asarray(jax.jit(
+            lambda v: mxu_fft(mxu_fft(v), inverse=True))(jnp.asarray(x)))
+        np.testing.assert_allclose(rt / n, x, atol=2e-4)
+
+    def test_c2c_batched(self):
+        from srtb_tpu.ops.mxu_fft import mxu_fft
+        rng = np.random.default_rng(4)
+        x = (rng.standard_normal((3, 1 << 12))
+             + 1j * rng.standard_normal((3, 1 << 12))).astype(np.complex64)
+        got = np.asarray(jax.jit(mxu_fft)(jnp.asarray(x)))
+        ref = np.fft.fft(x.astype(np.complex128), axis=-1)
+        assert (np.abs(got - ref) / np.abs(ref).mean()).max() < 5e-5
+
+    def test_segment_rfft_mxu_strategy(self):
+        rng = np.random.default_rng(5)
+        n = 1 << 18
+        x = rng.standard_normal(n).astype(np.float32)
+        got = np.asarray(jax.jit(
+            lambda v: jnp.stack([(y := F.segment_rfft(v, "mxu")).real,
+                                 y.imag]))(jnp.asarray(x)))
+        ref = np.fft.rfft(x.astype(np.float64))[:-1]
+        err = np.abs((got[0] + 1j * got[1]) - ref) / np.abs(ref).mean()
+        assert err.max() < 5e-5
+
+    def test_radix_validation(self):
+        from srtb_tpu.ops.mxu_fft import mxu_fft
+        with pytest.raises(ValueError, match="power-of-two"):
+            mxu_fft(jnp.ones(96, jnp.complex64))
+        with pytest.raises(ValueError, match="radix"):
+            mxu_fft(jnp.ones(128, jnp.complex64), radix=96)
