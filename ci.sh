@@ -10,10 +10,13 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "== [1/5] native build =="
+echo "== [1/6] native build =="
 make -C srtb_tpu/native
 
-echo "== [2/5] static checks (compile + import) =="
+echo "== [2/6] native sanitizer harness (ASan/UBSan) =="
+make -C srtb_tpu/native check
+
+echo "== [3/6] static checks (compile + import) =="
 python -m compileall -q srtb_tpu tests bench.py __graft_entry__.py
 python - <<'EOF'
 import importlib, pkgutil
@@ -28,7 +31,7 @@ assert not bad, bad
 print(f"all srtb_tpu modules import cleanly")
 EOF
 
-echo "== [3/5] pytest (8-device CPU mesh) =="
+echo "== [4/6] pytest (8-device CPU mesh) =="
 FAST_ARGS=()
 if [ "${1:-}" = "--fast" ]; then
   FAST_ARGS=(--deselect tests/test_dist_fft.py::test_dist_fft_large_n_twiddle_precision
@@ -36,10 +39,10 @@ if [ "${1:-}" = "--fast" ]; then
 fi
 python -m pytest tests/ -q "${FAST_ARGS[@]}"
 
-echo "== [4/5] bench smoke =="
+echo "== [5/6] bench smoke =="
 JAX_PLATFORMS=cpu SRTB_BENCH_LOG2N=16 python bench.py | tail -1
 
-echo "== [5/5] multichip dryrun (8 virtual devices) =="
+echo "== [6/6] multichip dryrun (8 virtual devices) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
