@@ -234,3 +234,41 @@ def test_staged_matches_fused(synthetic_cfg):
     ts_f = np.asarray(res_f.time_series)
     np.testing.assert_allclose(np.asarray(res_s.time_series), ts_f,
                                rtol=0, atol=5e-3 * np.abs(ts_f).max())
+
+
+def test_staged_multistream_and_window(tmp_path):
+    """Staged plan with a 2-stream interleaved format and a hann window:
+    the window must be applied at unpack and de-applied after the
+    waterfall C2C in stage (c), identically to the fused plan."""
+    from srtb_tpu.io.synth import make_dispersed_baseband
+
+    n = 1 << 16
+    f_min, bw, dm = 1405.0, 64.0, 30.0
+    one = make_dispersed_baseband(n, f_min, bw, dm,
+                                  pulse_positions=n // 2, nbits=8)
+    # byte-interleave two copies ("1212", ref: unpack.hpp:214-244)
+    raw = np.empty(2 * n, dtype=np.uint8)
+    raw[0::2] = one
+    raw[1::2] = one
+    cfg = Config(
+        baseband_input_count=n,
+        baseband_input_bits=8,
+        baseband_format_type="interleaved_samples_2",
+        baseband_freq_low=f_min,
+        baseband_bandwidth=bw,
+        baseband_sample_rate=128e6,
+        dm=dm,
+        spectrum_channel_count=1 << 7,
+        signal_detect_signal_noise_threshold=6.0,
+        baseband_reserve_sample=False,
+    )
+    fused = SegmentProcessor(cfg, window_name="hann")
+    staged = SegmentProcessor(cfg, window_name="hann", staged=True)
+    wf_f, res_f = fused.process(raw)
+    wf_s, res_s = staged.process(raw)
+    wf_f, wf_s = np.asarray(wf_f), np.asarray(wf_s)
+    assert wf_f.shape[1] == 2  # two data streams
+    scale = np.abs(wf_f).max()
+    np.testing.assert_allclose(wf_s, wf_f, atol=5e-3 * scale, rtol=0)
+    assert np.array_equal(np.asarray(res_f.signal_counts),
+                          np.asarray(res_s.signal_counts))
