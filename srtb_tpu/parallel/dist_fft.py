@@ -30,7 +30,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from srtb_tpu.ops.fft import _phase_exp
+from srtb_tpu.ops.fft import _phase_exp, pack_even_odd
 
 
 def _local_transpose_a2a(x_block, axis_name, n_dev):
@@ -151,8 +151,9 @@ def dist_rfft_drop_nyquist(x, mesh: Mesh, axis_name: str = "seq"):
     n_dev = mesh.shape[axis_name]
 
     def pack(blk):
-        z = blk.reshape(-1, 2)
-        return jax.lax.complex(z[:, 0], z[:, 1])
+        # lane-dense even/odd pack — a [m, 2] reshape pads its minor dim
+        # 2 -> 128 lanes on real TPU (64x HBM), see ops/fft.pack_even_odd
+        return pack_even_odd(blk)
 
     z = shard_map(pack, mesh=mesh, in_specs=P(axis_name),
                   out_specs=P(axis_name))(x.astype(jnp.float32))

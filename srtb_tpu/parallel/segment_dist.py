@@ -30,6 +30,7 @@ from srtb_tpu.config import Config
 from srtb_tpu.io import formats
 from srtb_tpu.ops import dedisperse as dd
 from srtb_tpu.ops import detect as det
+from srtb_tpu.ops import fft as F
 from srtb_tpu.ops import rfi
 from srtb_tpu.ops import unpack as U
 from srtb_tpu.ops import window as W
@@ -177,8 +178,9 @@ class DistSegmentProcessor:
         n2 = m // n1
         specs = []
         for s in range(n_streams):  # S is tiny (1-4); loop, don't vmap
-            z = xs[s].reshape(-1, 2)
-            z = jax.lax.complex(z[:, 0], z[:, 1])
+            # lane-dense even/odd pack — a [m, 2] reshape pads its minor
+            # dim 2 -> 128 lanes on real TPU (64x HBM, ops/fft.py)
+            z = F.pack_even_odd(xs[s])
             zf = DF._dist_fft_block(z, axis_name="seq", n1=n1, n2=n2,
                                     n_dev=n_seq, inverse=False)
             spec = DF._dist_rfft_post_block(zf, axis_name="seq", m=m,
