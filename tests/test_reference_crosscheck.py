@@ -178,3 +178,21 @@ def test_rfi_decision_parity_with_injected_tone():
     assert zapped_rows == zapped_rows_o, (zapped_rows, zapped_rows_o)
     assert zapped_rows >= 1  # the tone really tripped something
     assert int(np.asarray(res.zero_count)[0]) == zapped_rows_o
+
+
+@pytest.mark.parametrize("strategy", ["four_step", "mxu"])
+def test_alternate_fft_backends_match_oracle(crosscheck_run, strategy):
+    """Every FFT backend (not just the default monolithic XLA op) must
+    reproduce the reference-transliteration oracle's waterfall: the
+    four-step decomposition and the MXU DFT-matmul path go through the
+    same pack + Hermitian post-process, so this pins their conventions
+    (unnormalized, drop-Nyquist, frequency-major) to the oracle too."""
+    cfg, _, _, wf_o, _ = crosscheck_run
+    from srtb_tpu.pipeline.segment import SegmentProcessor, \
+        waterfall_to_numpy
+    proc = SegmentProcessor(cfg.replace(fft_strategy=strategy))
+    raw = np.fromfile(cfg.input_file_path, dtype=np.uint8,
+                      count=cfg.baseband_input_count // 4)
+    wf = waterfall_to_numpy(proc.process(raw)[0])[0]
+    scale = np.abs(wf_o).max()
+    np.testing.assert_allclose(wf, wf_o, atol=2e-4 * scale, rtol=0)
