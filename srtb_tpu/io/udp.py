@@ -14,10 +14,12 @@ counter.
 
 from __future__ import annotations
 
+import collections
 import ctypes
 import os
 import socket
 import struct
+import threading
 import time
 
 import numpy as np
@@ -230,6 +232,14 @@ class PythonBlockReceiver:
     def _parse_counter(self, pkt: bytes) -> int:
         return parse_packet_counter(self.fmt, pkt)
 
+    def _next_packet(self) -> bytes:
+        """Blocking fetch of one full-size packet (overridden by the
+        asyncio provider; the base class reads the socket directly)."""
+        while True:
+            pkt, _ = self._sock.recvfrom(self.fmt.packet_payload_size + 64)
+            if len(pkt) >= self.fmt.packet_payload_size:
+                return pkt
+
     def receive_block(self, out: np.ndarray) -> tuple[int, int, int]:
         fmt = self.fmt
         payload = fmt.payload_bytes
@@ -244,9 +254,7 @@ class PythonBlockReceiver:
                 c, pkt = self._pending
                 self._pending = None
             else:
-                pkt, _ = self._sock.recvfrom(fmt.packet_payload_size + 64)
-                if len(pkt) < fmt.packet_payload_size:
-                    continue
+                pkt = self._next_packet()
                 c = self._parse_counter(pkt)
             if begin is None:
                 begin = c
@@ -272,6 +280,97 @@ class PythonBlockReceiver:
 
     def close(self):
         self._sock.close()
+
+
+class AsyncioBlockReceiver(PythonBlockReceiver):
+    """Event-loop packet provider: the analog of the reference's
+    boost::asio provider (ref: io/udp/asio_udp_packet_provider.hpp:1-66,
+    an io_context-driven receive_from on the same socket the other
+    providers use).  Packets are received by an asyncio
+    ``DatagramProtocol`` on a dedicated event-loop thread and handed to
+    the block assembler (inherited from :class:`PythonBlockReceiver`)
+    through a bounded deque; on overflow the oldest packet is dropped and
+    surfaces as counter-gap loss, exactly like a kernel buffer drop.
+    """
+
+    def __init__(self, addr: str, port: int, fmt: formats.PacketFormat,
+                 rcvbuf_bytes: int = 1 << 26, queue_packets: int = 8192):
+        super().__init__(addr, port, fmt, rcvbuf_bytes)
+        self._q: "collections.deque[bytes]" = collections.deque()
+        self._q_max = queue_packets
+        self._cv = threading.Condition()
+        self._loop = None
+        self._transport = None
+        self._startup_error: BaseException | None = None
+        self._ready = threading.Event()
+        self._thread = threading.Thread(target=self._run_loop,
+                                        name="srtb-asyncio-udp",
+                                        daemon=True)
+        self._thread.start()
+        # bounded wait + error propagation: a loop-setup failure (e.g. fd
+        # exhaustion while creating the selector) must surface here, not
+        # hang the constructor
+        self._ready.wait(timeout=10)
+        if self._startup_error is not None:
+            raise RuntimeError(
+                "asyncio UDP provider failed to start") \
+                from self._startup_error
+        if not self._ready.is_set():
+            raise RuntimeError("asyncio UDP provider startup timed out")
+
+    def _run_loop(self):
+        import asyncio
+
+        outer = self
+
+        class _Proto(asyncio.DatagramProtocol):
+            def datagram_received(self, data, _addr):
+                with outer._cv:
+                    if len(outer._q) >= outer._q_max:
+                        outer._q.popleft()
+                    outer._q.append(data)
+                    outer._cv.notify()
+
+        try:
+            loop = asyncio.new_event_loop()
+            self._loop = loop
+            self._sock.setblocking(False)
+            transport, _ = loop.run_until_complete(
+                loop.create_datagram_endpoint(_Proto, sock=self._sock))
+            self._transport = transport
+        except BaseException as e:  # propagated by __init__
+            self._startup_error = e
+            self._ready.set()
+            return
+        self._ready.set()
+        try:
+            loop.run_forever()
+        finally:
+            transport.close()
+            loop.run_until_complete(loop.shutdown_asyncgens())
+            loop.close()
+
+    def _next_packet(self) -> bytes:
+        need = self.fmt.packet_payload_size
+        while True:
+            with self._cv:
+                while not self._q:
+                    self._cv.wait()
+                pkt = self._q.popleft()
+            if len(pkt) >= need:
+                return pkt
+
+    def close(self):
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=5)
+            self._loop = None
+        # the datagram transport owns (and closed) self._sock; the base
+        # close is a harmless double-close guard
+        try:
+            super().close()
+        except OSError:  # pragma: no cover
+            pass
 
 
 class PythonContinuousReceiver:
@@ -397,8 +496,19 @@ class UdpReceiverSource:
         if mode not in ("block", "continuous"):
             raise ValueError(f"unknown udp_receiver_mode {mode!r}")
         provider = getattr(cfg, "udp_packet_provider", "recvmmsg")
-        if provider not in ("recvmmsg", "packet_ring", "recvfrom"):
+        if provider not in ("recvmmsg", "packet_ring", "recvfrom",
+                            "asyncio"):
             raise ValueError(f"unknown udp_packet_provider {provider!r}")
+        if provider == "asyncio":
+            if mode == "continuous":
+                raise ValueError(
+                    "udp_packet_provider='asyncio' implements the block "
+                    "worker only (like the reference's asio provider it "
+                    "is an alternative packet transport, not a worker)")
+            if use_native:
+                raise ValueError(
+                    "use_native=True contradicts udp_packet_provider="
+                    "'asyncio' (the event-loop Python provider)")
         if mode == "continuous" and provider == "packet_ring":
             # refuse rather than silently downgrade: the operator asked
             # for the zero-loss ring but the continuous worker is the
@@ -421,7 +531,7 @@ class UdpReceiverSource:
                 "(make -C srtb_tpu/native) and use_native != False")
         if use_native is None:
             use_native = (_NATIVE is not None and mode == "block"
-                          and provider != "recvfrom")
+                          and provider not in ("recvfrom", "asyncio"))
         if mode == "continuous":
             # the continuous worker is sequential by construction; the
             # native recvmmsg path currently implements only the block
@@ -434,6 +544,8 @@ class UdpReceiverSource:
                 interface=getattr(cfg, "udp_packet_ring_interface", "lo"))
         elif use_native:
             self.receiver = NativeBlockReceiver(addr, port, self.fmt)
+        elif provider == "asyncio":
+            self.receiver = AsyncioBlockReceiver(addr, port, self.fmt)
         else:
             self.receiver = PythonBlockReceiver(addr, port, self.fmt)
         self.data_stream_id = receiver_id

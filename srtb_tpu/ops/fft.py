@@ -119,6 +119,31 @@ def _phase_exp(r: jnp.ndarray, n: int, sign: float) -> jnp.ndarray:
             * jax.lax.complex(jnp.cos(b), jnp.sin(b)))
 
 
+def _iota_phase(m: int, n: int, sign: float,
+                block: int = 256) -> jnp.ndarray:
+    """exp(i*sign*2*pi*k/n) for k = 0..m-1, as the outer product of two
+    small tables: k = block*q + r, so w[k] = W[q] * V[r] with
+    W[q] = exp(i*s*block*q/n), V[r] = exp(i*s*r/n).
+
+    Computing the phase per element costs ~4 transcendentals for each of
+    m points (the dominant cost of the Hermitian post-process at
+    m = 2^26, measured); the factored form needs m/block + block of them
+    plus one complex multiply per point, and its [m/block, block] shape
+    is lane-dense.  Accuracy: q*block and r are f32-exact (both well
+    under 2^24), so each factor's phase argument is exact — same
+    discipline as `_phase_exp`, via the structure of k instead of a
+    hi/lo split."""
+    if m % block or m < block:
+        return _phase_exp(jax.lax.iota(jnp.int32, m), n, sign)
+    scale = jnp.float32(sign * 2.0 * np.pi / n)
+    q = jax.lax.iota(jnp.int32, m // block)[:, None].astype(jnp.float32) \
+        * (block * scale)
+    r = jax.lax.iota(jnp.int32, block)[None, :].astype(jnp.float32) * scale
+    w = (jax.lax.complex(jnp.cos(q), jnp.sin(q))
+         * jax.lax.complex(jnp.cos(r), jnp.sin(r)))
+    return w.reshape(m)
+
+
 def _twiddle(n1: int, n2: int, inverse: bool) -> jnp.ndarray:
     """w[j1, j2] = exp(+-2*pi*i*j1*j2/n), generated inside the trace.
 
@@ -264,7 +289,7 @@ def hermitian_rfft_post(zf: jnp.ndarray,
         f_k = zf                                           # k in [0, m)
         # [(m-0)%m, m-1, ..., 1] = roll(flip(zf), 1)
         f_mk = jnp.conj(jnp.roll(jnp.flip(zf, axis=-1), 1, axis=-1))
-        w = _phase_exp(jax.lax.iota(jnp.int32, m), n, -1.0)
+        w = _iota_phase(m, n, -1.0)
     else:
         f_k = jnp.concatenate([zf, zf[..., :1]], axis=-1)  # F[m] = F[0]
         rev = jnp.flip(zf, axis=-1)                        # [m-1, ..., 0]
@@ -277,11 +302,106 @@ def hermitian_rfft_post(zf: jnp.ndarray,
     return even + w * odd
 
 
+def subbyte_window_planes(window: np.ndarray, nbits: int) -> np.ndarray:
+    """Reorder a sample-order window [n] into blocked field planes
+    [count, M] matching `unpack_subbyte_planes` (host-side numpy: the
+    strided reshape would be a pathological layout on device)."""
+    count = 8 // nbits
+    return np.ascontiguousarray(
+        np.asarray(window).reshape(-1, count).T)
+
+
+def rfft_subbyte(data: jnp.ndarray, nbits: int, strategy: str = "four_step",
+                 window_planes: jnp.ndarray | None = None,
+                 drop_nyquist: bool = True) -> jnp.ndarray:
+    """Fused unpack + even/odd pack + R2C for 1/2/4-bit baseband bytes,
+    with every intermediate lane-dense.
+
+    The sample-order composition (unpack -> pack_even_odd -> C2C) forces
+    a [bytes, count]-shaped interleave whose TPU layout pads count -> 128
+    lanes — materialized, that is a 16 GB copy at n = 2^27 (measured).
+    This path never builds sample order at all:
+
+    - `unpack_subbyte_planes` emits blocked field planes [count, M]
+      (plane k = field k of every byte, sample count*b + k);
+    - with count even, even-indexed samples are exactly the even field
+      planes, so the packed half-size sequence z[t] = x[2t] + i*x[2t+1]
+      is plane pairs: z[p*b + k'] = planes[2k'][b] + i*planes[2k'+1][b]
+      — a [p, M] complex array, p = count/2, no interleave;
+    - z is blocked over p planes, i.e. already in the [j2, j1] layout the
+      four-step uses *after* its first transpose: FFT_M each plane, then
+      the twiddle exp(-2pi*i*j2*k1/m) and a p-point cross-plane butterfly
+      finish the m = p*M transform, and the [p(k2), M(k1)] result *is*
+      natural order flattened — the blocked->natural permutation has been
+      absorbed into the decimation for free;
+    - Hermitian post-process as usual (ref fft_1d_r2c_post_process.hpp).
+
+    ``window_planes``: optional [count, M] from `subbyte_window_planes`.
+    ``strategy``: "four_step" (XLA batched FFTs) or "mxu" (DFT-matmul
+    stages) for the M-point plane FFTs.
+    """
+    from srtb_tpu.ops import unpack as _U
+    count = 8 // nbits
+    if count < 2:
+        raise ValueError("rfft_subbyte requires 1/2/4-bit input")
+    planes = _U.unpack_subbyte_planes(data, nbits)        # [..., count, M]
+    if window_planes is not None:
+        planes = planes * window_planes
+    z = subbyte_planes_to_packed(planes)
+    if strategy == "mxu":
+        from srtb_tpu.ops.mxu_fft import mxu_fft
+        a = mxu_fft(z)                                    # [..., p, M]
+    elif strategy == "monolithic":
+        a = jnp.fft.fft(z, axis=-1)  # one batched XLA FFT over the planes
+    else:
+        a = _fft_minor(z, inverse=False)
+    return finish_rfft_subbyte(a, drop_nyquist)
+
+
+def subbyte_planes_to_packed(planes: jnp.ndarray) -> jnp.ndarray:
+    """Blocked field planes [..., count, M] -> packed complex plane pairs
+    z[..., p, M] (p = count/2): z[p*b + k'] = x[2t] + i*x[2t+1] of the
+    sample-order sequence, held blocked."""
+    return jax.lax.complex(planes[..., 0::2, :], planes[..., 1::2, :])
+
+
+def finish_rfft_subbyte(a: jnp.ndarray,
+                        drop_nyquist: bool = True) -> jnp.ndarray:
+    """Finish `rfft_subbyte` from the per-plane FFTs a[..., p, M]:
+    twiddle + p-point cross-plane butterfly + Hermitian post-process.
+    Split out so the staged execution plan (pipeline/segment.py) can run
+    the plane FFTs and the finish in separate XLA programs."""
+    p, m_bytes = a.shape[-2], a.shape[-1]
+    m = p * m_bytes
+    if p > 1:
+        k1 = jax.lax.iota(jnp.int32, m_bytes)[None, :]
+        j2 = jax.lax.iota(jnp.int32, p)[:, None]
+        a = a * _phase_exp((j2 * k1) % m, m, -1.0)
+        # p-point DFT across the plane axis (p <= 4: a handful of
+        # complex-scalar multiply-adds, fused elementwise by XLA)
+        wp = np.exp(-2j * np.pi * np.outer(np.arange(p), np.arange(p))
+                    / p).astype(np.complex64)
+        rows = [sum(complex(wp[k2, j]) * a[..., j, :] for j in range(p))
+                for k2 in range(p)]
+        a = jnp.stack(rows, axis=-2)
+    zf = a.reshape(*a.shape[:-2], m)
+    return hermitian_rfft_post(zf, drop_nyquist)
+
+
 # Threshold (packed C2C length, = n/2) above which the segment R2C
 # switches to the four-step path.  Tuned on a v5e: the monolithic XLA R2C
 # works and wins through n = 2^29; at n = 2^30 XLA's compile OOMs
 # (PERF_TPU.jsonl n2_29/n2_30 A/Bs), so only 2^30+ takes the four-step.
 LARGE_FFT_THRESHOLD = 1 << 28
+
+
+def resolve_strategy(n: int, strategy: str) -> str:
+    """Resolve "auto" to a concrete segment-R2C strategy for n samples
+    (monolithic XLA R2C wins through n = 2^29 on a v5e; above, four-step
+    is the only one that fits — see LARGE_FFT_THRESHOLD)."""
+    if strategy == "auto":
+        return "four_step" if n // 2 > LARGE_FFT_THRESHOLD else "monolithic"
+    return strategy
 
 
 def segment_rfft(x: jnp.ndarray, strategy: str = "auto") -> jnp.ndarray:
@@ -298,15 +418,7 @@ def segment_rfft(x: jnp.ndarray, strategy: str = "auto") -> jnp.ndarray:
       the systolic array (ops/mxu_fft.py) — measured ~25% faster than
       the monolithic XLA R2C at the 2^27 bench size on a v5e.
     """
-    n = x.shape[-1]
-    if strategy == "auto":
-        # "mxu" measured faster than the monolithic XLA R2C at 2^26
-        # packed C2C on a v5e (31 vs 35 ms; the monolithic R2C itself is
-        # 47 ms at 2^27 samples) but stays opt-in until the combined
-        # pack + DFT-matmul + Hermitian program is validated end-to-end
-        # on hardware; XLA's own FFT wins below ~2^23 and on CPU.
-        strategy = "four_step" if n // 2 > LARGE_FFT_THRESHOLD \
-            else "monolithic"
+    strategy = resolve_strategy(x.shape[-1], strategy)
     if strategy == "four_step":
         return rfft_via_c2c(x, use_four_step=True, drop_nyquist=True)
     if strategy == "mxu":

@@ -88,6 +88,20 @@ class SegmentProcessor:
         # ---- precomputed constants ----
         win = W.window_coefficients(window_name, n)
         self.window = None if win is None else jnp.asarray(win)
+        # Simple-format sub-byte segments take the fused blocked-plane
+        # R2C (ops/fft.rfft_subbyte): unpack + pack + FFT with no
+        # sample-order interleave anywhere — the sample-order composition
+        # materializes a [bytes, count] layout that pads 32x on TPU.
+        # The Pallas unpack path emits sample order, so it keeps the
+        # classic route.
+        self._blocked_subbyte = (
+            self.fmt.unpack_variant == "simple"
+            and cfg.baseband_input_bits in (1, 2, 4)
+            and not cfg.use_pallas)
+        self.window_planes = None
+        if self._blocked_subbyte and win is not None:
+            self.window_planes = jnp.asarray(F.subbyte_window_planes(
+                win, cfg.baseband_input_bits))
         # watfft-length window to divide out of the dynamic spectrum after
         # the backward C2C (ref: fft_pipe.hpp:346-359); zero edges already
         # sanitized to 1 by dewindow_coefficients
@@ -163,23 +177,38 @@ class SegmentProcessor:
                               cfg.baseband_input_bits, self.window)
 
     def _process(self, raw: jnp.ndarray, chirp_ri: jnp.ndarray):
-        x = self._unpack(raw)
-        spec = F.segment_rfft(x, self.cfg.fft_strategy)    # [S, n/2]
+        strategy = F.resolve_strategy(self.n, self.cfg.fft_strategy)
+        if self._blocked_subbyte and strategy in ("four_step", "mxu"):
+            spec = F.rfft_subbyte(raw, self.cfg.baseband_input_bits,
+                                  strategy, self.window_planes)[None, :]
+        else:
+            x = self._unpack(raw)
+            spec = F.segment_rfft(x, strategy)             # [S, n/2]
         return self._spectrum_tail(spec, chirp_ri)
 
     # ---- staged plan: three programs with (re, im) f32 boundaries ----
 
     def _stage_a(self, raw: jnp.ndarray):
         """unpack + even/odd pack + four-step first half."""
-        x = self._unpack(raw)
-        a = F.four_step_stage1(F.pack_even_odd(x))     # [S, n2, n1]
+        if self._blocked_subbyte:
+            planes = U.unpack_subbyte_planes(
+                raw, self.cfg.baseband_input_bits)
+            if self.window_planes is not None:
+                planes = planes * self.window_planes
+            a = F.four_step_stage1(F.subbyte_planes_to_packed(planes))
+        else:
+            x = self._unpack(raw)
+            a = F.four_step_stage1(F.pack_even_odd(x))    # [S, n2, n1]
         return jnp.stack([jnp.real(a), jnp.imag(a)])
 
     def _stage_b(self, a_ri: jnp.ndarray):
         """four-step second half + Hermitian post -> spectrum [S, n/2]."""
         a = jax.lax.complex(a_ri[0], a_ri[1])
-        spec = F.hermitian_rfft_post(F.four_step_stage2(a),
-                                     drop_nyquist=True)
+        if self._blocked_subbyte:
+            spec = F.finish_rfft_subbyte(F.four_step_stage2(a))[None, :]
+        else:
+            spec = F.hermitian_rfft_post(F.four_step_stage2(a),
+                                         drop_nyquist=True)
         return jnp.stack([jnp.real(spec), jnp.imag(spec)])
 
     def _stage_c(self, spec_ri: jnp.ndarray):
@@ -218,8 +247,21 @@ class SegmentProcessor:
         else:
             chirp = jax.lax.complex(chirp_ri[0], chirp_ri[1])
             spec = dd.dedisperse(spec, chirp)
-        wf = F.waterfall_c2c(spec, self.channel_count,
-                             self.watfft_dewindow)      # [S, F, T]
+        from srtb_tpu.ops import pallas_fft as pf
+        if use_pallas and pf.supported(self.watfft_len,
+                                       spec.shape[0] * self.channel_count):
+            # one-HBM-pass Pallas waterfall C2C (ops/pallas_fft): rows in
+            # VMEM, DFT-matmul stages on the MXU
+            x = spec[..., :self.channel_count * self.watfft_len].reshape(
+                *spec.shape[:-1], self.channel_count, self.watfft_len)
+            wr, wi = pf.fft_rows_ri(jnp.real(x), jnp.imag(x),
+                                    inverse=True, interpret=interp)
+            wf = jax.lax.complex(wr, wi)
+            if self.watfft_dewindow is not None:
+                wf = wf / self.watfft_dewindow
+        else:
+            wf = F.waterfall_c2c(spec, self.channel_count,
+                                 self.watfft_dewindow)  # [S, F, T]
         if use_pallas and pk.sk_tiling_ok(wf.shape[-2], wf.shape[-1]):
             zapped, zero_counts, ts_rows = [], [], []
             for s in range(n_streams):

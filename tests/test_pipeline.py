@@ -272,3 +272,50 @@ def test_staged_multistream_and_window(tmp_path):
     np.testing.assert_allclose(wf_s, wf_f, atol=5e-3 * scale, rtol=0)
     assert np.array_equal(np.asarray(res_f.signal_counts),
                           np.asarray(res_s.signal_counts))
+
+
+def test_blocked_subbyte_strategies_and_staged_match():
+    """Sub-byte simple-format segments run the fused blocked-plane R2C
+    (ops/fft.rfft_subbyte: unpack + pack + FFT with no sample-order
+    interleave).  Every strategy and the staged plan must agree with the
+    classic monolithic path, window included."""
+    from srtb_tpu.io.synth import make_dispersed_baseband
+
+    n = 1 << 16
+    f_min, bw, dm = 1405.0, 64.0, 30.0
+    raw = make_dispersed_baseband(n, f_min, bw, dm,
+                                  pulse_positions=n // 2, nbits=2)
+    base = dict(
+        baseband_input_count=n,
+        baseband_input_bits=2,
+        baseband_format_type="simple",
+        baseband_freq_low=f_min,
+        baseband_bandwidth=bw,
+        baseband_sample_rate=128e6,
+        dm=dm,
+        spectrum_channel_count=1 << 7,
+        signal_detect_signal_noise_threshold=6.0,
+        baseband_reserve_sample=False,
+    )
+    ref = SegmentProcessor(Config(fft_strategy="monolithic", **base),
+                           window_name="hann")
+    assert ref._blocked_subbyte
+    wf_ref, res_ref = ref.process(raw)
+    wf_ref = np.asarray(wf_ref)
+    scale = np.abs(wf_ref).max()
+    variants = {
+        "four_step": SegmentProcessor(
+            Config(fft_strategy="four_step", **base), window_name="hann"),
+        "mxu": SegmentProcessor(
+            Config(fft_strategy="mxu", **base), window_name="hann"),
+        "staged": SegmentProcessor(
+            Config(fft_strategy="four_step", **base), window_name="hann",
+            staged=True),
+    }
+    for name, proc in variants.items():
+        wf, res = proc.process(raw)
+        np.testing.assert_allclose(
+            np.asarray(wf), wf_ref, atol=5e-3 * scale, rtol=0,
+            err_msg=name)
+        assert np.array_equal(np.asarray(res.signal_counts),
+                              np.asarray(res_ref.signal_counts)), name

@@ -227,3 +227,17 @@ def test_main_cli_scrolling_gui(tmp_path):
     ])
     assert rc == 0
     assert os.path.exists(str(tmp_path / "waterfall_s0_scroll.png"))
+
+
+def test_test_gui_tool(tmp_path):
+    """The test-gui analog (ref: src/test-gui.cpp): synthetic spectra
+    through both real waterfall providers, PNGs on disk."""
+    from srtb_tpu.tools.test_gui import main
+
+    out = str(tmp_path / "gui")
+    rc = main(["--out", out, "--frames", "2", "--streams", "1",
+               "--freq", "64", "--time", "128", "--scroll-lines", "4"])
+    assert rc == 0
+    names = sorted(p.name for p in (tmp_path / "gui").iterdir())
+    assert "waterfall_s0_000000.png" in names
+    assert "waterfall_s0_scroll.png" in names

@@ -435,3 +435,57 @@ def test_incompatible_provider_combos_are_refused():
     with pytest.raises(ValueError, match="recvfrom"):
         udp.UdpReceiverSource(Config(udp_packet_provider="recvfrom",
                                      **fmt_kwargs), use_native=True)
+
+
+# ----------------------------------------------------------------
+# asyncio event-loop provider (the boost::asio analog)
+# ----------------------------------------------------------------
+
+def test_asyncio_block_assembly_with_loss():
+    """The asyncio provider must assemble blocks (and zero-fill counter
+    gaps) exactly like the plain recvfrom provider — same worker, other
+    transport (ref: io/udp/asio_udp_packet_provider.hpp:1-66)."""
+    fmt = formats.FASTMB_ROACH2
+    payload = fmt.payload_bytes
+    port = 42033
+    rx = udp.AsyncioBlockReceiver("127.0.0.1", port, fmt)
+
+    def payload_fn(c):
+        return bytes([c % 100]) * payload
+
+    # drop counter 2: receive_block must zero-fill its slot
+    sender = threading.Thread(
+        target=_send_packets, args=(port, fmt, [1, 3, 4], payload_fn))
+    sender.start()
+    out = np.zeros(3 * payload, dtype=np.uint8)
+    first, lost, total = rx.receive_block(out)
+    sender.join()
+    rx.close()
+    assert (first, lost, total) == (1, 1, 3)
+    np.testing.assert_array_equal(out[:payload], 1)
+    np.testing.assert_array_equal(out[payload:2 * payload], 0)
+    np.testing.assert_array_equal(out[2 * payload:], 3)
+
+
+def test_asyncio_provider_selection_and_refusals():
+    fmt_kwargs = dict(
+        baseband_input_count=formats.FASTMB_ROACH2.payload_bytes,
+        baseband_input_bits=8,
+        baseband_format_type="fastmb_roach2",
+        udp_receiver_address=["127.0.0.1"],
+        udp_receiver_port=[42034],
+        baseband_reserve_sample=False,
+    )
+    src = udp.UdpReceiverSource(Config(udp_packet_provider="asyncio",
+                                       **fmt_kwargs))
+    try:
+        assert isinstance(src.receiver, udp.AsyncioBlockReceiver)
+    finally:
+        src.close()
+    with pytest.raises(ValueError, match="asyncio"):
+        udp.UdpReceiverSource(Config(udp_receiver_mode="continuous",
+                                     udp_packet_provider="asyncio",
+                                     **fmt_kwargs))
+    with pytest.raises(ValueError, match="asyncio"):
+        udp.UdpReceiverSource(Config(udp_packet_provider="asyncio",
+                                     **fmt_kwargs), use_native=True)
