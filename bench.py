@@ -152,6 +152,8 @@ def run_bench(platform_error):
         baseband_reserve_sample=False,
         fft_strategy=os.environ.get("SRTB_BENCH_FFT_STRATEGY", "auto"),
         use_pallas=bool(int(os.environ.get("SRTB_BENCH_USE_PALLAS", "0"))),
+        use_pallas_sk=bool(int(os.environ.get("SRTB_BENCH_USE_PALLAS_SK",
+                                              "0"))),
     )
     proc = SegmentProcessor(cfg)
 
@@ -224,9 +226,40 @@ def run_bench(platform_error):
     emit(out)
 
 
+def _arm_watchdog(platform, err):
+    """Hard deadline for the whole bench: a wedged TPU tunnel can hang
+    *mid-run* (device_put/compile never returning — observed on a v5e
+    after a compiler SIGSEGV wedged the remote helper), where the init
+    probe can't help.  On expiry, emit the diagnostic JSON line and exit
+    0 so the driver still records an artifact."""
+    import threading
+
+    deadline = float(os.environ.get("SRTB_BENCH_DEADLINE", "3000"))
+    if deadline <= 0:
+        return
+
+    def fire():
+        emit({
+            "metric": "coherent_dedispersion_pipeline_throughput",
+            "value": 0.0,
+            "unit": "Msamples/s/chip",
+            "vs_baseline": 0.0,
+            "error": f"bench deadline exceeded ({deadline:.0f}s): "
+                     "backend hang mid-run (wedged tunnel?)",
+            "platform": platform,
+            "accelerator_error": err,
+        })
+        os._exit(0)
+
+    t = threading.Timer(deadline, fire)
+    t.daemon = True
+    t.start()
+
+
 def main():
     platform, err = pick_platform()
     os.environ["JAX_PLATFORMS"] = platform
+    _arm_watchdog(platform, err)
     try:
         run_bench(err)
     except Exception as e:  # always land a JSON diagnostic, never rc != 0

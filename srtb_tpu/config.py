@@ -106,9 +106,14 @@ class Config:
     fft_fftw_wisdom_path: str = ""
     # segment R2C strategy: auto | monolithic | four_step
     fft_strategy: str = "auto"
-    # use Pallas fused kernels where available (df64 chirp-multiply,
-    # 2-bit unpack+window)
+    # use Pallas fused kernels where available (fused RFI-s1 + df64
+    # chirp-multiply, VMEM row-FFT waterfall C2C)
     use_pallas: bool = False
+    # fused SK-zap + time-series Pallas kernel: separate knob because it
+    # measured *slower* than the jnp pair at bench shapes
+    # (PERF_TPU.jsonl kernel rows) — opt-in for shapes where the 2-read
+    # pass wins
+    use_pallas_sk: bool = False
     # candidate-writer thread count; >0 uses the async writer pool (native
     # C++ when built — the reference's boost thread pools,
     # write_signal_pipe.hpp:159-280), 0 writes synchronously
@@ -162,7 +167,7 @@ class Config:
     })
     _BOOL_FIELDS = frozenset({
         "baseband_reserve_sample", "baseband_write_all", "gui_enable",
-        "use_emulated_fp64", "use_pallas",
+        "use_emulated_fp64", "use_pallas", "use_pallas_sk",
     })
     _LIST_FIELDS = frozenset({
         "udp_receiver_address", "udp_receiver_port",

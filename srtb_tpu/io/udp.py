@@ -301,6 +301,7 @@ class AsyncioBlockReceiver(PythonBlockReceiver):
         self._cv = threading.Condition()
         self._loop = None
         self._transport = None
+        self._closed = False
         self._startup_error: BaseException | None = None
         self._ready = threading.Event()
         self._thread = threading.Thread(target=self._run_loop,
@@ -311,11 +312,12 @@ class AsyncioBlockReceiver(PythonBlockReceiver):
         # exhaustion while creating the selector) must surface here, not
         # hang the constructor
         self._ready.wait(timeout=10)
-        if self._startup_error is not None:
-            raise RuntimeError(
-                "asyncio UDP provider failed to start") \
-                from self._startup_error
-        if not self._ready.is_set():
+        if self._startup_error is not None or not self._ready.is_set():
+            err = self._startup_error
+            self.close()  # release the bound socket, reap the thread
+            if err is not None:
+                raise RuntimeError(
+                    "asyncio UDP provider failed to start") from err
             raise RuntimeError("asyncio UDP provider startup timed out")
 
     def _run_loop(self):
@@ -355,18 +357,26 @@ class AsyncioBlockReceiver(PythonBlockReceiver):
         while True:
             with self._cv:
                 while not self._q:
+                    if self._closed:
+                        # mirror the recvfrom provider, whose blocked
+                        # syscall raises when the fd is closed
+                        raise OSError("asyncio UDP provider closed")
                     self._cv.wait()
                 pkt = self._q.popleft()
             if len(pkt) >= need:
                 return pkt
 
     def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()  # unblock a consumer in _next_packet
         if self._loop is not None:
             self._loop.call_soon_threadsafe(self._loop.stop)
             self._thread.join(timeout=5)
             self._loop = None
         # the datagram transport owns (and closed) self._sock; the base
-        # close is a harmless double-close guard
+        # close is a harmless double-close guard, and covers startup
+        # failures where the transport never took ownership
         try:
             super().close()
         except OSError:  # pragma: no cover

@@ -153,10 +153,25 @@ def _twiddle(n1: int, n2: int, inverse: bool) -> jnp.ndarray:
     with *integer* arithmetic first — j1*j2 < n fits int32 exactly.
     """
     n = n1 * n2
+    sign = 1.0 if inverse else -1.0
     j1 = jax.lax.iota(jnp.int32, n1)[:, None]
-    j2 = jax.lax.iota(jnp.int32, n2)[None, :]
-    r = (j1 * j2) % n                      # exact, < n
-    return _phase_exp(r, n, 1.0 if inverse else -1.0)
+    block = 256
+    if n2 % block or n2 < block:
+        j2 = jax.lax.iota(jnp.int32, n2)[None, :]
+        r = (j1 * j2) % n                  # exact, < n
+        return _phase_exp(r, n, sign)
+    # Factored form: j2 = block*q + s, so w[j1, j2] = A[j1, q] * C[j1, s]
+    # with A = exp(i*sign*2*pi*j1*q*block/n), C = exp(.. j1*s/n).  Same
+    # exact-integer-residue precision (both arguments go through
+    # _phase_exp's hi/lo split), but n1*n2/block + n1*block
+    # transcendentals instead of n — the per-element cost collapses to
+    # one complex multiply (same trick as _iota_phase, extended to the
+    # outer-product index j1*j2).
+    q = jax.lax.iota(jnp.int32, n2 // block)[None, :]
+    s = jax.lax.iota(jnp.int32, block)[None, :]
+    a = _phase_exp((j1 * (q * block)) % n, n, sign)   # [n1, n2/block]
+    c = _phase_exp((j1 * s) % n, n, sign)             # [n1, block]
+    return (a[:, :, None] * c[:, None, :]).reshape(n1, n2)
 
 
 def _split_factor(n: int) -> int:
@@ -374,9 +389,10 @@ def finish_rfft_subbyte(a: jnp.ndarray,
     p, m_bytes = a.shape[-2], a.shape[-1]
     m = p * m_bytes
     if p > 1:
-        k1 = jax.lax.iota(jnp.int32, m_bytes)[None, :]
-        j2 = jax.lax.iota(jnp.int32, p)[:, None]
-        a = a * _phase_exp((j2 * k1) % m, m, -1.0)
+        # w[j2, k1] = exp(-2*pi*i*j2*k1/m) is _twiddle(p, M) exactly —
+        # reuse its factored form (m/256 + 256 transcendentals per row
+        # instead of 4 per point on this hot path)
+        a = a * _twiddle(p, m_bytes, inverse=False)
         # p-point DFT across the plane axis (p <= 4: a handful of
         # complex-scalar multiply-adds, fused elementwise by XLA)
         wp = np.exp(-2j * np.pi * np.outer(np.arange(p), np.arange(p))

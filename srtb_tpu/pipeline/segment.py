@@ -19,6 +19,8 @@ Everything is batched over data streams (polarizations): shape [S, ...].
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -188,9 +190,20 @@ class SegmentProcessor:
 
     # ---- staged plan: three programs with (re, im) f32 boundaries ----
 
+    # The blocked-plane form inside the *staged* plan reproducibly
+    # SIGSEGVs the XLA TPU compiler at the 2^30 production shape (the
+    # fused blocked form through 2^28 and the classic staged form are
+    # both fine) — keep the staged plan on the proven unpack+pack path
+    # until that compiler crash is root-caused.  Flip for experiments
+    # with SRTB_STAGED_BLOCKED=1.
+    @property
+    def _staged_blocked(self) -> bool:
+        return self._blocked_subbyte and bool(
+            int(os.environ.get("SRTB_STAGED_BLOCKED", "0")))
+
     def _stage_a(self, raw: jnp.ndarray):
         """unpack + even/odd pack + four-step first half."""
-        if self._blocked_subbyte:
+        if self._staged_blocked:
             planes = U.unpack_subbyte_planes(
                 raw, self.cfg.baseband_input_bits)
             if self.window_planes is not None:
@@ -204,7 +217,7 @@ class SegmentProcessor:
     def _stage_b(self, a_ri: jnp.ndarray):
         """four-step second half + Hermitian post -> spectrum [S, n/2]."""
         a = jax.lax.complex(a_ri[0], a_ri[1])
-        if self._blocked_subbyte:
+        if self._staged_blocked:
             spec = F.finish_rfft_subbyte(F.four_step_stage2(a))[None, :]
         else:
             spec = F.hermitian_rfft_post(F.four_step_stage2(a),
@@ -224,29 +237,45 @@ class SegmentProcessor:
         use_pallas = cfg.use_pallas
         interp = getattr(self, "_pallas_interpret", False)
         from srtb_tpu.ops import pallas_kernels as pk
-        spec = rfi.mitigate_rfi_average_and_normalize(
-            spec, cfg.mitigate_rfi_average_method_threshold, self.norm_coeff)
-        spec = rfi.mitigate_rfi_manual(spec, self.rfi_mask)
         n_streams = spec.shape[0]
-        if use_pallas or chirp_ri is None:
-            # Per-stream fused df64 chirp, phase computed in-register
-            # (S is small and static).  This is also the only in-step
-            # form that fits a 2^30 segment: the XLA df64 chirp's
-            # optimization_barriers block fusion, so its ~12 error-free-
-            # transform intermediates each materialize a 2 GB plane
-            # (observed 24 GB peak); the Pallas kernel touches HBM only
-            # for the spectrum in/out.
+        if use_pallas:
+            # Fully fused front half: RFI s1 zap + normalize + manual
+            # mask + df64 in-register chirp in ONE HBM pass per stream
+            # (the mean-power reduce stays a jnp pass).  Phase computed
+            # in-register; no chirp bank exists.
             outs = []
             for s in range(n_streams):
                 spec_ri = jnp.stack([jnp.real(spec[s]), jnp.imag(spec[s])])
-                out_ri = pk.dedisperse_df64(spec_ri, self.f_min, self.df,
-                                            self.f_c, cfg.dm,
-                                            interpret=interp)
+                out_ri = pk.rfi_s1_dedisperse_df64(
+                    spec_ri, cfg.mitigate_rfi_average_method_threshold,
+                    self.norm_coeff, self.f_min, self.df, self.f_c,
+                    cfg.dm, mask=self.rfi_mask, interpret=interp)
                 outs.append(jax.lax.complex(out_ri[0], out_ri[1]))
             spec = jnp.stack(outs)
         else:
-            chirp = jax.lax.complex(chirp_ri[0], chirp_ri[1])
-            spec = dd.dedisperse(spec, chirp)
+            spec = rfi.mitigate_rfi_average_and_normalize(
+                spec, cfg.mitigate_rfi_average_method_threshold,
+                self.norm_coeff)
+            spec = rfi.mitigate_rfi_manual(spec, self.rfi_mask)
+            if chirp_ri is None:
+                # In-step df64 chirp without Pallas (staged plan on the
+                # jnp path).  The XLA df64 chirp's optimization_barriers
+                # block fusion, so its ~12 error-free-transform
+                # intermediates each materialize a plane (24 GB peak at
+                # 2^30) — the Pallas kernel is the form that scales;
+                # this branch serves CPU tests and small segments.
+                outs = []
+                for s in range(n_streams):
+                    spec_ri = jnp.stack([jnp.real(spec[s]),
+                                         jnp.imag(spec[s])])
+                    out_ri = pk.dedisperse_df64(spec_ri, self.f_min,
+                                                self.df, self.f_c,
+                                                cfg.dm, interpret=interp)
+                    outs.append(jax.lax.complex(out_ri[0], out_ri[1]))
+                spec = jnp.stack(outs)
+            else:
+                chirp = jax.lax.complex(chirp_ri[0], chirp_ri[1])
+                spec = dd.dedisperse(spec, chirp)
         from srtb_tpu.ops import pallas_fft as pf
         if use_pallas and pf.supported(self.watfft_len,
                                        spec.shape[0] * self.channel_count):
@@ -262,7 +291,8 @@ class SegmentProcessor:
         else:
             wf = F.waterfall_c2c(spec, self.channel_count,
                                  self.watfft_dewindow)  # [S, F, T]
-        if use_pallas and pk.sk_tiling_ok(wf.shape[-2], wf.shape[-1]):
+        if cfg.use_pallas_sk and pk.sk_tiling_ok(wf.shape[-2],
+                                                 wf.shape[-1]):
             zapped, zero_counts, ts_rows = [], [], []
             for s in range(n_streams):
                 wf_ri1 = jnp.stack([jnp.real(wf[s]), jnp.imag(wf[s])])

@@ -52,7 +52,7 @@ def _time(fn, *args, reps=5):
             a = args_[0] + c.astype(args_[0].dtype)  # depend on prev iter
             out = fn(a, *args_[1:])
             leaf = jax.tree_util.tree_leaves(out)[0]
-            nxt = jnp.ravel(leaf)[0].astype(jnp.float32)
+            nxt = jnp.real(jnp.ravel(leaf)[0]).astype(jnp.float32)
             # exactly-zero carry the simplifier cannot prove is zero
             # (x*0 folds for integer kernels and DCEs the whole body)
             zero = nxt - jax.lax.optimization_barrier(nxt)

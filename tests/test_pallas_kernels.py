@@ -199,3 +199,38 @@ def test_dedisperse_df64_kernel_high_channel_offset(interpret):
     chirp = np.exp(-2j * np.pi * np.modf(k)[0]).astype(np.complex64)
     err = np.abs(got - spec * chirp)
     assert err.max() < 5e-3 * np.abs(spec).max(), err.max()
+
+
+@pytest.mark.parametrize("with_mask", [False, True])
+def test_rfi_s1_dedisperse_fused_matches_jnp_sequence(interpret, with_mask):
+    """The fused RFI-s1 + chirp kernel must reproduce the jnp sequence
+    mitigate_rfi_average_and_normalize -> mitigate_rfi_manual -> chirp
+    multiply (ref: rfi_mitigation_pipe.hpp:50-94 + dedisperse_pipe)."""
+    from srtb_tpu.ops import rfi
+
+    n = 1 << 15
+    f_min, bw, dm = 1405.0, 64.0, 150.0
+    f_c = f_min + bw
+    df = bw / n
+    threshold, norm = 1.8, 0.125
+    rng = np.random.default_rng(7)
+    spec = (rng.standard_normal(n) + 1j * rng.standard_normal(n)).astype(
+        np.complex64)
+    spec[100] *= 30.0  # guarantee at least one zapped channel
+    mask = None
+    if with_mask:  # zap mask: True = zero the bin (rfi.rfi_ranges_to_mask)
+        mask_np = np.zeros(n, bool)
+        mask_np[2048:4096] = True
+        mask = jnp.asarray(mask_np)
+    spec_ri = jnp.stack([jnp.asarray(spec.real), jnp.asarray(spec.imag)])
+
+    out_ri = np.asarray(pk.rfi_s1_dedisperse_df64(
+        spec_ri, threshold, norm, f_min, df, f_c, dm, mask=mask,
+        interpret=interpret))
+    got = out_ri[0] + 1j * out_ri[1]
+
+    want = rfi.mitigate_rfi_average_and_normalize(
+        jnp.asarray(spec)[None, :], threshold, norm)
+    want = rfi.mitigate_rfi_manual(want, mask)[0]
+    want = np.asarray(want) * dd.chirp_factor_host(n, f_min, df, f_c, dm)
+    assert np.max(np.abs(got - want)) < 5e-3 * np.max(np.abs(want))

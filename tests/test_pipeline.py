@@ -199,7 +199,8 @@ def test_pallas_path_multi_stream_matches(tmp_path):
         mitigate_rfi_spectral_kurtosis_threshold=2.0,
         baseband_reserve_sample=False)
     p_ref = SegmentProcessor(Config(**base))
-    p_pal = SegmentProcessor(Config(**base, use_pallas=True))
+    p_pal = SegmentProcessor(Config(**base, use_pallas=True,
+                                    use_pallas_sk=True))
     wf_a, res_a = p_ref.process(raw)
     wf_b, res_b = p_pal.process(raw)
     assert np.asarray(res_a.signal_counts).shape == \
