@@ -236,7 +236,7 @@ def _arm_watchdog(platform, err):
 
     deadline = float(os.environ.get("SRTB_BENCH_DEADLINE", "3000"))
     if deadline <= 0:
-        return
+        return None
 
     def fire():
         emit({
@@ -254,14 +254,19 @@ def _arm_watchdog(platform, err):
     t = threading.Timer(deadline, fire)
     t.daemon = True
     t.start()
+    return t
 
 
 def main():
     platform, err = pick_platform()
     os.environ["JAX_PLATFORMS"] = platform
-    _arm_watchdog(platform, err)
+    watchdog = _arm_watchdog(platform, err)
     try:
         run_bench(err)
+        # disarm before teardown: a slow runtime shutdown must not fire
+        # a second, contradictory diagnostic line after the real result
+        if watchdog is not None:
+            watchdog.cancel()
     except Exception as e:  # always land a JSON diagnostic, never rc != 0
         emit({
             "metric": "coherent_dedispersion_pipeline_throughput",

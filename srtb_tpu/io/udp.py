@@ -372,8 +372,12 @@ class AsyncioBlockReceiver(PythonBlockReceiver):
             self._cv.notify_all()  # unblock a consumer in _next_packet
         if self._loop is not None:
             self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread.is_alive():
+            # join even when the loop never came up (startup timeout):
+            # the thread may still hold self._sock, which the base close
+            # below is about to invalidate
             self._thread.join(timeout=5)
-            self._loop = None
+        self._loop = None
         # the datagram transport owns (and closed) self._sock; the base
         # close is a harmless double-close guard, and covers startup
         # failures where the transport never took ownership

@@ -188,17 +188,34 @@ def _split_factor(n: int) -> int:
 _XLA_FFT_LEN_CAP = 1 << 16
 
 
-def _fft_minor(x: jnp.ndarray, inverse: bool) -> jnp.ndarray:
+def _fft_minor(x: jnp.ndarray, inverse: bool,
+               rows_impl: str = "xla") -> jnp.ndarray:
     """FFT along the minor (last) axis, recursing into the four-step
-    decomposition for lengths XLA's TPU FFT handles badly."""
-    if x.shape[-1] > _XLA_FFT_LEN_CAP:
-        return four_step_fft(x, inverse)
+    decomposition for lengths XLA's TPU FFT handles badly.
+
+    ``rows_impl``: "xla" | "pallas" | "pallas_interpret" — who executes
+    the batched row transforms.  "pallas" runs rows that fit VMEM through
+    ops/pallas_fft (one HBM read+write per point, MXU DFT-matmul stages);
+    out-of-range rows fall back to XLA.
+    """
+    length = x.shape[-1]
+    if length > _XLA_FFT_LEN_CAP:
+        return four_step_fft(x, inverse, rows_impl)
+    if rows_impl != "xla":
+        from srtb_tpu.ops import pallas_fft as _pf
+        batch = 1
+        for s in x.shape[:-1]:
+            batch *= s
+        if _pf.supported(length, batch):
+            return _pf.fft_rows(x, inverse,
+                                interpret=rows_impl == "pallas_interpret")
     if inverse:
         return jnp.fft.ifft(x, axis=-1, norm="forward")
     return jnp.fft.fft(x, axis=-1)
 
 
-def four_step_stage1(x: jnp.ndarray, inverse: bool = False) -> jnp.ndarray:
+def four_step_stage1(x: jnp.ndarray, inverse: bool = False,
+                     rows_impl: str = "xla") -> jnp.ndarray:
     """First half of the four-step FFT: [..., n] -> A[..., n2, k1].
 
     Splitting the decomposition in two lets very large segments run the
@@ -216,10 +233,11 @@ def four_step_stage1(x: jnp.ndarray, inverse: bool = False) -> jnp.ndarray:
     a = x.reshape(*x.shape[:-1], n1, n2)
     # step 1: FFT_n1 over j1 for each j2 — transpose so n1 is minor
     a = jnp.swapaxes(a, -1, -2)            # [j2, j1]
-    return _fft_minor(a, inverse)          # A[j2, k1]
+    return _fft_minor(a, inverse, rows_impl)   # A[j2, k1]
 
 
-def four_step_stage2(a: jnp.ndarray, inverse: bool = False) -> jnp.ndarray:
+def four_step_stage2(a: jnp.ndarray, inverse: bool = False,
+                     rows_impl: str = "xla") -> jnp.ndarray:
     """Second half of the four-step FFT: A[..., n2, k1] -> X[..., n]."""
     n2, n1 = a.shape[-2], a.shape[-1]
     n = n1 * n2
@@ -228,13 +246,14 @@ def four_step_stage2(a: jnp.ndarray, inverse: bool = False) -> jnp.ndarray:
     a = a * _twiddle(n2, n1, inverse)
     # step 3: FFT_n2 over j2 for each k1 — transpose so n2 is minor
     a = jnp.swapaxes(a, -1, -2)            # [k1, j2]
-    a = _fft_minor(a, inverse)             # C[k1, k2]
+    a = _fft_minor(a, inverse, rows_impl)      # C[k1, k2]
     # result index k = k2*n1 + k1 -> [k2, k1] then flatten
     a = jnp.swapaxes(a, -1, -2)
     return a.reshape(*a.shape[:-2], n)
 
 
-def four_step_fft(x: jnp.ndarray, inverse: bool = False) -> jnp.ndarray:
+def four_step_fft(x: jnp.ndarray, inverse: bool = False,
+                  rows_impl: str = "xla") -> jnp.ndarray:
     """1-D C2C FFT of power-of-two length via the four-step algorithm.
     Unnormalized in both directions (matching c2c_forward / c2c_backward).
     Leading dims batch.
@@ -246,7 +265,8 @@ def four_step_fft(x: jnp.ndarray, inverse: bool = False) -> jnp.ndarray:
     keeps the layout work visible: transpose -> batched FFT -> twiddle ->
     transpose -> batched FFT -> transpose, all row lengths <= 2^16.
     """
-    return four_step_stage2(four_step_stage1(x, inverse), inverse)
+    return four_step_stage2(four_step_stage1(x, inverse, rows_impl),
+                            inverse, rows_impl)
 
 
 def rfft_via_c2c(x: jnp.ndarray, use_four_step: bool = False,
@@ -368,6 +388,8 @@ def rfft_subbyte(data: jnp.ndarray, nbits: int, strategy: str = "four_step",
         a = mxu_fft(z)                                    # [..., p, M]
     elif strategy == "monolithic":
         a = jnp.fft.fft(z, axis=-1)  # one batched XLA FFT over the planes
+    elif strategy in ("pallas", "pallas_interpret"):
+        a = _fft_minor(z, inverse=False, rows_impl=strategy)
     else:
         a = _fft_minor(z, inverse=False)
     return finish_rfft_subbyte(a, drop_nyquist)
@@ -432,9 +454,16 @@ def segment_rfft(x: jnp.ndarray, strategy: str = "auto") -> jnp.ndarray:
       one huge 1-D FFT;
     - "mxu": the packed C2C executed as radix-128 DFT-matrix matmuls on
       the systolic array (ops/mxu_fft.py) — measured ~25% faster than
-      the monolithic XLA R2C at the 2^27 bench size on a v5e.
+      the monolithic XLA R2C at the 2^27 bench size on a v5e;
+    - "pallas" ("pallas_interpret" off-TPU): the four-step decomposition
+      with its batched row FFTs executed by the VMEM Pallas kernel
+      (ops/pallas_fft) — one HBM read+write per point per leg.
     """
     strategy = resolve_strategy(x.shape[-1], strategy)
+    if strategy in ("pallas", "pallas_interpret"):
+        z = pack_even_odd(x)
+        zf = four_step_fft(z, rows_impl=strategy)
+        return hermitian_rfft_post(zf, drop_nyquist=True)
     if strategy == "four_step":
         return rfft_via_c2c(x, use_four_step=True, drop_nyquist=True)
     if strategy == "mxu":

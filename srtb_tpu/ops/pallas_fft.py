@@ -63,9 +63,10 @@ def _split_la_lb(length: int):
     """Factor L = La*Lb with La pinned to 128: the final natural-order
     assembly transposes to a [rows, Lb, La] view, so La is the one minor
     dimension that must stay a full 128-lane tile.  Lb = L/128 lands in
-    [64, 512] over the supported range ([Lb, Lb] tail matrix <= 1 MB per
-    plane)."""
-    if length & (length - 1) or not (1 << 13) <= length <= (1 << 16):
+    [32, 512] over the supported range ([Lb, Lb] tail matrix <= 1 MB per
+    plane; Lb < 128 pads its stage intermediates up to 4x in VMEM, paid
+    only on the small end)."""
+    if length & (length - 1) or not (1 << 12) <= length <= (1 << 16):
         return None
     return 128, length // 128
 

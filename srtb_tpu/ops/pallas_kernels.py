@@ -156,20 +156,37 @@ def _chirp_phase_block(i_hi, i_lo, f_min, df, f_c, dm):
     return jnp.float32(-2.0 * np.pi) * frac
 
 
-def _dedisperse_kernel(re_ref, im_ref, out_re_ref, out_im_ref, *,
-                       f_min, df, f_c, dm, rows, i0):
+def _channel_index_split(rows: int, i0: int):
+    """Global channel index of every element of this grid step's
+    [rows, _LANES] block, as an exact hi/lo float32 split (hi a multiple
+    of 2^12, f32-exact to 2^36; lo < 2^12) — the one preamble every
+    per-channel kernel shares."""
     from jax.experimental import pallas as pl
 
     step = pl.program_id(0)
     base = i0 + step * (rows * _LANES)
-    # global channel index per element (row-major), built as int32 and
-    # split hi (multiple of 2^12, f32-exact to 2^36) / lo (< 2^12)
     row_idx = jax.lax.broadcasted_iota(jnp.int32, (rows, _LANES), 0)
     lane_idx = jax.lax.broadcasted_iota(jnp.int32, (rows, _LANES), 1)
     i_int = jnp.int32(base) + row_idx * _LANES + lane_idx
-    i_hi = (i_int & ~0xFFF).astype(jnp.float32)
-    i_lo = (i_int & 0xFFF).astype(jnp.float32)
+    return ((i_int & ~0xFFF).astype(jnp.float32),
+            (i_int & 0xFFF).astype(jnp.float32))
 
+
+def _spectrum_tiling(n: int):
+    """(rows_total, rows, grid) for a [2, n] spectrum kernel launch —
+    shared by every elementwise spectrum kernel here."""
+    if n % _LANES:
+        raise ValueError(f"n must be a multiple of {_LANES}")
+    rows_total = n // _LANES
+    rows = min(_ROWS, rows_total)
+    if rows_total % rows:
+        raise ValueError(f"{rows_total} rows not divisible by block {rows}")
+    return rows_total, rows, (rows_total // rows,)
+
+
+def _dedisperse_kernel(re_ref, im_ref, out_re_ref, out_im_ref, *,
+                       f_min, df, f_c, dm, rows, i0):
+    i_hi, i_lo = _channel_index_split(rows, i0)
     phase = _chirp_phase_block(i_hi, i_lo, f_min, df, f_c, dm)
     c = jnp.cos(phase)
     s = jnp.sin(phase)
@@ -185,16 +202,7 @@ def _rfi_dedisperse_kernel(re_ref, im_ref, thr_ref, mask_ref, out_re_ref,
     """Fused RFI stage-1 (avg-threshold zap + normalize + manual mask,
     ref: rfi_mitigation_pipe.hpp:50-94) feeding the df64 chirp multiply:
     the spectrum crosses HBM once instead of once per stage."""
-    from jax.experimental import pallas as pl
-
-    step = pl.program_id(0)
-    base = i0 + step * (rows * _LANES)
-    row_idx = jax.lax.broadcasted_iota(jnp.int32, (rows, _LANES), 0)
-    lane_idx = jax.lax.broadcasted_iota(jnp.int32, (rows, _LANES), 1)
-    i_int = jnp.int32(base) + row_idx * _LANES + lane_idx
-    i_hi = (i_int & ~0xFFF).astype(jnp.float32)
-    i_lo = (i_int & 0xFFF).astype(jnp.float32)
-
+    i_hi, i_lo = _channel_index_split(rows, i0)
     re = re_ref[:]
     im = im_ref[:]
     # RFI s1: zap where power exceeds threshold*mean (thr_ref holds the
@@ -232,13 +240,7 @@ def rfi_s1_dedisperse_df64(spec_ri: jnp.ndarray, threshold: float,
     from jax.experimental.pallas import tpu as pltpu
 
     n = spec_ri.shape[-1]
-    if n % _LANES:
-        raise ValueError(f"n must be a multiple of {_LANES}")
-    rows_total = n // _LANES
-    rows = min(_ROWS, rows_total)
-    if rows_total % rows:
-        raise ValueError(f"{rows_total} rows not divisible by block {rows}")
-    grid = (rows_total // rows,)
+    rows_total, rows, grid = _spectrum_tiling(n)
 
     re = spec_ri[0].reshape(rows_total, _LANES)
     im = spec_ri[1].reshape(rows_total, _LANES)
@@ -292,13 +294,7 @@ def dedisperse_df64(spec_ri: jnp.ndarray, f_min: float, df: float,
     from jax.experimental.pallas import tpu as pltpu
 
     n = spec_ri.shape[-1]
-    if n % _LANES:
-        raise ValueError(f"n must be a multiple of {_LANES}")
-    rows_total = n // _LANES
-    rows = min(_ROWS, rows_total)
-    if rows_total % rows:
-        raise ValueError(f"{rows_total} rows not divisible by block {rows}")
-    grid = (rows_total // rows,)
+    rows_total, rows, grid = _spectrum_tiling(n)
 
     re = spec_ri[0].reshape(rows_total, _LANES)
     im = spec_ri[1].reshape(rows_total, _LANES)

@@ -180,7 +180,12 @@ class SegmentProcessor:
 
     def _process(self, raw: jnp.ndarray, chirp_ri: jnp.ndarray):
         strategy = F.resolve_strategy(self.n, self.cfg.fft_strategy)
-        if self._blocked_subbyte and strategy in ("four_step", "mxu"):
+        if strategy == "pallas" and getattr(self, "_pallas_interpret",
+                                            False):
+            strategy = "pallas_interpret"
+        if self._blocked_subbyte and strategy in ("four_step", "mxu",
+                                                  "pallas",
+                                                  "pallas_interpret"):
             spec = F.rfft_subbyte(raw, self.cfg.baseband_input_bits,
                                   strategy, self.window_planes)[None, :]
         else:

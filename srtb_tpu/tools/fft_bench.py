@@ -6,7 +6,8 @@ vars).  Prints one JSON line per (size, strategy) with steady-state
 timings; use it to tune ops.fft.LARGE_FFT_THRESHOLD / cfg.fft_strategy on
 new hardware.
 
-Usage: python -m srtb_tpu.tools.fft_bench [min_log2 [max_log2]]
+Usage: python -m srtb_tpu.tools.fft_bench [min_log2 [max_log2 [strategies]]]
+(strategies: comma list from monolithic,four_step,mxu,pallas)
 """
 
 from __future__ import annotations
@@ -47,9 +48,12 @@ def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     lo = int(argv[0]) if len(argv) > 0 else 20
     hi = int(argv[1]) if len(argv) > 1 else 27
+    strategies = ("monolithic", "four_step", "mxu", "pallas")
+    if len(argv) > 2:
+        strategies = tuple(argv[2].split(","))
     for log2n in range(lo, hi + 1):
         n = 1 << log2n
-        for strategy in ("monolithic", "four_step"):
+        for strategy in strategies:
             dt = bench_one(n, strategy)
             if dt is None:
                 continue

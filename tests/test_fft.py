@@ -149,3 +149,30 @@ class TestMxuFFT:
             mxu_fft(jnp.ones(96, jnp.complex64))
         with pytest.raises(ValueError, match="radix"):
             mxu_fft(jnp.ones(128, jnp.complex64), radix=96)
+
+
+def test_factored_twiddle_matches_float64_large_n():
+    """The factored outer-product _twiddle must keep the exact-residue
+    precision of the per-element form at large n (the round-1 bug class:
+    f32 phase error at n >= 2^24 costs whole turns)."""
+    from srtb_tpu.ops.fft import _twiddle
+
+    n1, n2 = 1 << 11, 1 << 13  # n = 2^24, n2 a multiple of 256
+    got = np.asarray(_twiddle(n1, n2, inverse=False))
+    # sample rows so the float64 oracle stays tiny (4 rows, not 2^24 pts)
+    idx = np.array([0, 1, n1 // 3, n1 - 1])
+    j1 = idx.astype(np.float64)[:, None]
+    j2 = np.arange(n2, dtype=np.float64)[None, :]
+    want = np.exp(-2j * np.pi * (j1 * j2 % (n1 * n2)) / (n1 * n2))
+    err = np.abs(got[idx] - want)
+    assert err.max() < 5e-6  # ~f32 eps-level phase error, no turns lost
+
+
+def test_iota_phase_matches_float64_large_m():
+    from srtb_tpu.ops.fft import _iota_phase
+
+    m, n = 1 << 22, 1 << 23
+    got = np.asarray(_iota_phase(m, n, -1.0))
+    k = np.arange(m, dtype=np.float64)
+    want = np.exp(-2j * np.pi * k / n)
+    assert np.abs(got - want).max() < 5e-6

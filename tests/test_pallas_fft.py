@@ -39,7 +39,7 @@ def test_fft_rows_leading_dims_and_support():
     got = np.asarray(PF.fft_rows(jnp.asarray(x), interpret=INTERPRET))
     want = np.fft.fft(x)
     assert np.abs(got - want).max() / np.abs(want).max() < 5e-6
-    assert not PF.supported(1 << 12, 4)   # below the supported range
+    assert not PF.supported(1 << 11, 4)   # below the supported range
     assert not PF.supported(3 * 1024, 4)  # not a power of two
     assert PF.supported(1 << 16, 1)
 
@@ -87,3 +87,42 @@ def test_pallas_waterfall_in_pipeline_matches_jnp():
     np.testing.assert_allclose(wf_b, wf_a, atol=5e-3 * scale, rtol=0)
     assert np.array_equal(np.asarray(res_a.signal_counts),
                           np.asarray(res_b.signal_counts))
+
+
+def test_pallas_fft_strategy_matches_monolithic():
+    """fft_strategy='pallas' (four-step with Pallas row legs) through the
+    full segment processor must match the monolithic XLA path."""
+    from srtb_tpu.config import Config
+    from srtb_tpu.pipeline.segment import SegmentProcessor
+
+    n = 1 << 16
+    rng = np.random.default_rng(5)
+    raw = rng.integers(0, 256, size=n // 4, dtype=np.uint8)
+    base = dict(
+        baseband_input_count=n, baseband_input_bits=2,
+        baseband_format_type="simple", baseband_freq_low=1405.0,
+        baseband_bandwidth=64.0, baseband_sample_rate=128e6, dm=5.0,
+        spectrum_channel_count=8,
+        mitigate_rfi_average_method_threshold=100.0,
+        mitigate_rfi_spectral_kurtosis_threshold=2.0,
+        signal_detect_max_boxcar_length=16,
+        baseband_reserve_sample=False)
+    ref = SegmentProcessor(Config(fft_strategy="monolithic", **base))
+    pal = SegmentProcessor(Config(fft_strategy="pallas", **base))
+    wf_a, res_a = ref.process(raw)
+    wf_b, res_b = pal.process(raw)
+    wf_a, wf_b = np.asarray(wf_a), np.asarray(wf_b)
+    scale = np.abs(wf_a).max()
+    np.testing.assert_allclose(wf_b, wf_a, atol=5e-3 * scale, rtol=0)
+    assert np.array_equal(np.asarray(res_a.signal_counts),
+                          np.asarray(res_b.signal_counts))
+
+
+@pytest.mark.parametrize("length", [1 << 12, 1 << 13])
+def test_fft_rows_small_lengths(length):
+    rng = np.random.default_rng(length)
+    x = (rng.standard_normal((8, length))
+         + 1j * rng.standard_normal((8, length))).astype(np.complex64)
+    got = np.asarray(PF.fft_rows(jnp.asarray(x), interpret=INTERPRET))
+    want = np.fft.fft(x.astype(np.complex128))
+    assert np.abs(got - want).max() / np.abs(want).max() < 5e-6

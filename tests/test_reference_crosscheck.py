@@ -180,7 +180,7 @@ def test_rfi_decision_parity_with_injected_tone():
     assert int(np.asarray(res.zero_count)[0]) == zapped_rows_o
 
 
-@pytest.mark.parametrize("strategy", ["four_step", "mxu"])
+@pytest.mark.parametrize("strategy", ["four_step", "mxu", "pallas"])
 def test_alternate_fft_backends_match_oracle(crosscheck_run, strategy):
     """Every FFT backend (not just the default monolithic XLA op) must
     reproduce the reference-transliteration oracle's waterfall: the

@@ -24,6 +24,7 @@ run pallas     env SRTB_BENCH_USE_PALLAS=1 python bench.py
 run four_step  env SRTB_BENCH_FFT_STRATEGY=four_step python bench.py
 run monolithic env SRTB_BENCH_FFT_STRATEGY=monolithic python bench.py
 run mxu        env SRTB_BENCH_FFT_STRATEGY=mxu python bench.py
+run pallas_fs  env SRTB_BENCH_FFT_STRATEGY=pallas python bench.py
 run n2_28      env SRTB_BENCH_LOG2N=28 python bench.py
 run n2_29      env SRTB_BENCH_LOG2N=29 python bench.py
 # 2^30 (the reference's production segment size) auto-selects the staged
