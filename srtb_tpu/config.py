@@ -114,6 +114,12 @@ class Config:
     # (PERF_TPU.jsonl kernel rows) — opt-in for shapes where the 2-read
     # pass wins
     use_pallas_sk: bool = False
+    # fail-fast watchdog on the per-segment device sync (seconds,
+    # 0 = disabled): a wedged accelerator runtime otherwise hangs the
+    # observation silently — on expiry the process aborts through the
+    # termination handler (loud stacktrace), matching the reference's
+    # fail-loudly philosophy (ref: util/termination_handler.hpp)
+    segment_deadline_s: float = 0.0
     # candidate-writer thread count; >0 uses the async writer pool (native
     # C++ when built — the reference's boost thread pools,
     # write_signal_pipe.hpp:159-280), 0 writes synchronously
@@ -163,7 +169,7 @@ class Config:
         "dm", "mitigate_rfi_average_method_threshold",
         "mitigate_rfi_spectral_kurtosis_threshold",
         "signal_detect_signal_noise_threshold",
-        "signal_detect_channel_threshold",
+        "signal_detect_channel_threshold", "segment_deadline_s",
     })
     _BOOL_FIELDS = frozenset({
         "baseband_reserve_sample", "baseband_write_all", "gui_enable",

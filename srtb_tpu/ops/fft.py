@@ -201,17 +201,24 @@ def _fft_minor(x: jnp.ndarray, inverse: bool,
     length = x.shape[-1]
     if length > _XLA_FFT_LEN_CAP:
         return four_step_fft(x, inverse, rows_impl)
+    batch = 1
+    for s in x.shape[:-1]:
+        batch *= s
     if rows_impl != "xla":
         from srtb_tpu.ops import pallas_fft as _pf
-        batch = 1
-        for s in x.shape[:-1]:
-            batch *= s
         if _pf.supported(length, batch):
             return _pf.fft_rows(x, inverse,
                                 interpret=rows_impl == "pallas_interpret")
+    # flatten batch dims: a major-dims-only reshape is free, and the TPU
+    # FFT planner is only ever handed the one proven [batch, L] form
+    # (a [2, 16384, 16384] batched FFT SIGSEGVed the XLA TPU compiler
+    # where [32768, 16384] compiles fine)
+    x2 = x.reshape(batch, length) if x.ndim > 2 else x
     if inverse:
-        return jnp.fft.ifft(x, axis=-1, norm="forward")
-    return jnp.fft.fft(x, axis=-1)
+        y = jnp.fft.ifft(x2, axis=-1, norm="forward")
+    else:
+        y = jnp.fft.fft(x2, axis=-1)
+    return y.reshape(x.shape) if x.ndim > 2 else y
 
 
 def four_step_stage1(x: jnp.ndarray, inverse: bool = False,

@@ -152,7 +152,15 @@ def dist_rfft_drop_nyquist(x, mesh: Mesh, axis_name: str = "seq"):
 
     def pack(blk):
         # lane-dense even/odd pack — a [m, 2] reshape pads its minor dim
-        # 2 -> 128 lanes on real TPU (64x HBM), see ops/fft.pack_even_odd
+        # 2 -> 128 lanes on real TPU (64x HBM), see ops/fft.pack_even_odd.
+        # Known future work: for sub-byte input the single-chip path now
+        # skips sample order entirely (ops/fft.rfft_subbyte blocked
+        # planes); the distributed analog would hold each shard as field
+        # planes and absorb the cross-plane butterfly after dist_fft,
+        # but that changes the output sharding layout (k = k2*M + k1
+        # interleaves device blocks) and with it every downstream
+        # index computation in segment_dist — deferred until real
+        # multi-chip hardware is available to measure on.
         return pack_even_odd(blk)
 
     z = shard_map(pack, mesh=mesh, in_specs=P(axis_name),

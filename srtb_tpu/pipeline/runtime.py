@@ -68,6 +68,37 @@ def has_signal(cfg: Config, detect_result, stream: int | None = None,
     return bool(per_stream.any())
 
 
+def _abort_on_deadline(deadline_s: float) -> None:  # pragma: no cover
+    import os
+    import signal
+
+    log.error(
+        f"[pipeline] device sync exceeded segment_deadline_s={deadline_s}: "
+        "accelerator runtime wedged; aborting")
+    os.kill(os.getpid(), signal.SIGABRT)
+
+
+def sync_with_deadline(deadline_s: float, fn, on_deadline=None):
+    """Run a blocking device fetch under a fail-fast deadline (seconds,
+    <= 0 disables).  A wedged accelerator runtime otherwise hangs the
+    observation silently (observed on a v5e after a remote-compiler
+    crash); on expiry the default handler aborts through the installed
+    termination handlers for a loud stacktrace."""
+    if not deadline_s or deadline_s <= 0:
+        return fn()
+    import threading
+
+    timer = threading.Timer(deadline_s,
+                            on_deadline or
+                            (lambda: _abort_on_deadline(deadline_s)))
+    timer.daemon = True
+    timer.start()
+    try:
+        return fn()
+    finally:
+        timer.cancel()
+
+
 class Pipeline:
     """File (or any SegmentWork iterator) to sinks."""
 
@@ -116,8 +147,12 @@ class Pipeline:
 
         def drain(item):
             seg, wf, det_res, offset_after = item
-            # block until device results are ready
-            det_res = jax.tree_util.tree_map(np.asarray, det_res)
+            # block until device results are ready, under the optional
+            # fail-fast deadline (a wedged accelerator tunnel otherwise
+            # hangs the observation silently — observed on a v5e after a
+            # remote-compiler crash)
+            det_res = self._sync_with_deadline(
+                lambda: jax.tree_util.tree_map(np.asarray, det_res))
             result = SegmentResultWork(
                 segment=seg,
                 waterfall=wf if self.keep_waterfall else None,
@@ -164,6 +199,17 @@ class Pipeline:
         log.info(f"[pipeline] {self.stats.segments} segments, "
                  f"{self.stats.msamples_per_sec:.1f} Msamples/s")
         return self.stats
+
+    # overridable for tests; the default aborts through the installed
+    # signal/termination handlers for a loud stacktrace (the reference's
+    # fail-fast philosophy, ref: util/termination_handler.hpp:38-113)
+    def _on_segment_deadline(self) -> None:  # pragma: no cover - aborts
+        _abort_on_deadline(self.cfg.segment_deadline_s)
+
+    def _sync_with_deadline(self, fn):
+        """Run a blocking device fetch under cfg.segment_deadline_s."""
+        return sync_with_deadline(self.cfg.segment_deadline_s, fn,
+                                  self._on_segment_deadline)
 
     def _drain_sinks(self) -> None:
         for sink in self.sinks:
@@ -237,7 +283,11 @@ class DMSearchPipeline:
                 res = self.processor.process(seg.data)
                 n_dm = len(self.dm_list)
                 # reduce over (stream, boxcar) axes -> per-dm quantities
-                peaks = np.asarray(res.snr_peaks).reshape(n_dm, -1)
+                # (first fetch syncs the device step: run it under the
+                # fail-fast deadline like the other pipelines)
+                peaks = sync_with_deadline(
+                    cfg.segment_deadline_s,
+                    lambda: np.asarray(res.snr_peaks)).reshape(n_dm, -1)
                 counts = np.asarray(res.signal_counts).reshape(n_dm, -1)
                 zero = np.asarray(res.zero_count).reshape(n_dm, -1).max(
                     axis=-1)
@@ -306,7 +356,8 @@ class ThreadedPipeline(Pipeline):
 
         def drain_f(stop_token, item):
             seg, wf, det_res, offset_after = item
-            det_res = jax.tree_util.tree_map(np.asarray, det_res)
+            det_res = self._sync_with_deadline(
+                lambda: jax.tree_util.tree_map(np.asarray, det_res))
             result = SegmentResultWork(
                 segment=seg,
                 waterfall=wf if self.keep_waterfall else None,

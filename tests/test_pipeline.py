@@ -320,3 +320,25 @@ def test_blocked_subbyte_strategies_and_staged_match():
             err_msg=name)
         assert np.array_equal(np.asarray(res.signal_counts),
                               np.asarray(res_ref.signal_counts)), name
+
+
+def test_segment_deadline_fires_and_cancels(synthetic_cfg):
+    """segment_deadline_s: the watchdog must fire on a wedged device sync
+    and must NOT fire on a healthy one (cancel on success)."""
+    import time as _time
+
+    from srtb_tpu.pipeline.runtime import Pipeline
+
+    cfg = synthetic_cfg.replace(segment_deadline_s=0.2,
+                                writer_thread_count=0)
+    p = Pipeline(cfg)
+    fired = []
+    p._on_segment_deadline = lambda: fired.append(True)
+    # healthy: a fast fetch must not trip the timer
+    assert p._sync_with_deadline(lambda: 42) == 42
+    _time.sleep(0.3)
+    assert not fired
+    # wedged: a fetch slower than the deadline trips it
+    p._sync_with_deadline(lambda: _time.sleep(0.4))
+    assert fired
+    p.close()
