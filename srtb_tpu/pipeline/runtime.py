@@ -146,13 +146,16 @@ class Pipeline:
         drained = [self.checkpoint.segments_done if self.checkpoint else 0]
 
         def drain(item):
+            # the WHOLE drain runs under the optional fail-fast deadline:
+            # not just the detect fetch — the sinks' np.asarray of the
+            # waterfall is a device transfer too, and a wedged tunnel
+            # blocks transfers as readily as compute (observed on a v5e
+            # after a remote-compiler crash)
+            self._sync_with_deadline(lambda: _drain_body(item))
+
+        def _drain_body(item):
             seg, wf, det_res, offset_after = item
-            # block until device results are ready, under the optional
-            # fail-fast deadline (a wedged accelerator tunnel otherwise
-            # hangs the observation silently — observed on a v5e after a
-            # remote-compiler crash)
-            det_res = self._sync_with_deadline(
-                lambda: jax.tree_util.tree_map(np.asarray, det_res))
+            det_res = jax.tree_util.tree_map(np.asarray, det_res)
             result = SegmentResultWork(
                 segment=seg,
                 waterfall=wf if self.keep_waterfall else None,
@@ -282,15 +285,17 @@ class DMSearchPipeline:
                     break
                 res = self.processor.process(seg.data)
                 n_dm = len(self.dm_list)
-                # reduce over (stream, boxcar) axes -> per-dm quantities
-                # (first fetch syncs the device step: run it under the
-                # fail-fast deadline like the other pipelines)
-                peaks = sync_with_deadline(
+                # reduce over (stream, boxcar) axes -> per-dm quantities;
+                # every device transfer runs under the fail-fast deadline
+                # (a wedged tunnel blocks transfers, not just compute)
+                peaks, counts, zero = sync_with_deadline(
                     cfg.segment_deadline_s,
-                    lambda: np.asarray(res.snr_peaks)).reshape(n_dm, -1)
-                counts = np.asarray(res.signal_counts).reshape(n_dm, -1)
-                zero = np.asarray(res.zero_count).reshape(n_dm, -1).max(
-                    axis=-1)
+                    lambda: (np.asarray(res.snr_peaks),
+                             np.asarray(res.signal_counts),
+                             np.asarray(res.zero_count)))
+                peaks = peaks.reshape(n_dm, -1)
+                counts = counts.reshape(n_dm, -1)
+                zero = zero.reshape(n_dm, -1).max(axis=-1)
                 ok = zero < (cfg.signal_detect_channel_threshold
                              * cfg.spectrum_channel_count)
                 fired = counts.sum(axis=-1) > 0
@@ -355,9 +360,12 @@ class ThreadedPipeline(Pipeline):
                     getattr(self.source, "logical_offset", 0))
 
         def drain_f(stop_token, item):
+            return self._sync_with_deadline(
+                lambda: _drain_body(stop_token, item))
+
+        def _drain_body(stop_token, item):
             seg, wf, det_res, offset_after = item
-            det_res = self._sync_with_deadline(
-                lambda: jax.tree_util.tree_map(np.asarray, det_res))
+            det_res = jax.tree_util.tree_map(np.asarray, det_res)
             result = SegmentResultWork(
                 segment=seg,
                 waterfall=wf if self.keep_waterfall else None,

@@ -91,15 +91,15 @@ class SegmentProcessor:
         win = W.window_coefficients(window_name, n)
         self.window = None if win is None else jnp.asarray(win)
         # Simple-format sub-byte segments take the fused blocked-plane
-        # R2C (ops/fft.rfft_subbyte): unpack + pack + FFT with no
-        # sample-order interleave anywhere — the sample-order composition
-        # materializes a [bytes, count] layout that pads 32x on TPU.
-        # The Pallas unpack path emits sample order, so it keeps the
-        # classic route.
+        # R2C (ops/fft.rfft_subbyte) on the non-monolithic strategies:
+        # unpack + pack + FFT with no sample-order interleave anywhere —
+        # the sample-order composition materializes a [bytes, count]
+        # layout that pads 32x on TPU.  Independent of use_pallas: the
+        # Pallas unpack kernel (sample order) only serves the monolithic
+        # route, which fuses it away.
         self._blocked_subbyte = (
             self.fmt.unpack_variant == "simple"
-            and cfg.baseband_input_bits in (1, 2, 4)
-            and not cfg.use_pallas)
+            and cfg.baseband_input_bits in (1, 2, 4))
         self.window_planes = None
         if self._blocked_subbyte and win is not None:
             self.window_planes = jnp.asarray(F.subbyte_window_planes(

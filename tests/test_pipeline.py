@@ -312,6 +312,9 @@ def test_blocked_subbyte_strategies_and_staged_match():
         "staged": SegmentProcessor(
             Config(fft_strategy="four_step", **base), window_name="hann",
             staged=True),
+        "four_step+pallas": SegmentProcessor(
+            Config(fft_strategy="four_step", use_pallas=True, **base),
+            window_name="hann"),
     }
     for name, proc in variants.items():
         wf, res = proc.process(raw)
