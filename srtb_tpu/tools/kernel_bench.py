@@ -183,6 +183,47 @@ def main(argv=None) -> int:
         except Exception as e:  # pragma: no cover
             print(json.dumps({"kernel": "pallas df64", "error": str(e)}))
 
+    # ---- fused RFI-s1 + df64 chirp (Pallas, one HBM pass) ----
+    if jax.default_backend() not in ("cpu",):
+        from srtb_tpu.ops import pallas_kernels as pk
+        spec_ri = jnp.stack([spec_re, spec_im])
+        fused_rfi = jax.jit(lambda s: pk.rfi_s1_dedisperse_df64(
+            s, 1.5, 0.125, f_min, df, f_c, -478.80))
+        try:
+            dt = _time(fused_rfi, spec_ri, reps=reps)
+            record("RFI s1 + chirp (Pallas fused)", dt,
+                   f"[{n_spec}]c64", n_spec)
+        except Exception as e:  # pragma: no cover
+            print(json.dumps({"kernel": "pallas rfi+chirp",
+                              "error": str(e)}))
+        # the jnp sequence it replaces
+        seq = jax.jit(lambda s, c: dd.dedisperse(
+            rfi.mitigate_rfi_average_and_normalize(
+                s[None], 1.5, 0.125),
+            jax.lax.complex(c[0], c[1]))[0])
+        dt = _time(seq, spec_c, chirp, reps=reps)
+        record("RFI s1 + chirp (jnp + bank)", dt, f"[{n_spec}]c64", n_spec)
+
+    # ---- waterfall backward C2C: XLA vs Pallas VMEM rows ----
+    from srtb_tpu.ops import pallas_fft as pf
+    wfs_re = jax.device_put(
+        rng.standard_normal((nchan, wlen)).astype(np.float32))
+    wfs_im = jax.device_put(
+        rng.standard_normal((nchan, wlen)).astype(np.float32))
+    xla_rows = jax.jit(lambda r, i: jnp.fft.ifft(
+        jax.lax.complex(r, i), axis=-1, norm="forward"))
+    dt = _time(xla_rows, wfs_re, wfs_im, reps=reps)
+    record("waterfall C2C (XLA ifft)", dt, f"[{nchan},{wlen}]c64", n_spec)
+    if jax.default_backend() not in ("cpu",) and pf.supported(wlen, nchan):
+        prows = jax.jit(lambda r, i: pf.fft_rows_ri(r, i, inverse=True))
+        try:
+            dt = _time(prows, wfs_re, wfs_im, reps=reps)
+            record("waterfall C2C (Pallas VMEM rows)", dt,
+                   f"[{nchan},{wlen}]c64", n_spec)
+        except Exception as e:  # pragma: no cover
+            print(json.dumps({"kernel": "pallas fft_rows",
+                              "error": str(e)}))
+
     # ---- spectral kurtosis on the waterfall ----
     wf_re = jax.device_put(
         rng.standard_normal((nchan, wlen)).astype(np.float32))
