@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import html
 import http.server
+import json
 import os
 import re
 import threading
@@ -55,6 +56,28 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             pass
 
     def _do_get(self):
+        if self.path in ("/metrics", "/metrics.json"):
+            # live observability beyond the reference's log-only story
+            # (SURVEY.md §5.5): JSON snapshot or Prometheus text format
+            from srtb_tpu.utils.metrics import metrics
+
+            snap = metrics.snapshot()
+            if self.path == "/metrics.json":
+                data = (json.dumps(snap, sort_keys=True) + "\n").encode()
+                ctype = "application/json"
+            else:
+                lines = []
+                for k in sorted(snap):
+                    name = "srtb_" + re.sub(r"[^a-zA-Z0-9_]", "_", k)
+                    lines.append(f"{name} {snap[k]:.17g}")
+                data = ("\n".join(lines) + "\n").encode()
+                ctype = "text/plain; version=0.0.4"
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
         if self.path in ("/", "/index.html"):
             frames = self._latest_frames()
             if frames:

@@ -27,6 +27,7 @@ import numpy as np
 from srtb_tpu.config import Config
 from srtb_tpu.io import formats
 from srtb_tpu.pipeline.work import SegmentWork
+from srtb_tpu.utils.metrics import metrics
 from srtb_tpu.utils.logging import log
 
 COUNTER_LE64 = 0
@@ -578,6 +579,8 @@ class UdpReceiverSource:
     def __next__(self) -> SegmentWork:
         buf = np.zeros(self.segment_bytes, dtype=np.uint8)
         first_counter, lost, total = self.receiver.receive_block(buf)
+        metrics.add("packets_total", total)
+        metrics.add("packets_lost", lost)
         if lost:
             log.warning(f"[udp_receiver] lost {lost}/{total} packets "
                         f"({lost / total:.2%})")

@@ -26,6 +26,7 @@ from srtb_tpu.io.writers import WriteAllSink, WriteSignalSink
 from srtb_tpu.pipeline.segment import SegmentProcessor
 from srtb_tpu.pipeline.work import SegmentResultWork, SegmentWork
 from srtb_tpu.utils.logging import log
+from srtb_tpu.utils.metrics import metrics
 
 
 @dataclass
@@ -176,6 +177,10 @@ class Pipeline:
             if pool is not None and cfg.input_file_path:
                 pool.release(seg.data)
             drained[0] += 1
+            metrics.add("segments")
+            metrics.add("samples", n_samples_per_seg)
+            if positive:
+                metrics.add("signals")
             if self.checkpoint is not None:
                 # a checkpointed segment must be durable: flush queued
                 # async candidate writes before recording it as done
@@ -382,6 +387,10 @@ class ThreadedPipeline(Pipeline):
             if pool is not None and cfg.input_file_path:
                 pool.release(seg.data)
             drained[0] += 1
+            metrics.add("segments")
+            metrics.add("samples", cfg.baseband_input_count)
+            if positive:
+                metrics.add("signals")
             if self.checkpoint is not None:
                 self._drain_sinks()  # durability before recording done
                 self.checkpoint.update(drained[0], offset_after)

@@ -186,7 +186,6 @@ def main(argv=None) -> int:
     # ---- fused RFI-s1 + df64 chirp (Pallas, one HBM pass) ----
     if jax.default_backend() not in ("cpu",):
         from srtb_tpu.ops import pallas_kernels as pk
-        spec_ri = jnp.stack([spec_re, spec_im])
         fused_rfi = jax.jit(lambda s: pk.rfi_s1_dedisperse_df64(
             s, 1.5, 0.125, f_min, df, f_c, -478.80))
         try:
@@ -204,32 +203,29 @@ def main(argv=None) -> int:
         dt = _time(seq, spec_c, chirp, reps=reps)
         record("RFI s1 + chirp (jnp + bank)", dt, f"[{n_spec}]c64", n_spec)
 
-    # ---- waterfall backward C2C: XLA vs Pallas VMEM rows ----
-    from srtb_tpu.ops import pallas_fft as pf
-    wfs_re = jax.device_put(
-        rng.standard_normal((nchan, wlen)).astype(np.float32))
-    wfs_im = jax.device_put(
-        rng.standard_normal((nchan, wlen)).astype(np.float32))
-    xla_rows = jax.jit(lambda r, i: jnp.fft.ifft(
-        jax.lax.complex(r, i), axis=-1, norm="forward"))
-    dt = _time(xla_rows, wfs_re, wfs_im, reps=reps)
-    record("waterfall C2C (XLA ifft)", dt, f"[{nchan},{wlen}]c64", n_spec)
-    if jax.default_backend() not in ("cpu",) and pf.supported(wlen, nchan):
-        prows = jax.jit(lambda r, i: pf.fft_rows_ri(r, i, inverse=True))
-        try:
-            dt = _time(prows, wfs_re, wfs_im, reps=reps)
-            record("waterfall C2C (Pallas VMEM rows)", dt,
-                   f"[{nchan},{wlen}]c64", n_spec)
-        except Exception as e:  # pragma: no cover
-            print(json.dumps({"kernel": "pallas fft_rows",
-                              "error": str(e)}))
-
     # ---- spectral kurtosis on the waterfall ----
     wf_re = jax.device_put(
         rng.standard_normal((nchan, wlen)).astype(np.float32))
     wf_im = jax.device_put(
         rng.standard_normal((nchan, wlen)).astype(np.float32))
     wf_c = to_c(wf_re, wf_im)
+
+    # ---- waterfall backward C2C: XLA vs Pallas VMEM rows ----
+    # (reuses the wf_re/wf_im pair: each is 256 MB+ at segment sizes)
+    from srtb_tpu.ops import pallas_fft as pf
+    xla_rows = jax.jit(lambda r, i: jnp.fft.ifft(
+        jax.lax.complex(r, i), axis=-1, norm="forward"))
+    dt = _time(xla_rows, wf_re, wf_im, reps=reps)
+    record("waterfall C2C (XLA ifft)", dt, f"[{nchan},{wlen}]c64", n_spec)
+    if jax.default_backend() not in ("cpu",) and pf.supported(wlen, nchan):
+        prows = jax.jit(lambda r, i: pf.fft_rows_ri(r, i, inverse=True))
+        try:
+            dt = _time(prows, wf_re, wf_im, reps=reps)
+            record("waterfall C2C (Pallas VMEM rows)", dt,
+                   f"[{nchan},{wlen}]c64", n_spec)
+        except Exception as e:  # pragma: no cover
+            print(json.dumps({"kernel": "pallas fft_rows",
+                              "error": str(e)}))
     sk = jax.jit(lambda w: rfi.mitigate_rfi_spectral_kurtosis(w[None], 1.05)[0])
     dt = _time(sk, wf_c, reps=reps)
     record("spectral kurtosis zap", dt, f"[{nchan},{wlen}]c64", n_spec)

@@ -32,6 +32,13 @@ class Metrics:
         with self._lock:
             return self._counters.get(name, 0.0)
 
+    def reset(self) -> None:
+        """Clear all counters and restart the clock (tests; a fresh
+        observation run)."""
+        with self._lock:
+            self._counters.clear()
+            self._start = time.monotonic()
+
     def snapshot(self) -> dict:
         with self._lock:
             out = dict(self._counters)

@@ -63,3 +63,30 @@ def test_running_mean_vs_oracle():
 def test_termination_handler_idempotent():
     install_termination_handler()
     install_termination_handler()  # no crash on double install
+
+
+def test_http_metrics_endpoint(tmp_path):
+    """/metrics (Prometheus text) and /metrics.json on the waterfall HTTP
+    server expose the runtime counters (beyond the reference's log-only
+    observability, SURVEY.md §5.5)."""
+    import json
+    import urllib.request
+
+    from srtb_tpu.gui.server import WaterfallHTTPServer
+    from srtb_tpu.utils.metrics import metrics
+
+    metrics.reset()
+    metrics.add("segments", 3)
+    metrics.add("samples", 1000)
+    server = WaterfallHTTPServer(str(tmp_path), port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        text = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert "srtb_segments 3" in text
+        snap = json.loads(
+            urllib.request.urlopen(base + "/metrics.json").read())
+        assert snap["segments"] == 3
+        assert "elapsed_s" in snap
+    finally:
+        server.stop()
+        metrics.reset()  # don't leak counter state into other tests
