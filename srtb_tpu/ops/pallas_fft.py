@@ -113,6 +113,33 @@ def _fft_rows_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref, wbi_ref,
     out_im_ref[:] = jnp.transpose(c3i, (1, 2, 0)).reshape(rows, la * lb)
 
 
+def _fft_rows_stats_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref,
+                           wbi_ref, twr_ref, twi_ref, dwr_ref,
+                           out_re_ref, out_im_ref, s2_ref, s4_ref, *,
+                           la, lb, rows, apply_dewindow):
+    """fft_rows kernel + fused epilogue: optional de-window multiply and
+    per-row power moments (sum |x|^2, sum |x|^4 as 128-lane partials) —
+    the spectral-kurtosis statistics collected while the waterfall rows
+    are still in VMEM, so the SK stage never re-reads the waterfall from
+    HBM (ref: spectrum/rfi_mitigation.hpp:290-341 computes them in a
+    separate pass)."""
+    _fft_rows_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref, wbi_ref,
+                     twr_ref, twi_ref, out_re_ref, out_im_ref,
+                     la=la, lb=lb, rows=rows)
+    yr = out_re_ref[:]
+    yi = out_im_ref[:]
+    if apply_dewindow:
+        dw = dwr_ref[:]        # [1, L] reciprocal de-window coefficients
+        yr = yr * dw
+        yi = yi * dw
+        out_re_ref[:] = yr
+        out_im_ref[:] = yi
+    p = yr * yr + yi * yi
+    p3 = p.reshape(rows, (la * lb) // 128, 128)
+    s2_ref[:] = jnp.sum(p3, axis=1)
+    s4_ref[:] = jnp.sum(p3 * p3, axis=1)
+
+
 def _row_block(length: int, batch: int) -> int:
     rows = max(1, _VMEM_BLOCK_ELEMS // length)
     while batch % rows:
@@ -129,6 +156,59 @@ def _dft_matrix_np(r: int, inverse: bool):
             np.ascontiguousarray(w.imag.astype(np.float32)))
 
 
+class _Launch:
+    """Shared launch recipe for the row-FFT kernels: shape checks, the
+    La/Lb split, VMEM block sizing, and the DFT/twiddle constants — one
+    home, so the plain and stats variants can never drift apart."""
+
+    def __init__(self, re, im, inverse):
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        self.shape = re.shape
+        self.length = self.shape[-1]
+        self.batch = (int(np.prod(self.shape[:-1]))
+                      if len(self.shape) > 1 else 1)
+        if not supported(self.length, self.batch):
+            raise ValueError(f"unsupported row FFT shape {self.shape}")
+        self.la, self.lb = _split_la_lb(self.length)
+        self.re2 = re.reshape(self.batch, self.length)
+        self.im2 = im.reshape(self.batch, self.length)
+        self.rows = _row_block(self.length, self.batch)
+        self.grid = (self.batch // self.rows,)
+        self.block = pl.BlockSpec((self.rows, self.length),
+                                  lambda i: (i, 0),
+                                  memory_space=pltpu.VMEM)
+        war, wai = _dft_matrix_np(self.la, inverse)
+        wbr, wbi = _dft_matrix_np(self.lb, inverse)
+        # tw[k1, j2] = exp(+-2*pi*i*k1*j2/L): exact integer residues
+        # through the hi/lo phase split (ops.fft._twiddle discipline)
+        tw = F._twiddle(self.la, self.lb, inverse)
+        self.consts = (jnp.asarray(war), jnp.asarray(wai),
+                       jnp.asarray(wbr), jnp.asarray(wbi),
+                       jnp.real(tw), jnp.imag(tw))
+        self.const_specs = [
+            self.const_spec((self.la, self.la)),
+            self.const_spec((self.la, self.la)),
+            self.const_spec((self.lb, self.lb)),
+            self.const_spec((self.lb, self.lb)),
+            self.const_spec((self.la, self.lb)),
+            self.const_spec((self.la, self.lb)),
+        ]
+
+    @staticmethod
+    def const_spec(shp):
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        return pl.BlockSpec(shp, lambda i: (0, 0),
+                            memory_space=pltpu.VMEM)
+
+    def out_shape(self):
+        return jax.ShapeDtypeStruct((self.batch, self.length),
+                                    jnp.float32)
+
+
 def fft_rows_ri(re: jnp.ndarray, im: jnp.ndarray, inverse: bool = False,
                 interpret: bool = False):
     """C2C FFT along the last axis of split re/im f32 [..., L] arrays
@@ -136,46 +216,19 @@ def fft_rows_ri(re: jnp.ndarray, im: jnp.ndarray, inverse: bool = False,
     Unnormalized both directions (same conventions as ops.fft
     c2c_forward / c2c_backward)."""
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
-    shape = re.shape
-    length = shape[-1]
-    batch = int(np.prod(shape[:-1])) if len(shape) > 1 else 1
-    if not supported(length, batch):
-        raise ValueError(f"unsupported row FFT shape {shape}")
-    la, lb = _split_la_lb(length)
-    re2 = re.reshape(batch, length)
-    im2 = im.reshape(batch, length)
-    rows = _row_block(length, batch)
-    grid = (batch // rows,)
-    block = pl.BlockSpec((rows, length), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM)
-
-    war, wai = _dft_matrix_np(la, inverse)
-    wbr, wbi = _dft_matrix_np(lb, inverse)
-    # tw[k1, j2] = exp(+-2*pi*i*k1*j2/L): exact integer residues through
-    # the hi/lo phase split (ops.fft._twiddle discipline)
-    tw = F._twiddle(la, lb, inverse)
-
-    def const_spec(shp):
-        return pl.BlockSpec(shp, lambda i: (0, 0),
-                            memory_space=pltpu.VMEM)
-
-    kernel = functools.partial(_fft_rows_kernel, la=la, lb=lb, rows=rows)
+    lc = _Launch(re, im, inverse)
+    kernel = functools.partial(_fft_rows_kernel, la=lc.la, lb=lc.lb,
+                               rows=lc.rows)
     out_re, out_im = pl.pallas_call(
         kernel,
-        grid=grid,
-        in_specs=[block, block,
-                  const_spec((la, la)), const_spec((la, la)),
-                  const_spec((lb, lb)), const_spec((lb, lb)),
-                  const_spec((la, lb)), const_spec((la, lb))],
-        out_specs=[block, block],
-        out_shape=[jax.ShapeDtypeStruct((batch, length), jnp.float32)] * 2,
+        grid=lc.grid,
+        in_specs=[lc.block, lc.block] + lc.const_specs,
+        out_specs=[lc.block, lc.block],
+        out_shape=[lc.out_shape()] * 2,
         interpret=interpret,
-    )(re2, im2, jnp.asarray(war), jnp.asarray(wai),
-      jnp.asarray(wbr), jnp.asarray(wbi),
-      jnp.real(tw), jnp.imag(tw))
-    return out_re.reshape(shape), out_im.reshape(shape)
+    )(lc.re2, lc.im2, *lc.consts)
+    return out_re.reshape(lc.shape), out_im.reshape(lc.shape)
 
 
 def fft_rows(x: jnp.ndarray, inverse: bool = False,
@@ -183,3 +236,45 @@ def fft_rows(x: jnp.ndarray, inverse: bool = False,
     """Complex convenience wrapper over :func:`fft_rows_ri`."""
     yr, yi = fft_rows_ri(jnp.real(x), jnp.imag(x), inverse, interpret)
     return jax.lax.complex(yr, yi)
+
+
+def fft_rows_stats_ri(re: jnp.ndarray, im: jnp.ndarray,
+                      inverse: bool = True,
+                      dewindow: jnp.ndarray | None = None,
+                      interpret: bool = False):
+    """Waterfall form of :func:`fft_rows_ri`: C2C rows plus a fused
+    epilogue computing the optional de-window multiply (``dewindow`` is
+    the [L] coefficient vector to divide out, ref: fft_pipe.hpp:346-359)
+    and the per-row power moments for spectral kurtosis.
+
+    Returns ``(re, im, s2, s4)`` where s2/s4 are [B, 128] lane-partial
+    sums of |x|^2 / |x|^4 per row (finish with ``.sum(-1)``)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    lc = _Launch(re, im, inverse)
+    shape, length, batch = lc.shape, lc.length, lc.batch
+    rows = lc.rows
+    apply_dewindow = dewindow is not None
+    if apply_dewindow:
+        dwr = (1.0 / dewindow.astype(jnp.float32)).reshape(1, length)
+    else:  # placeholder tile, never read by the kernel
+        dwr = jnp.ones((1, length), jnp.float32)
+
+    stat_block = pl.BlockSpec((rows, 128), lambda i: (i, 0),
+                              memory_space=pltpu.VMEM)
+    kernel = functools.partial(_fft_rows_stats_kernel, la=lc.la, lb=lc.lb,
+                               rows=rows, apply_dewindow=apply_dewindow)
+    out_re, out_im, s2, s4 = pl.pallas_call(
+        kernel,
+        grid=lc.grid,
+        in_specs=[lc.block, lc.block] + lc.const_specs
+                 + [lc.const_spec((1, length))],
+        out_specs=[lc.block, lc.block, stat_block, stat_block],
+        out_shape=[lc.out_shape(), lc.out_shape(),
+                   jax.ShapeDtypeStruct((batch, 128), jnp.float32),
+                   jax.ShapeDtypeStruct((batch, 128), jnp.float32)],
+        interpret=interpret,
+    )(lc.re2, lc.im2, *lc.consts, dwr)
+    return (out_re.reshape(shape), out_im.reshape(shape),
+            s2.reshape(*shape[:-1], 128), s4.reshape(*shape[:-1], 128))

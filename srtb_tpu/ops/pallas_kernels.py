@@ -443,18 +443,46 @@ def sk_zap_timeseries(wf_ri: jnp.ndarray, sk_threshold: float,
 
     # ---- tiny per-row decision in jnp, thresholds shared with
     # rfi.mitigate_rfi_spectral_kurtosis ----
-    from srtb_tpu.ops.rfi import sk_decision_thresholds
-    thr_low_, thr_high_ = sk_decision_thresholds(m, sk_threshold)
-    s2r = jnp.sum(s2, axis=-1)
-    s4r = jnp.sum(s4, axis=-1)
-    sk = m * s4r / (s2r * s2r)
-    zap = (sk > thr_high_) | (sk < thr_low_)
+    zap = sk_zap_decision(jnp.sum(s2, axis=-1), jnp.sum(s4, axis=-1), m,
+                          sk_threshold)
     zero_count = jnp.sum(
         (zap | (fs[:, 0] == 0)).astype(jnp.int32))
+
+    out_ri, ts = sk_apply_timeseries(wf_ri, zap, interpret)
+    return out_ri, zero_count, ts
+
+
+def sk_zap_decision(s2_sum, s4_sum, m: int, sk_threshold: float):
+    """Per-row zap verdict from the power moments (thresholds shared with
+    rfi.mitigate_rfi_spectral_kurtosis)."""
+    from srtb_tpu.ops.rfi import sk_decision_thresholds
+    thr_low_, thr_high_ = sk_decision_thresholds(m, sk_threshold)
+    sk = m * s4_sum / (s2_sum * s2_sum)
+    return (sk > thr_high_) | (sk < thr_low_)
+
+
+def sk_apply_timeseries(wf_ri: jnp.ndarray, zap: jnp.ndarray,
+                        interpret: bool = False):
+    """Pass 2 of the fused SK chain, standalone: zap the verdict rows and
+    accumulate the frequency-summed power time series in the same read.
+    ``zap`` is the [F] boolean verdict (e.g. from
+    :func:`sk_zap_decision` over stats collected by the waterfall FFT's
+    fused epilogue, ops/pallas_fft.fft_rows_stats_ri — in that pairing
+    the waterfall is never re-read for statistics at all).
+
+    Returns ``(wf_zapped_ri [2, F, T], ts [T])``.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _, nfreq, ntime = wf_ri.shape
+    tiles = _sk_tiles(nfreq, ntime)
+    if tiles is None:
+        raise ValueError(f"bad waterfall tiling [{nfreq}, {ntime}]")
+    rows, tb = tiles
+    re, im = wf_ri[0], wf_ri[1]
     keep = jnp.broadcast_to((~zap).astype(jnp.float32)[:, None],
                             (nfreq, _LANES))
-
-    # ---- pass 2: zap + time series (grid: time outer, freq inner) ----
     grid2 = (ntime // tb, nfreq // rows)
     in_block2 = pl.BlockSpec((rows, tb), lambda t, f: (f, t),
                              memory_space=pltpu.VMEM)
@@ -474,7 +502,7 @@ def sk_zap_timeseries(wf_ri: jnp.ndarray, sk_threshold: float,
         interpret=interpret,
     )(re, im, keep)
 
-    return (jnp.stack([out_re, out_im]), zero_count, ts2d.reshape(ntime))
+    return jnp.stack([out_re, out_im]), ts2d.reshape(ntime)
 
 
 # Sub-byte unpack needs a lane interleave (out[4c+j] = field_j(byte[c])),

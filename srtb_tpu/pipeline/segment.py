@@ -282,22 +282,42 @@ class SegmentProcessor:
                 chirp = jax.lax.complex(chirp_ri[0], chirp_ri[1])
                 spec = dd.dedisperse(spec, chirp)
         from srtb_tpu.ops import pallas_fft as pf
-        if use_pallas and pf.supported(self.watfft_len,
-                                       spec.shape[0] * self.channel_count):
-            # one-HBM-pass Pallas waterfall C2C (ops/pallas_fft): rows in
-            # VMEM, DFT-matmul stages on the MXU
-            x = spec[..., :self.channel_count * self.watfft_len].reshape(
-                *spec.shape[:-1], self.channel_count, self.watfft_len)
-            wr, wi = pf.fft_rows_ri(jnp.real(x), jnp.imag(x),
-                                    inverse=True, interpret=interp)
-            wf = jax.lax.complex(wr, wi)
-            if self.watfft_dewindow is not None:
-                wf = wf / self.watfft_dewindow
-        else:
+        pallas_wf = use_pallas and pf.supported(
+            self.watfft_len, spec.shape[0] * self.channel_count)
+        pallas_sk = cfg.use_pallas_sk and pk.sk_tiling_ok(
+            self.channel_count, self.watfft_len)
+        if pallas_sk and pallas_wf:
+            # Fully fused waterfall post-chain: ONE batched VMEM row-FFT
+            # kernel computes the backward C2C for all streams,
+            # de-applies the window and collects the SK power moments
+            # while each row is still in VMEM
+            # (ops/pallas_fft.fft_rows_stats_ri) — the waterfall is never
+            # re-read for statistics; the zap verdict + time series then
+            # cost exactly one more read+write (pk.sk_apply_timeseries).
+            # 2 HBM round trips total where the jnp chain takes ~5.
+            t_len = self.watfft_len
+            x = spec[..., :self.channel_count * t_len].reshape(
+                n_streams, self.channel_count, t_len)
+            wr, wi, s2p, s4p = pf.fft_rows_stats_ri(
+                jnp.real(x), jnp.imag(x), inverse=True,
+                dewindow=self.watfft_dewindow, interpret=interp)
+            zap_all = pk.sk_zap_decision(            # [S, F]
+                s2p.sum(-1), s4p.sum(-1), t_len,
+                cfg.mitigate_rfi_spectral_kurtosis_threshold)
+            fs0 = wr[..., 0] ** 2 + wi[..., 0] ** 2
+            zc_all = jnp.sum((zap_all | (fs0 == 0)).astype(jnp.int32),
+                             axis=-1)
+            zapped, zero_counts, ts_rows = [], [], []
+            for s in range(n_streams):
+                wf_ri1, ts = pk.sk_apply_timeseries(
+                    jnp.stack([wr[s], wi[s]]), zap_all[s],
+                    interpret=interp)
+                zapped.append(jax.lax.complex(wf_ri1[0], wf_ri1[1]))
+                zero_counts.append(zc_all[s])
+                ts_rows.append(ts)
+        elif pallas_sk:
             wf = F.waterfall_c2c(spec, self.channel_count,
                                  self.watfft_dewindow)  # [S, F, T]
-        if cfg.use_pallas_sk and pk.sk_tiling_ok(wf.shape[-2],
-                                                 wf.shape[-1]):
             zapped, zero_counts, ts_rows = [], [], []
             for s in range(n_streams):
                 wf_ri1 = jnp.stack([jnp.real(wf[s]), jnp.imag(wf[s])])
@@ -307,6 +327,7 @@ class SegmentProcessor:
                 zapped.append(jax.lax.complex(wf_ri1[0], wf_ri1[1]))
                 zero_counts.append(zc)
                 ts_rows.append(ts)
+        if pallas_sk:
             wf = jnp.stack(zapped)
             t = det.trimmed_length(wf.shape[-1], self.time_reserved_count)
             result = det.detect_from_time_series(
@@ -314,6 +335,20 @@ class SegmentProcessor:
                 cfg.signal_detect_signal_noise_threshold,
                 cfg.signal_detect_max_boxcar_length)
         else:
+            if pallas_wf:
+                # one-HBM-pass Pallas waterfall C2C (ops/pallas_fft):
+                # rows in VMEM, DFT-matmul stages on the MXU
+                x = spec[..., :self.channel_count
+                         * self.watfft_len].reshape(
+                    *spec.shape[:-1], self.channel_count, self.watfft_len)
+                wr, wi = pf.fft_rows_ri(jnp.real(x), jnp.imag(x),
+                                        inverse=True, interpret=interp)
+                wf = jax.lax.complex(wr, wi)
+                if self.watfft_dewindow is not None:
+                    wf = wf / self.watfft_dewindow
+            else:
+                wf = F.waterfall_c2c(spec, self.channel_count,
+                                     self.watfft_dewindow)  # [S, F, T]
             wf = rfi.mitigate_rfi_spectral_kurtosis(
                 wf, cfg.mitigate_rfi_spectral_kurtosis_threshold)
             result = det.detect(wf, self.time_reserved_count,

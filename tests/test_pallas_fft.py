@@ -79,14 +79,21 @@ def test_pallas_waterfall_in_pipeline_matches_jnp():
         baseband_reserve_sample=False)
     ref = SegmentProcessor(Config(**base))
     pal = SegmentProcessor(Config(use_pallas=True, **base))
+    fused = SegmentProcessor(Config(use_pallas=True, use_pallas_sk=True,
+                                    **base))
     assert PF.supported(pal.watfft_len, pal.channel_count)
     wf_a, res_a = ref.process(raw)
-    wf_b, res_b = pal.process(raw)
-    wf_a, wf_b = np.asarray(wf_a), np.asarray(wf_b)
+    wf_a = np.asarray(wf_a)
     scale = np.abs(wf_a).max()
-    np.testing.assert_allclose(wf_b, wf_a, atol=5e-3 * scale, rtol=0)
-    assert np.array_equal(np.asarray(res_a.signal_counts),
-                          np.asarray(res_b.signal_counts))
+    for name, proc in (("wf", pal), ("wf+sk", fused)):
+        wf_b, res_b = proc.process(raw)
+        np.testing.assert_allclose(np.asarray(wf_b), wf_a,
+                                   atol=5e-3 * scale, rtol=0,
+                                   err_msg=name)
+        assert np.array_equal(np.asarray(res_a.signal_counts),
+                              np.asarray(res_b.signal_counts)), name
+        assert np.array_equal(np.asarray(res_a.zero_count),
+                              np.asarray(res_b.zero_count)), name
 
 
 def test_pallas_fft_strategy_matches_monolithic():
