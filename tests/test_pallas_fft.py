@@ -133,3 +133,27 @@ def test_fft_rows_small_lengths(length):
     got = np.asarray(PF.fft_rows(jnp.asarray(x), interpret=INTERPRET))
     want = np.fft.fft(x.astype(np.complex128))
     assert np.abs(got - want).max() / np.abs(want).max() < 5e-6
+
+
+def test_fft_rows_stats_matches_jnp():
+    """fft_rows_stats_ri: inverse FFT + de-window + power moments must
+    match the jnp sequence (c2c_backward -> divide -> |x|^2 sums)."""
+    from srtb_tpu.ops import fft as F
+
+    rng = np.random.default_rng(11)
+    B, L = 6, 1 << 13
+    x = (rng.standard_normal((B, L))
+         + 1j * rng.standard_normal((B, L))).astype(np.complex64)
+    dewin = (0.5 + rng.random(L)).astype(np.float32)
+    wr, wi, s2p, s4p = PF.fft_rows_stats_ri(
+        jnp.asarray(x.real), jnp.asarray(x.imag), inverse=True,
+        dewindow=jnp.asarray(dewin), interpret=INTERPRET)
+    want = np.asarray(F.c2c_backward(jnp.asarray(x))) / dewin
+    got = np.asarray(wr) + 1j * np.asarray(wi)
+    scale = np.abs(want).max()
+    assert np.abs(got - want).max() < 5e-5 * scale
+    p = np.abs(want) ** 2
+    np.testing.assert_allclose(np.asarray(s2p).sum(-1), p.sum(-1),
+                               rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(s4p).sum(-1), (p * p).sum(-1),
+                               rtol=1e-3)
