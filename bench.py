@@ -75,16 +75,41 @@ def pick_platform():
     """Probe the accelerator with retries; fall back to CPU.
 
     Returns (platform_for_env, accelerator_error | None).
+
+    A preset ``JAX_PLATFORMS`` is *probed, not trusted*: the round-2
+    artifact came out 0.0 precisely because the driver environment pinned
+    the platform and the old code skipped the probe/fallback machinery,
+    letting the main process run head-first into a dead tunnel.  The
+    probe subprocess inherits the preset via its environment, so pinning
+    still selects the platform — it just has to actually come up.  Only
+    ``cpu`` is exempt (it is its own fallback and always initializes).
+
+    Transient tunnel loss gets a bounded retry-over-minutes loop
+    (SRTB_BENCH_RETRY_BUDGET seconds total, default 900) before the CPU
+    fallback, so a blip during the driver's capture doesn't cost the
+    round its accelerator number.
     """
-    if os.environ.get("JAX_PLATFORMS"):  # explicit override wins
-        return os.environ["JAX_PLATFORMS"], None
+    preset = os.environ.get("JAX_PLATFORMS")
+    if preset == "cpu":
+        return "cpu", None
     t0 = float(os.environ.get("SRTB_BENCH_INIT_TIMEOUT", "300"))
-    timeouts = [t0, min(120.0, t0)]
+    budget = float(os.environ.get("SRTB_BENCH_RETRY_BUDGET", "900"))
+    deadline = time.monotonic() + budget
     err = None
-    for t in timeouts:
-        platform, err = probe_backend(t)
+    first = True
+    while True:
+        platform, err = probe_backend(t0 if first else min(120.0, t0))
         if platform is not None:
-            return platform, None
+            # keep the preset spelling: the plugin's registered name (e.g.
+            # "axon") can differ from the device's .platform (e.g. "tpu"),
+            # and JAX_PLATFORMS must use the registered name
+            return (preset or platform), None
+        first = False
+        if time.monotonic() >= deadline:
+            break
+        time.sleep(min(30.0, max(0.0, deadline - time.monotonic())))
+    if preset:
+        err = f"preset JAX_PLATFORMS={preset!r} failed probe: {err}"
     return "cpu", err
 
 

@@ -334,6 +334,7 @@ class AsyncioBlockReceiver(PythonBlockReceiver):
                     outer._q.append(data)
                     outer._cv.notify()
 
+        loop = None
         try:
             loop = asyncio.new_event_loop()
             self._loop = loop
@@ -343,6 +344,12 @@ class AsyncioBlockReceiver(PythonBlockReceiver):
             self._transport = transport
         except BaseException as e:  # propagated by __init__
             self._startup_error = e
+            # run_forever is never reached, so the finally below never
+            # runs: release the selector fd here and clear self._loop so
+            # close() doesn't call_soon_threadsafe on a closed loop
+            self._loop = None
+            if loop is not None:
+                loop.close()
             self._ready.set()
             return
         self._ready.set()
@@ -371,8 +378,12 @@ class AsyncioBlockReceiver(PythonBlockReceiver):
         with self._cv:
             self._closed = True
             self._cv.notify_all()  # unblock a consumer in _next_packet
-        if self._loop is not None:
-            self._loop.call_soon_threadsafe(self._loop.stop)
+        loop = self._loop  # snapshot: the worker's error path nulls and
+        if loop is not None:  # closes it concurrently with this check
+            try:
+                loop.call_soon_threadsafe(loop.stop)
+            except RuntimeError:  # loop already closed by the worker
+                pass
         if self._thread.is_alive():
             # join even when the loop never came up (startup timeout):
             # the thread may still hold self._sock, which the base close

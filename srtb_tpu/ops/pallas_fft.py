@@ -48,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from srtb_tpu.ops import fft as F
+from srtb_tpu.utils.logging import log
 
 # v5e VMEM is ~16 MB/core.  Live per grid step: in + out + two stage
 # intermediates (all [rows, L] f32 pairs) + matrices + twiddle.
@@ -140,10 +141,21 @@ def _fft_rows_stats_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref,
     s4_ref[:] = jnp.sum(p3 * p3, axis=1)
 
 
+@functools.lru_cache(maxsize=None)
 def _row_block(length: int, batch: int) -> int:
-    rows = max(1, _VMEM_BLOCK_ELEMS // length)
+    target = max(1, _VMEM_BLOCK_ELEMS // length)
+    rows = target
     while batch % rows:
         rows -= 1
+    if rows == 1 and target > 1 and batch > 1:
+        # a batch with no small factors (prime/odd channel counts) forces
+        # one grid step per row — correct but loses the kernel's batching;
+        # warn once per shape (lru_cache memoizes the search *and* the
+        # warning) so pathological configs don't silently crawl
+        log.warning(
+            f"[pallas_fft] batch {batch} has no divisor <= {target}: "
+            "row-FFT runs one row per grid step; prefer power-of-two "
+            "channel counts (or fft_strategy=monolithic) for this shape")
     return rows
 
 

@@ -100,6 +100,56 @@ def sync_with_deadline(deadline_s: float, fn, on_deadline=None):
         timer.cancel()
 
 
+class _DeadlineArray:
+    """Lazy device-array handle whose host fetch runs under the pipeline's
+    fail-fast deadline, however late a consumer triggers it.  Sinks fetch
+    the waterfall via ``np.asarray`` and only for segments they actually
+    write, so eagerly transferring the (multi-GB) waterfall per segment
+    in drain would tax every segment; this keeps the fetch lazy while
+    still arming the watchdog around the device transfer."""
+
+    __slots__ = ("_arr", "_sync", "_fetched")
+
+    def __init__(self, dev, sync_with_deadline):
+        self._arr = dev
+        self._sync = sync_with_deadline
+        self._fetched = False
+
+    @property
+    def shape(self):
+        return self._arr.shape
+
+    @property
+    def ndim(self):
+        return self._arr.ndim
+
+    @property
+    def dtype(self):
+        return self._arr.dtype
+
+    @property
+    def nbytes(self):
+        return self._arr.nbytes
+
+    def __len__(self):
+        return len(self._arr)
+
+    def __getitem__(self, idx):
+        return self.__array__()[idx]
+
+    def __array__(self, dtype=None, copy=None):
+        if not self._fetched:
+            dev = self._arr
+            self._arr = self._sync(lambda: np.asarray(dev))
+            self._fetched = True  # drop the device handle; memoize host
+        a = self._arr
+        if dtype is not None and np.dtype(dtype) != a.dtype:
+            a = a.astype(dtype)
+        elif copy:
+            a = a.copy()
+        return a
+
+
 class Pipeline:
     """File (or any SegmentWork iterator) to sinks."""
 
@@ -147,16 +197,10 @@ class Pipeline:
         drained = [self.checkpoint.segments_done if self.checkpoint else 0]
 
         def drain(item):
-            # the WHOLE drain runs under the optional fail-fast deadline:
-            # not just the detect fetch — the sinks' np.asarray of the
-            # waterfall is a device transfer too, and a wedged tunnel
-            # blocks transfers as readily as compute (observed on a v5e
-            # after a remote-compiler crash)
-            self._sync_with_deadline(lambda: _drain_body(item))
+            _drain_body(self._fetch_device(item))
 
         def _drain_body(item):
             seg, wf, det_res, offset_after = item
-            det_res = jax.tree_util.tree_map(np.asarray, det_res)
             result = SegmentResultWork(
                 segment=seg,
                 waterfall=wf if self.keep_waterfall else None,
@@ -218,6 +262,25 @@ class Pipeline:
         """Run a blocking device fetch under cfg.segment_deadline_s."""
         return sync_with_deadline(self.cfg.segment_deadline_s, fn,
                                   self._on_segment_deadline)
+
+    def _fetch_device(self, item):
+        """Resolve one (seg, wf, det_res, offset) drain item's device
+        handles to host data, with the fail-fast deadline scoped to the
+        *device fetches only*: those are what a wedged accelerator tunnel
+        blocks.  Sink pushes and checkpoint flushes are host disk I/O —
+        a slow-but-healthy disk flush of a multi-GB waterfall must not
+        SIGABRT the observation — so they run with no timer armed.
+
+        The detect results (a few KB) are fetched eagerly.  The waterfall
+        can be multi-GB and most sinks never read it (WriteSignalSink only
+        touches it for written segments), so it is wrapped in a lazy proxy
+        whose eventual ``np.asarray`` still runs under the deadline."""
+        seg, wf, det_res, offset_after = item
+        det_res = self._sync_with_deadline(
+            lambda: jax.tree_util.tree_map(np.asarray, det_res))
+        if wf is not None and self.cfg.segment_deadline_s > 0:
+            wf = _DeadlineArray(wf, self._sync_with_deadline)
+        return seg, wf, det_res, offset_after
 
     def _drain_sinks(self) -> None:
         for sink in self.sinks:
@@ -365,12 +428,10 @@ class ThreadedPipeline(Pipeline):
                     getattr(self.source, "logical_offset", 0))
 
         def drain_f(stop_token, item):
-            return self._sync_with_deadline(
-                lambda: _drain_body(stop_token, item))
+            return _drain_body(stop_token, self._fetch_device(item))
 
         def _drain_body(stop_token, item):
             seg, wf, det_res, offset_after = item
-            det_res = jax.tree_util.tree_map(np.asarray, det_res)
             result = SegmentResultWork(
                 segment=seg,
                 waterfall=wf if self.keep_waterfall else None,

@@ -41,6 +41,7 @@ def test_bench_survives_unreachable_accelerator(tmp_path):
     # runs deterministically on any machine, healthy accelerator or not
     env["SRTB_BENCH_PROBE_PLATFORM"] = "no_such_platform"
     env["SRTB_BENCH_INIT_TIMEOUT"] = "30"
+    env["SRTB_BENCH_RETRY_BUDGET"] = "0"  # no retry-over-minutes in CI
     env["SRTB_BENCH_LOG2N"] = "16"  # small on every platform
     out = subprocess.run(
         [sys.executable, os.path.join(env["PYTHONPATH"], "bench.py")],
@@ -53,6 +54,32 @@ def test_bench_survives_unreachable_accelerator(tmp_path):
     assert rec["value"] > 0  # CPU fallback still measured something
     assert rec["platform"] == "cpu"
     assert rec.get("accelerator_error"), rec  # fallback branch really ran
+
+
+def test_bench_probes_preset_platform(tmp_path):
+    """The round-2 failure mode: the driver *pins* JAX_PLATFORMS to a
+    platform whose tunnel is down.  The old code trusted the preset and
+    skipped the probe, so the main process died on backend init (value
+    0.0).  Now the preset is probed and, on failure, the bench falls back
+    to a real CPU measurement with the error attached."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "no_such_platform"  # preset, and unreachable
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    env["SRTB_BENCH_INIT_TIMEOUT"] = "30"
+    env["SRTB_BENCH_RETRY_BUDGET"] = "0"
+    env["SRTB_BENCH_LOG2N"] = "16"
+    out = subprocess.run(
+        [sys.executable, os.path.join(env["PYTHONPATH"], "bench.py")],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert len(lines) == 1, out.stdout
+    rec = json.loads(lines[0])
+    assert rec["value"] > 0, rec  # fell back to a *measured* CPU run
+    assert rec["platform"] == "cpu"
+    assert "preset" in (rec.get("accelerator_error") or ""), rec
 
 
 def test_kernel_bench_runs():
