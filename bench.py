@@ -95,19 +95,23 @@ def pick_platform():
     t0 = float(os.environ.get("SRTB_BENCH_INIT_TIMEOUT", "300"))
     budget = float(os.environ.get("SRTB_BENCH_RETRY_BUDGET", "900"))
     deadline = time.monotonic() + budget
+    retry_timeout = min(120.0, t0)
     err = None
     first = True
     while True:
-        platform, err = probe_backend(t0 if first else min(120.0, t0))
+        platform, err = probe_backend(t0 if first else retry_timeout)
         if platform is not None:
             # keep the preset spelling: the plugin's registered name (e.g.
             # "axon") can differ from the device's .platform (e.g. "tpu"),
             # and JAX_PLATFORMS must use the registered name
             return (preset or platform), None
         first = False
-        if time.monotonic() >= deadline:
+        # a retry only launches if sleep + its full probe timeout still
+        # fit in the budget — the budget is a bound, not a target
+        sleep_s = min(30.0, max(0.0, deadline - time.monotonic()))
+        if time.monotonic() + sleep_s + retry_timeout > deadline:
             break
-        time.sleep(min(30.0, max(0.0, deadline - time.monotonic())))
+        time.sleep(sleep_s)
     if preset:
         err = f"preset JAX_PLATFORMS={preset!r} failed probe: {err}"
     return "cpu", err

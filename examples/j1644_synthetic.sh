@@ -1,0 +1,34 @@
+#!/bin/bash
+# Flagship-config acceptance run on synthetic data: the J1644-4559
+# observation parameters (ref: userspace/srtb_config_1644-4559.cfg —
+# 2-bit samples, 128 MSa/s, |DM| = 478.80, inverted 64 MHz band at
+# 1405-1469 MHz) over a synthesized baseband with two dispersed pulses,
+# end-to-end through the CLI pipeline, then rendered with plot_spectrum.
+#
+# The reference's acceptance evidence is a real J1644-4559 recording
+# (ref: README.md:9-19); no recording ships in either repo, so this is
+# the reproducible equivalent: same config, synthetic pulses at known
+# positions, detection + waterfall artifact out.  Expected: both
+# segments detect (peak at time bin 2048 of 4096, SNR ~60), candidates
+# written, PNGs rendered.  artifacts/j1644_synthetic_waterfall.png in
+# the repo is segment 0 of exactly this run.
+set -eu
+DIR=${1:-/tmp/j1644}
+mkdir -p "$DIR"
+
+python -m srtb_tpu.tools.make_baseband --out "$DIR/bb.bin" \
+  --n "2**25" --freq_low "1405+32" --bandwidth " -64" --dm " -478.80" \
+  --pulses "2**23, 3*2**23" --nbits 2 --pulse_amp 40 --seed 3
+
+python -m srtb_tpu.tools.main \
+  --input_file_path "$DIR/bb.bin" \
+  --baseband_input_count "2 ** 24" --baseband_input_bits 2 \
+  --baseband_format_type simple --baseband_freq_low "1405 + 32" \
+  --baseband_bandwidth " -64" --baseband_sample_rate 128e6 \
+  --dm " -478.80" --spectrum_channel_count "2 ** 11" \
+  --baseband_output_file_prefix "$DIR/out_" \
+  --signal_detect_signal_noise_threshold 8 --baseband_reserve_sample 0 \
+  --mitigate_rfi_spectral_kurtosis_threshold 1.05
+
+(cd "$DIR" && python -m srtb_tpu.tools.plot_spectrum "out_*.0.npy")
+ls -la "$DIR"/*.png

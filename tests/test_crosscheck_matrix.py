@@ -1,0 +1,117 @@
+"""Crosscheck MATRIX: every packet format x every execution plan against
+the independent float64 oracle (oracle_utils).
+
+The reference validates its chain on real recordings in each ingest
+format (ref: README.md:9-19, backend_registry.hpp:36-181); the closest
+reproducible substitute is identical-bytes numeric parity per format and
+per plan.  The single-format crosscheck (test_reference_crosscheck)
+pins the default path deeply; this matrix widens it:
+
+- axis 1, formats: simple 2/4-bit sub-byte, simple signed int8, the
+  "1212" byte-interleave, the "1122" pair-interleave, and both gznupsr
+  word-interleaves (incl. the XOR-0x80 unsigned->signed trick) — all
+  multi-stream formats checked per stream against an *independent*
+  de-interleave transliteration (oracle_utils.oracle_deinterleave).
+- axis 2, plans: the fused single-program plan, the three-program
+  staged plan (the 2^30 production form, forced small here), the
+  Pallas in-step-chirp plan, and the MXU DFT-matmul FFT strategy.
+
+Thresholds sit in the strict-parity tier (no RFI decision flips), so
+any mismatch is a numeric/convention error, not a threshold race.
+"""
+
+import numpy as np
+import pytest
+from oracle_utils import oracle_deinterleave, oracle_stream_chain
+
+from srtb_tpu.config import Config
+from srtb_tpu.pipeline.segment import SegmentProcessor, waterfall_to_numpy
+
+# (format, baseband_input_bits, data_stream_count)
+FORMATS = [
+    ("simple", 2, 1),
+    ("simple", 4, 1),
+    ("simple", -8, 1),
+    ("interleaved_samples_2", -8, 2),
+    ("naocpsr_snap1", -8, 2),
+    ("gznupsr_a1", -8, 2),
+    ("gznupsr_a1_v1", -8, 4),
+]
+
+PLANS = ["fused", "staged", "pallas", "mxu"]
+
+N = 1 << 14
+
+
+def _cfg(fmt: str, nbits: int) -> Config:
+    return Config(
+        baseband_input_count=N,
+        baseband_input_bits=nbits,
+        baseband_format_type=fmt,
+        baseband_freq_low=1405.0,
+        baseband_bandwidth=64.0,
+        baseband_sample_rate=128e6,
+        dm=30.0,
+        spectrum_channel_count=1 << 5,
+        signal_detect_signal_noise_threshold=5.0,
+        signal_detect_max_boxcar_length=8,
+        mitigate_rfi_average_method_threshold=1e9,    # strict parity:
+        mitigate_rfi_spectral_kurtosis_threshold=1e9,  # no decision flips
+        baseband_reserve_sample=False,
+    )
+
+
+def _processor(cfg: Config, plan: str) -> SegmentProcessor:
+    if plan == "fused":
+        return SegmentProcessor(cfg)
+    if plan == "staged":
+        return SegmentProcessor(cfg, staged=True)
+    if plan == "pallas":
+        return SegmentProcessor(cfg.replace(use_pallas=True))
+    if plan == "mxu":
+        return SegmentProcessor(cfg.replace(fft_strategy="mxu"))
+    raise ValueError(plan)
+
+
+def _raw_segment(cfg: Config, streams: int, seed: int = 7) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=cfg.segment_bytes(streams),
+                        dtype=np.uint8)
+
+
+def _check(fmt, nbits, streams, plan):
+    cfg = _cfg(fmt, nbits)
+    raw = _raw_segment(cfg, streams)
+    proc = _processor(cfg, plan)
+    wf = waterfall_to_numpy(proc.process(raw)[0])
+    if wf.ndim == 2:
+        wf = wf[None]
+    assert wf.shape[0] == streams
+
+    per_stream = oracle_deinterleave(raw, fmt, nbits)
+    assert len(per_stream) == streams
+    for s, x in enumerate(per_stream):
+        wf_o, _, _ = oracle_stream_chain(x, cfg)
+        scale = max(np.abs(wf_o).max(), 1e-30)
+        np.testing.assert_allclose(
+            wf[s], wf_o.astype(np.complex64),
+            atol=3e-4 * scale, rtol=3e-3,
+            err_msg=f"{fmt}/{nbits} stream {s} plan {plan}")
+
+
+@pytest.mark.parametrize("fmt,nbits,streams", FORMATS,
+                         ids=[f"{f}_{b}" for f, b, _ in FORMATS])
+@pytest.mark.parametrize("plan", ["fused", "staged"])
+def test_format_matrix(fmt, nbits, streams, plan):
+    """Every ingest format, fused and staged plans, per-stream parity."""
+    _check(fmt, nbits, streams, plan)
+
+
+@pytest.mark.parametrize("fmt,nbits,streams",
+                         [("simple", 2, 1), ("gznupsr_a1", -8, 2)],
+                         ids=["simple_2", "gznupsr_a1"])
+@pytest.mark.parametrize("plan", ["pallas", "mxu"])
+def test_plan_matrix(fmt, nbits, streams, plan):
+    """The alternate compute plans on the flagship sub-byte format and a
+    word-interleaved multi-stream format."""
+    _check(fmt, nbits, streams, plan)

@@ -1,0 +1,105 @@
+"""Byte-level validation of the candidate files the pipeline writes.
+
+A reference user's downstream tooling parses these exact layouts (the
+reference writes .npy via cnpy::npy_save and .tim as raw float32 —
+ref: pipeline/write_signal_pipe.hpp:225-280 — and .bin as the raw
+segment bytes), so the bytes on disk are API surface.  These tests
+parse the files with an independent decoder (struct/ast, not np.load)
+and check every header field and payload byte."""
+
+import ast
+import struct
+
+import numpy as np
+import pytest
+
+from srtb_tpu.config import Config
+from srtb_tpu.io.synth import make_dispersed_baseband
+from srtb_tpu.pipeline.runtime import Pipeline
+
+
+@pytest.fixture(scope="module")
+def written(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("fmt")
+    n = 1 << 14
+    cfg = Config(
+        baseband_input_count=n,
+        baseband_input_bits=2,
+        baseband_format_type="simple",
+        baseband_freq_low=1405.0,
+        baseband_bandwidth=64.0,
+        baseband_sample_rate=128e6,
+        dm=30.0,
+        input_file_path=str(tmp / "bb.bin"),
+        baseband_output_file_prefix=str(tmp / "out_"),
+        spectrum_channel_count=1 << 5,
+        signal_detect_signal_noise_threshold=5.0,
+        signal_detect_max_boxcar_length=16,
+        mitigate_rfi_average_method_threshold=1e9,
+        mitigate_rfi_spectral_kurtosis_threshold=1e9,
+        baseband_reserve_sample=False,
+    )
+    make_dispersed_baseband(
+        n, cfg.baseband_freq_low, cfg.baseband_bandwidth, cfg.dm,
+        pulse_positions=n // 2, pulse_amp=30.0, nbits=2,
+    ).tofile(cfg.input_file_path)
+    pipe = Pipeline(cfg)
+    pipe.run()
+    assert pipe.sinks[0].written
+    return cfg, pipe.sinks[0].written[0]
+
+
+def test_npy_bytes_are_spec_exact(written):
+    """Parse the .npy with struct/ast only (NPY format 1.0 as
+    cnpy::npy_save emits it): magic, version, little-endian complex64
+    descr, C order, (channels, wlen) shape, then exactly
+    shape-product * 8 payload bytes."""
+    cfg, rec = written
+    raw = open(rec.npy_paths[0], "rb").read()
+    assert raw[:6] == b"\x93NUMPY"
+    major, minor = raw[6], raw[7]
+    assert (major, minor) == (1, 0)
+    (hlen,) = struct.unpack("<H", raw[8:10])
+    assert (10 + hlen) % 64 == 0  # spec: header pads to 64-byte alignment
+    header = ast.literal_eval(raw[10:10 + hlen].decode("latin1").strip())
+    assert header["descr"] == "<c8"
+    assert header["fortran_order"] is False
+    ch = cfg.spectrum_channel_count
+    wlen = cfg.baseband_input_count // 2 // ch
+    assert header["shape"] == (ch, wlen)
+    payload = raw[10 + hlen:]
+    assert len(payload) == ch * wlen * 8
+    # and the payload really is the waterfall np.load sees
+    wf = np.frombuffer(payload, dtype="<c8").reshape(ch, wlen)
+    np.testing.assert_array_equal(wf, np.load(rec.npy_paths[0]))
+
+
+def test_tim_bytes_are_raw_f32_per_boxcar(written):
+    """.tim payload: raw little-endian float32 (the reference writes the
+    bare sample buffer, write_signal_pipe.hpp:250-280), one file per
+    boxcar length, named <base>.<boxcar>.tim, with the boxcar-L sliding
+    difference's valid length (T for L=1, T-L otherwise; the writer trims
+    the zero-padded tail of the static-shape device rows)."""
+    cfg, rec = written
+    wlen = cfg.baseband_input_count // 2 // cfg.spectrum_channel_count
+    assert rec.tim_paths
+    for path in rec.tim_paths:
+        stem = path.rsplit(".", 2)
+        boxcar = int(stem[1])
+        raw = open(path, "rb").read()
+        assert len(raw) % 4 == 0
+        ts = np.frombuffer(raw, dtype="<f4")
+        expect = wlen if boxcar == 1 else wlen - boxcar
+        assert ts.size == expect, (path, ts.size)
+        assert np.isfinite(ts).all()
+
+
+def test_bin_is_raw_segment_bytes(written):
+    """.bin: the segment's raw input bytes, verbatim (reserve disabled
+    here, so the full segment)."""
+    cfg, rec = written
+    raw = open(rec.bin_path, "rb").read()
+    src = open(cfg.input_file_path, "rb").read()
+    seg_bytes = cfg.segment_bytes(1)
+    assert len(raw) == seg_bytes
+    assert raw == src[:seg_bytes]

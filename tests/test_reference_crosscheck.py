@@ -15,64 +15,18 @@ convention/ordering error anywhere in the device chain fails the test.
 
 import numpy as np
 import pytest
+from oracle_utils import oracle_stream_chain, oracle_unpack
 
 from srtb_tpu.config import Config
 from srtb_tpu.io.synth import make_dispersed_baseband
-from srtb_tpu.ops import dedisperse as dd
-from srtb_tpu.ops import rfi
 from srtb_tpu.pipeline.runtime import Pipeline
-
-D = 4.148808e3  # MHz^2 pc^-1 cm^3 s (ref: coherent_dedispersion.hpp:67)
 
 
 def _oracle_chain(raw_bytes: np.ndarray, cfg: Config):
-    """float64 transliteration of the reference device chain."""
-    # unpack: 2-bit unsigned fields, MSB first (ref: unpack.hpp:43-75)
-    b = raw_bytes.astype(np.uint16)
-    x = np.stack([(b >> 6) & 3, (b >> 4) & 3, (b >> 2) & 3, b & 3],
-                 axis=-1).reshape(-1).astype(np.float64)
-    n = x.size
-    n_spec = n // 2
-
-    # R2C, Nyquist dropped (ref: fft_pipe.hpp:44-78)
-    spec = np.fft.rfft(x)[:-1]
-
-    # RFI stage 1: zap > threshold*mean power, normalize survivors by
-    # (N^2/channels)^-0.5 evaluated in f32 (ref: rfi_mitigation_pipe.hpp:50-80)
-    power = spec.real**2 + spec.imag**2
-    zap1 = power > cfg.mitigate_rfi_average_method_threshold * power.mean()
-    coeff = rfi.normalization_coefficient(n_spec, cfg.spectrum_channel_count)
-    spec = np.where(zap1, 0.0, spec * coeff)
-
-    # coherent dedispersion chirp (ref: coherent_dedispersion.hpp:133-150,
-    # Jiang 2022): k = D*1e6*dm/f*((f-f_c)/f_c)^2, phase = -2*pi*frac(k)
-    f_min, f_c, df = dd.spectrum_frequencies(cfg, n_spec)
-    f = f_min + df * np.arange(n_spec, dtype=np.float64)
-    k = D * 1e6 * cfg.dm / f * ((f - f_c) / f_c) ** 2
-    chirp = np.exp(-2j * np.pi * np.modf(k)[0])
-    spec = spec * chirp
-
-    # waterfall: [channels, wlen] rows, unnormalized backward C2C
-    # (ref: fft_pipe.hpp:285-344)
-    ch = cfg.spectrum_channel_count
-    wlen = n_spec // ch
-    wf = np.fft.ifft(spec.reshape(ch, wlen), axis=-1) * wlen
-
-    # SK stage 2 (ref: rfi_mitigation.hpp:290-341), thresholds in f32 as
-    # the implementation computes them
-    lo, hi = rfi.sk_decision_thresholds(
-        wlen, cfg.mitigate_rfi_spectral_kurtosis_threshold)
-    p = wf.real**2 + wf.imag**2
-    s2, s4 = p.sum(axis=-1), (p * p).sum(axis=-1)
-    sk = wlen * s4 / (s2 * s2)
-    zap2 = (sk > hi) | (sk < lo)
-    wf = np.where(zap2[:, None], 0.0, wf)
-
-    # detect: power time series over the untrimmed window, mean-subtracted
-    # (ref: signal_detect_pipe.hpp:305-334; reserve disabled in this cfg)
-    ts = (wf.real**2 + wf.imag**2).sum(axis=0)
-    ts = ts - ts.mean()
-    return wf, ts, int(zap2.sum())
+    """float64 transliteration of the reference device chain (shared
+    per-stage oracle lives in oracle_utils, cited there)."""
+    x = oracle_unpack(raw_bytes, cfg.baseband_input_bits)
+    return oracle_stream_chain(x, cfg)
 
 
 @pytest.fixture(scope="module")
