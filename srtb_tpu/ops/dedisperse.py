@@ -111,15 +111,189 @@ def chirp_factor_host_ri(n: int, f_min: float, df: float, f_c: float,
 
 
 def chirp_factor_df64_ri(n: int, f_min: float, df: float, f_c: float,
-                         dm, i0: int = 0, dm_lo=None) -> jnp.ndarray:
+                         dm, i0: int = 0, dm_lo=None,
+                         anchor_consts=None) -> jnp.ndarray:
     """df64 on-device chirp as stacked (cos, sin) float32 [2, n] — jit-safe
     output dtype on complex-less runtimes."""
-    phase = _chirp_phase_df64(n, f_min, df, f_c, dm, i0=i0, dm_lo=dm_lo)
+    phase = _chirp_phase_df64(n, f_min, df, f_c, dm, i0=i0, dm_lo=dm_lo,
+                              anchor_consts=anchor_consts)
     return jnp.stack([jnp.cos(phase), jnp.sin(phase)])
 
 
+# ---- anchored-Taylor fast path for the on-device df64 phase ----
+#
+# The exact per-element df64 evaluation of k spends ~3 df64 divisions per
+# channel (measured 6.6x the cost of the precomputed-bank multiply at
+# 2^27 on a v5e).  But k is an extremely smooth function of the channel
+# index: expanding
+#
+#     k(f) = A (f - f_c)^2 / (f_c^2 f) = C1*f - C2 + A/f,
+#     A = D*1e6*dm,  C1 = A/f_c^2,  C2 = 2A/f_c
+#
+# and Taylor-expanding in the channel offset d around an anchor channel,
+# the cubic remainder over a block of B channels is bounded by
+# |A| (|df| B)^4 / min|f|^5 turns — ~1e-10 for the flagship config at
+# B = 32768.  So one df64 anchor evaluation per block (amortized to
+# nothing) plus a cheap per-element update replaces the division chains:
+#
+#     k(i_a + d) ~ k0 + k1*d + k2*d^2 + k3*d^3
+#     k1 = df*(C1 - A/f_a^2)   [df64, reduced mod 1 — d is an integer,
+#                               so frac(k1*d) == frac(frac(k1)*d)]
+#     k2 = df^2 A/f_a^3, k3 = -df^3 A/f_a^4   [f32: the terms are < ~0.1
+#                               turns, so f32's 1e-7 relative is plenty]
+#
+# d <= B stays exact in f32, and the df64 k1f*d product keeps absolute
+# error ~B*2^-48.  The mod-1 value matches the exact path to ~1e-9
+# turns — far inside the ~k*2^-48 ~ 5e-6-turn precision both paths
+# inherit from df64 itself.  Precision validated against the f64 host
+# chirp in tests/test_dedisperse.py and tests/test_df64.py.
+
+_ANCHOR_BLOCK = 4096
+_ANCHOR_REMAINDER_TOL = 1e-6
+
+
+def anchored_chirp_consts(n: int, f_min, df, f_c, dm, i0: int = 0,
+                          block: int = _ANCHOR_BLOCK,
+                          allow_shrink: bool = True,
+                          unit_dm: bool = False):
+    """Host-side f64 constants for the anchored-Taylor chirp phase, or
+    None when the expansion isn't applicable: traced dm/i0 (DM-search
+    trials), a band touching f = 0, or a cubic-Taylor remainder over
+    ``block`` channels above tolerance.
+
+    ``unit_dm=True``: validate the bound at the given |dm| (the max of a
+    DM-search grid) but store dm-independent coefficients (dm = 1) — k
+    is linear in dm, so per-trial traced dm values scale the anchor
+    coefficients on device (_chirp_phase_df64_anchored_dm)."""
+    try:
+        f_min64 = float(f_min)
+        df64_ = float(df)
+        f_c64 = float(f_c)
+        dm64 = float(dm)
+        i0 = int(i0)
+    except (TypeError, ValueError):
+        return None  # traced scalar: caller keeps the exact path
+    # the last block's Taylor extension may be evaluated (then sliced
+    # off) up to the padded end, so bound over the padded range
+    n_pad = -(-n // block) * block
+    f_at_start = f_min64 + df64_ * i0
+    f_at_end = f_min64 + df64_ * (i0 + n_pad)
+    if not (np.isfinite(f_at_start) and np.isfinite(f_at_end)) \
+            or f_at_start * f_at_end <= 0 or f_c64 == 0:
+        return None
+    min_f = min(abs(f_at_start), abs(f_at_end))
+    A = np.float64(D) * 1e6 * dm64
+    # shrink the block until the cubic-Taylor remainder fits tolerance
+    # (smaller blocks = more anchors, still amortized); below 32
+    # channels per anchor the scheme stops paying for itself.  Callers
+    # whose anchor span is fixed by kernel geometry (the Pallas per-row
+    # anchors) pass allow_shrink=False: valid at `block` or not at all.
+    denom = abs(A) * abs(df64_) ** 4
+    if denom > 0:
+        block_max = (_ANCHOR_REMAINDER_TOL * min_f ** 5 / denom) ** 0.25
+        while allow_shrink and block > 32 and block > block_max:
+            block //= 2
+        if block > block_max:
+            return None
+    if unit_dm:
+        A = np.float64(D) * 1e6
+    return {
+        "A": ds.from_float64(A),
+        "C1": ds.from_float64(A / (f_c64 * f_c64)),
+        "f_c": ds.from_float64(f_c64),
+        "f_min": ds.from_float64(f_min64),
+        "df": ds.from_float64(df64_),
+        "df2A": np.float32(df64_ * df64_ * A),
+        "df3A": np.float32(df64_ ** 3 * A),
+        "block": block,
+    }
+
+
+def _anchor_values_raw(consts, ia_hi, ia_lo):
+    """Unreduced per-anchor Taylor coefficients from exact hi/lo-split
+    anchor channel indices: (k0 [df64], k1 [df64], k2 [f32], k3 [f32]).
+    With unit_dm consts these are the per-unit-dm coefficients g0..g3."""
+    df_d = ds.df64(*consts["df"])
+    f_a = ds.add(ds.df64(*consts["f_min"]),
+                 ds.add(ds.mul(df_d, ds.df64(ia_hi)),
+                        ds.mul(df_d, ds.df64(ia_lo))))
+    u = ds.div(ds.df64(*consts["A"]), f_a)            # A / f_a
+    # anchor value via the original product form u * r^2: the expanded
+    # form C1*f - C2 + A/f cancels ~1e9-turn terms down to ~1e6 and
+    # loses 3 digits of the fraction (measured 1.4e-5 turns); u*r^2
+    # keeps every factor's error *relative*, ~k * 2^-48
+    f_c_d = ds.df64(*consts["f_c"])
+    r = ds.div(ds.sub(f_a, f_c_d), f_c_d)
+    k = ds.mul(u, ds.mul(r, r))
+    w = ds.div(u, f_a)                                # A / f_a^2
+    k1 = ds.mul(df_d, ds.sub(ds.df64(*consts["C1"]), w))
+    fa32 = f_a[0]
+    fa2 = fa32 * fa32
+    k2 = consts["df2A"] / (fa2 * fa32)
+    k3 = -consts["df3A"] / (fa2 * fa2)
+    return k, k1, k2, k3
+
+
+def _reduce_mod1(k):
+    """Reduce a df64 value mod 1 keeping the pair's precision:
+    hi - trunc(hi) is exact, then renormalize (two_sum — hi may be
+    integral, leaving the whole fraction in lo, so quick_two_sum's
+    |a| >= |b| precondition doesn't hold)."""
+    return ds.two_sum(k[0] - jnp.trunc(k[0]), k[1])
+
+
+def _anchor_values(consts, ia_hi, ia_lo):
+    """Mod-1-reduced anchor coefficients:
+    (k0f [f32], k1f [df64 pair], k2 [f32], k3 [f32])."""
+    k, k1, k2, k3 = _anchor_values_raw(consts, ia_hi, ia_lo)
+    return ds.frac(k), _reduce_mod1(k1), k2, k3
+
+
+def _taylor_phase(k0f, k1f, k2, k3, delta):
+    """-2*pi*frac(k0f + k1f*delta + k2*delta^2 + k3*delta^3), the
+    anchored per-element update (all inputs broadcast against delta,
+    which must be exact in f32)."""
+    p = ds.mul(k1f, ds.df64(delta))
+    v_hi, v_lo = ds.add((k0f, jnp.zeros_like(k0f)), p)
+    poly = (delta * delta) * (k2 + k3 * delta)
+    r = (v_hi - jnp.trunc(v_hi)) + v_lo + poly
+    r = r - jnp.trunc(r)
+    return jnp.float32(-2.0 * np.pi) * r
+
+
+def _chirp_phase_df64_anchored(n: int, consts, i0=0, dm_d=None):
+    """Anchored-Taylor delta_phi [n]: one df64 anchor per `block` channels
+    (vectorized over anchors), cheap Taylor update within blocks.  i0 may
+    be traced (shard-local offsets) — validity was bounded for the global
+    range by anchored_chirp_consts.
+
+    ``dm_d`` (a df64 hi/lo pair, may be traced — DM-search trials): k is
+    linear in dm, so the dm-independent per-anchor coefficients g0..g3
+    (consts built with unit_dm=True; validity bounded at the grid's max
+    |dm|) are scaled by this trial's dm on device, then reduced mod 1
+    exactly as the concrete path — ~3 df64 divisions per channel *per
+    trial* become one df64 multiply per anchor."""
+    block = min(consts["block"], n)
+    nb = -(-n // block)
+    ia = jnp.arange(nb, dtype=jnp.int32) * block + jnp.int32(i0)
+    ia_hi = (ia & ~0xFFF).astype(jnp.float32)
+    ia_lo = (ia & 0xFFF).astype(jnp.float32)
+    if dm_d is None:
+        k0f, k1f, k2, k3 = _anchor_values(consts, ia_hi, ia_lo)
+    else:
+        g0, g1, g2, g3 = _anchor_values_raw(consts, ia_hi, ia_lo)
+        k0f = ds.frac(ds.mul(dm_d, g0))
+        k1f = _reduce_mod1(ds.mul(dm_d, g1))
+        k2 = dm_d[0] * g2
+        k3 = dm_d[0] * g3
+    delta = jnp.arange(block, dtype=jnp.float32)[None, :]
+    phase = _taylor_phase(k0f[:, None], (k1f[0][:, None], k1f[1][:, None]),
+                          k2[:, None], k3[:, None], delta)
+    return phase.reshape(-1)[:n]
+
+
 def _chirp_phase_df64(n: int, f_min: float, df: float, f_c: float, dm,
-                      i0: int = 0, dm_lo=None):
+                      i0: int = 0, dm_lo=None, anchor_consts=None):
     """delta_phi [n] in f32 via df64 arithmetic (shared by the complex and
     split-ri chirp generators).
 
@@ -128,7 +302,29 @@ def _chirp_phase_df64(n: int, f_min: float, df: float, f_c: float, dm,
     a float32 arange is exact only below 2^24, and a channel-index error
     of even a few samples at 2^27 channels shifts the phase by whole
     turns (k ~ 1e9 turns scales as ~k/f per MHz).
+
+    Concrete (non-traced) dm takes the anchored-Taylor fast path (see
+    above).  Traced dm — DM-search trials — takes it too when the caller
+    passes ``anchor_consts`` (built once with unit_dm=True at the grid's
+    max |dm|); otherwise the exact per-element evaluation runs.
     """
+    if anchor_consts is not None:
+        if dm_lo is None and isinstance(dm, (int, float, np.floating)):
+            # same guard as the exact path below: a concrete dm must be
+            # split hi/lo — one f32's 3e-8 relative error shifts
+            # k ~ 1e9 turns by ~25 turns
+            dm_arr = jnp.float32(np.float32(dm))
+            dm_lo_arr = jnp.float32(np.float64(dm) - np.float32(dm))
+        else:
+            dm_arr = jnp.asarray(dm, dtype=jnp.float32)
+            dm_lo_arr = jnp.zeros_like(dm_arr) if dm_lo is None \
+                else jnp.asarray(dm_lo, dtype=jnp.float32)
+        return _chirp_phase_df64_anchored(
+            n, anchor_consts, i0=i0, dm_d=(dm_arr, dm_lo_arr))
+    if dm_lo is None:
+        consts = anchored_chirp_consts(n, f_min, df, f_c, dm, i0=i0)
+        if consts is not None:
+            return _chirp_phase_df64_anchored(n, consts, i0=i0)
     # int32 channel indices: silently wrong at/beyond 2^31 channels.
     # i0 may be traced (shard-local offset); guard what is static here.
     if isinstance(i0, (int, np.integer)):

@@ -172,6 +172,102 @@ def _channel_index_split(rows: int, i0: int):
             (i_int & 0xFFF).astype(jnp.float32))
 
 
+def _df_frac32(hi, lo):
+    """Single-f32 fraction of a df64 value (mod-1 representative; the
+    final cos/sin only sees the phase mod one turn)."""
+    t = jnp.trunc(hi)
+    f = (hi - t) + lo
+    return f - jnp.trunc(f)
+
+
+def _chirp_phase_block_anchored(rows, i0, consts):
+    """Anchored-Taylor chirp phase for this grid step's [rows, _LANES]
+    block: one df64 anchor evaluation PER ROW (a [rows, 1] vector —
+    1/128th of the per-element work) plus a cheap per-lane Taylor
+    update — replacing the exact path's ~3 df64 divisions *per element*
+    (measured 6.6x the bank-multiply cost at 2^27).  Derivation, error
+    budget and the validity bound live with ops.dedisperse
+    .anchored_chirp_consts; the builders only pass ``consts`` when the
+    cubic remainder over one row's 128 channels is < 1e-6 turns (true
+    for every physical config — 128-channel spans are tiny)."""
+    from jax.experimental import pallas as pl
+
+    blk = rows * _LANES
+    step = pl.program_id(0)
+    row_idx = jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0)
+    base = jnp.int32(i0) + step * jnp.int32(blk) + row_idx * _LANES
+    b_hi = (base & ~0xFFF).astype(jnp.float32)        # [rows, 1]
+    b_lo = (base & 0xFFF).astype(jnp.float32)
+
+    def c(pair):
+        return jnp.float32(pair[0]), jnp.float32(pair[1])
+
+    df_hi, df_lo = c(consts["df"])
+    fm_hi, fm_lo = c(consts["f_min"])
+    A_hi, A_lo = c(consts["A"])
+    C1_hi, C1_lo = c(consts["C1"])
+    fc_hi, fc_lo = c(consts["f_c"])
+    zero = jnp.float32(0)
+
+    # f at each row anchor, then k via the product form u * r^2 (the
+    # expanded C1*f - C2 + u form cancels ~1e9-turn terms and loses 3
+    # digits of the fraction — measured 1.4e-5 turns)
+    a1 = _df_mul(df_hi, df_lo, b_hi, zero)
+    a2 = _df_mul(df_hi, df_lo, b_lo, zero)
+    fi = _df_add(*a1, *a2)
+    fa = _df_add(fm_hi, fm_lo, *fi)
+    u = _df_div(A_hi, A_lo, *fa)              # A / f_a
+    dfc = _df_add(*fa, -fc_hi, -fc_lo)
+    r = _df_div(*dfc, fc_hi, fc_lo)
+    k = _df_mul(*u, *_df_mul(*r, *r))
+    k0f = _df_frac32(*k)                      # [rows, 1]
+
+    # dk/d(channel) = df * (C1 - A/f^2), reduced mod 1 (delta is an
+    # integer, so frac(k1*delta) == frac(frac(k1)*delta)), kept df64
+    w = _df_div(*u, *fa)                      # A / f_a^2
+    s = _df_add(C1_hi, C1_lo, -w[0], -w[1])
+    k1 = _df_mul(df_hi, df_lo, *s)
+    k1f = _two_sum(k1[0] - jnp.trunc(k1[0]), k1[1])
+
+    # quadratic/cubic Taylor terms are < ~1e-4 turns over one row:
+    # plain f32 suffices
+    fa32 = fa[0]
+    fa2 = fa32 * fa32
+    k2 = jnp.float32(consts["df2A"]) / (fa2 * fa32)
+    k3 = -jnp.float32(consts["df3A"]) / (fa2 * fa2)
+
+    delta = jax.lax.broadcasted_iota(
+        jnp.int32, (1, _LANES), 1).astype(jnp.float32)  # lane offset
+    p_hi, p_lo = _df_mul(k1f[0], k1f[1],
+                         jnp.broadcast_to(delta, (rows, _LANES)),
+                         jnp.zeros((rows, _LANES), jnp.float32))
+    v_hi, v_lo = _df_add(k0f, zero, p_hi, p_lo)
+    poly = (delta * delta) * (k2 + k3 * delta)
+    frac = (v_hi - jnp.trunc(v_hi)) + v_lo + poly
+    frac = frac - jnp.trunc(frac)
+    return jnp.float32(-2.0 * np.pi) * frac
+
+
+def _chirp_consts(n, f_min, df, f_c, dm, i0):
+    """Builder-side consts for the anchored in-kernel chirp; the
+    SRTB_PALLAS_CHIRP_EXACT=1 env knob forces the exact per-element
+    path (hardware A/B of the round-3 anchored rewrite)."""
+    import os
+    if os.environ.get("SRTB_PALLAS_CHIRP_EXACT", "") == "1":
+        return None
+    return dd.anchored_chirp_consts(n, f_min, df, f_c, dm, i0=int(i0),
+                                    block=_LANES, allow_shrink=False)
+
+
+def _chirp_phase(rows, i0, f_min, df, f_c, dm, consts):
+    """Dispatch: anchored-Taylor when the builder proved it valid,
+    exact per-element df64 otherwise."""
+    if consts is not None:
+        return _chirp_phase_block_anchored(rows, i0, consts)
+    i_hi, i_lo = _channel_index_split(rows, i0)
+    return _chirp_phase_block(i_hi, i_lo, f_min, df, f_c, dm)
+
+
 def _spectrum_tiling(n: int):
     """(rows_total, rows, grid) for a [2, n] spectrum kernel launch —
     shared by every elementwise spectrum kernel here."""
@@ -185,9 +281,8 @@ def _spectrum_tiling(n: int):
 
 
 def _dedisperse_kernel(re_ref, im_ref, out_re_ref, out_im_ref, *,
-                       f_min, df, f_c, dm, rows, i0):
-    i_hi, i_lo = _channel_index_split(rows, i0)
-    phase = _chirp_phase_block(i_hi, i_lo, f_min, df, f_c, dm)
+                       f_min, df, f_c, dm, rows, i0, consts=None):
+    phase = _chirp_phase(rows, i0, f_min, df, f_c, dm, consts)
     c = jnp.cos(phase)
     s = jnp.sin(phase)
     re = re_ref[:]
@@ -198,11 +293,10 @@ def _dedisperse_kernel(re_ref, im_ref, out_re_ref, out_im_ref, *,
 
 def _rfi_dedisperse_kernel(re_ref, im_ref, thr_ref, mask_ref, out_re_ref,
                            out_im_ref, *, f_min, df, f_c, dm, rows, i0,
-                           norm, has_mask):
+                           norm, has_mask, consts=None):
     """Fused RFI stage-1 (avg-threshold zap + normalize + manual mask,
     ref: rfi_mitigation_pipe.hpp:50-94) feeding the df64 chirp multiply:
     the spectrum crosses HBM once instead of once per stage."""
-    i_hi, i_lo = _channel_index_split(rows, i0)
     re = re_ref[:]
     im = im_ref[:]
     # RFI s1: zap where power exceeds threshold*mean (thr_ref holds the
@@ -216,7 +310,7 @@ def _rfi_dedisperse_kernel(re_ref, im_ref, thr_ref, mask_ref, out_re_ref,
     re = re * scale
     im = im * scale
 
-    phase = _chirp_phase_block(i_hi, i_lo, f_min, df, f_c, dm)
+    phase = _chirp_phase(rows, i0, f_min, df, f_c, dm, consts)
     c = jnp.cos(phase)
     s = jnp.sin(phase)
     out_re_ref[:] = re * c - im * s
@@ -262,7 +356,9 @@ def rfi_s1_dedisperse_df64(spec_ri: jnp.ndarray, threshold: float,
                                   memory_space=pltpu.VMEM)
     kernel = functools.partial(_rfi_dedisperse_kernel, f_min=f_min, df=df,
                                f_c=f_c, dm=dm, rows=rows, i0=int(i0),
-                               norm=float(norm), has_mask=has_mask)
+                               norm=float(norm), has_mask=has_mask,
+                               consts=_chirp_consts(
+                                   n, f_min, df, f_c, dm, i0))
     global _USE_OB
     saved, _USE_OB = _USE_OB, bool(interpret)
     try:
@@ -299,7 +395,9 @@ def dedisperse_df64(spec_ri: jnp.ndarray, f_min: float, df: float,
     re = spec_ri[0].reshape(rows_total, _LANES)
     im = spec_ri[1].reshape(rows_total, _LANES)
     kernel = functools.partial(_dedisperse_kernel, f_min=f_min, df=df,
-                               f_c=f_c, dm=dm, rows=rows, i0=int(i0))
+                               f_c=f_c, dm=dm, rows=rows, i0=int(i0),
+                               consts=_chirp_consts(
+                                   n, f_min, df, f_c, dm, i0))
     block = pl.BlockSpec((rows, _LANES), lambda i: (i, 0),
                          memory_space=pltpu.VMEM)
     global _USE_OB

@@ -182,6 +182,27 @@ def main(argv=None) -> int:
                    f"[{n_spec}]c64", n_spec)
         except Exception as e:  # pragma: no cover
             print(json.dumps({"kernel": "pallas df64", "error": str(e)}))
+        # A/B the round-3 anchored-Taylor rewrite against the exact
+        # per-element df64 division chains it replaced (save/restore the
+        # knob: a user-exported value must survive, and the first chirp
+        # record above already honored it)
+        import os
+        prior = os.environ.get("SRTB_PALLAS_CHIRP_EXACT")
+        os.environ["SRTB_PALLAS_CHIRP_EXACT"] = "1"
+        try:
+            exact_mul = jax.jit(lambda s: pk.dedisperse_df64(
+                s, f_min, df, f_c, -478.80))
+            dt = _time(exact_mul, spec_ri, reps=reps)
+            record("chirp multiply (Pallas df64 exact, pre-anchor)", dt,
+                   f"[{n_spec}]c64", n_spec)
+        except Exception as e:  # pragma: no cover
+            print(json.dumps({"kernel": "pallas df64 exact",
+                              "error": str(e)}))
+        finally:
+            if prior is None:
+                del os.environ["SRTB_PALLAS_CHIRP_EXACT"]
+            else:
+                os.environ["SRTB_PALLAS_CHIRP_EXACT"] = prior
 
     # ---- fused RFI-s1 + df64 chirp (Pallas, one HBM pass) ----
     if jax.default_backend() not in ("cpu",):

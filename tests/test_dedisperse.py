@@ -108,3 +108,98 @@ def test_dedisperse_removes_dispersion():
     peak = np.max(np.abs(rededispersed))
     assert peak > 0.99, f"dedispersed peak {peak}"
     del sample_rate, rng
+
+
+def test_anchored_fast_path_engages_and_matches_host():
+    """The anchored-Taylor df64 chirp (concrete dm) must engage for the
+    flagship J1644 parameters and match the f64 host chirp as tightly as
+    the exact per-element path (~df64's inherent k*2^-48)."""
+    import jax
+
+    n = 1 << 20
+    f_min, bw, dm = 1405.0 + 32.0, -64.0, -478.80
+    df_ = bw / n
+    f_c = f_min + bw
+    assert dd.anchored_chirp_consts(n, f_min, df_, f_c, dm) is not None
+    host = dd.chirp_factor_host(n, f_min, df_, f_c, dm)
+    dev = np.asarray(jax.jit(
+        lambda: dd.chirp_factor_df64(n, f_min, df_, f_c, dm))())
+    assert np.abs(dev - host).max() < 2e-5
+
+
+def test_anchored_matches_exact_traced_dm_path():
+    """Anchored (concrete dm) and exact (traced hi/lo dm, the DM-search
+    spelling) must agree in factor space — same function, two routes."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 1 << 16
+    f_min, bw, dm = 1405.0, 64.0, 750.25
+    df_ = bw / n
+    f_c = f_min + bw
+    anchored = np.asarray(jax.jit(
+        lambda: dd.chirp_factor_df64(n, f_min, df_, f_c, dm))())
+    dm_hi = jnp.float32(np.float32(dm))
+    dm_lo = jnp.float32(np.float64(dm) - np.float32(dm))
+    exact = np.asarray(jax.jit(
+        lambda: dd.chirp_factor_df64(n, f_min, df_, f_c, dm_hi,
+                                     dm_lo=dm_lo))())
+    # both routes carry their own ~1e-5-class df64 error at this k;
+    # absolute precision is pinned against the f64 host chirp above
+    assert np.abs(anchored - exact).max() < 5e-5
+
+
+def test_anchored_rejects_invalid_configs():
+    """Traced dm, bands touching f = 0, and out-of-tolerance remainders
+    must all fall back (None) rather than produce silent phase error."""
+    import jax
+
+    n = 1 << 14
+    seen = []
+
+    def probe(dm):
+        seen.append(dd.anchored_chirp_consts(n, 1405.0, 64.0 / n,
+                                             1469.0, dm))
+        return dm
+
+    jax.jit(probe)(10.0)  # dm traced inside jit
+    assert seen[0] is None
+    assert dd.anchored_chirp_consts(
+        n, -32.0, 64.0 / n, 32.0, 10.0) is None  # band crosses zero
+    # pathological: enormous DM over a band reaching ~0 -> remainder
+    # blows past tolerance even at the minimum 32-channel block
+    assert dd.anchored_chirp_consts(
+        n, 1e-3, 1.0, 1e4, 1e9, allow_shrink=False) is None
+
+
+def test_anchored_dm_linear_traced_path_matches_host():
+    """The DM-search spelling: unit-dm anchor coefficients scaled by a
+    *traced* per-trial dm (anchor_consts route) must match the f64 host
+    chirp for every trial in the grid, including a traced i0 offset."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 1 << 16
+    f_min, bw = 1405.0, 64.0
+    df_ = bw / n
+    f_c = f_min + bw
+    dm_list = [12.5, 478.80, 993.12]
+    consts = dd.anchored_chirp_consts(
+        n, f_min, df_, f_c, max(dm_list), unit_dm=True)
+    assert consts is not None
+
+    @jax.jit
+    def gen(dm_hi, dm_lo, i0):
+        return dd.chirp_factor_df64_ri(n // 2, f_min, df_, f_c, dm_hi,
+                                       i0=i0, dm_lo=dm_lo,
+                                       anchor_consts=consts)
+
+    for dm in dm_list:
+        dm_hi = jnp.float32(np.float32(dm))
+        dm_lo = jnp.float32(np.float64(dm) - np.float32(dm))
+        for i0 in (0, n // 2):
+            ri = np.asarray(gen(dm_hi, dm_lo, jnp.int32(i0)))
+            got = ri[0] + 1j * ri[1]
+            host = dd.chirp_factor_host(n, f_min, df_, f_c, dm)
+            want = host[i0:i0 + n // 2]
+            assert np.abs(got - want).max() < 5e-5, (dm, i0)
