@@ -355,7 +355,8 @@ def subbyte_window_planes(window: np.ndarray, nbits: int) -> np.ndarray:
 
 def rfft_subbyte(data: jnp.ndarray, nbits: int, strategy: str = "four_step",
                  window_planes: jnp.ndarray | None = None,
-                 drop_nyquist: bool = True) -> jnp.ndarray:
+                 drop_nyquist: bool = True,
+                 planes: jnp.ndarray | None = None) -> jnp.ndarray:
     """Fused unpack + even/odd pack + R2C for 1/2/4-bit baseband bytes,
     with every intermediate lane-dense.
 
@@ -381,14 +382,19 @@ def rfft_subbyte(data: jnp.ndarray, nbits: int, strategy: str = "four_step",
     ``window_planes``: optional [count, M] from `subbyte_window_planes`.
     ``strategy``: "four_step" (XLA batched FFTs) or "mxu" (DFT-matmul
     stages) for the M-point plane FFTs.
+    ``planes``: optional precomputed (and already-windowed) blocked field
+    planes [..., count, M] — e.g. from the fused Pallas
+    unpack_subbyte_planes_window; when given, ``data``/``nbits`` unpack
+    and ``window_planes`` are skipped entirely.
     """
     from srtb_tpu.ops import unpack as _U
     count = 8 // nbits
     if count < 2:
         raise ValueError("rfft_subbyte requires 1/2/4-bit input")
-    planes = _U.unpack_subbyte_planes(data, nbits)        # [..., count, M]
-    if window_planes is not None:
-        planes = planes * window_planes
+    if planes is None:
+        planes = _U.unpack_subbyte_planes(data, nbits)    # [..., count, M]
+        if window_planes is not None:
+            planes = planes * window_planes
     z = subbyte_planes_to_packed(planes)
     if strategy == "mxu":
         from srtb_tpu.ops.mxu_fft import mxu_fft

@@ -684,3 +684,103 @@ def unpack_2bit_window(data: jnp.ndarray,
                        interpret: bool = False) -> jnp.ndarray:
     """uint8 [m] -> f32 [4m]; see :func:`unpack_subbyte_window`."""
     return unpack_subbyte_window(data, 2, window, interpret)
+
+
+# ----------------------------------------------------------------
+# blocked-plane sub-byte unpack (the Mosaic-lowerable spelling)
+# ----------------------------------------------------------------
+
+def _unpack_planes_kernel(byte_ref, win_ref, out_ref, *, nbits,
+                          apply_window):
+    b = byte_ref[:].astype(jnp.int32)            # [rows, LANES]
+    count = 8 // nbits
+    mask = (1 << nbits) - 1
+    for j in range(count):
+        # MSB-first field j of every byte (ref: unpack.hpp:43-140)
+        f = ((b >> (8 - nbits * (j + 1))) & mask).astype(jnp.float32)
+        if apply_window:
+            f = f * win_ref[j]
+        out_ref[j] = f
+
+
+def unpack_subbyte_planes_window(data: jnp.ndarray, nbits: int,
+                                 window_planes: jnp.ndarray | None = None,
+                                 interpret: bool = False) -> jnp.ndarray:
+    """uint8 [m] -> blocked field planes [count, m] f32 (count = 8/nbits,
+    plane k = field k of every byte), fused with the blocked window
+    multiply — ONE HBM pass for unpack + window.
+
+    This is the Mosaic-LOWERABLE sub-byte unpack: the sample-order kernel
+    (:func:`unpack_subbyte_window`) needs a lane interleave
+    (out[4c+j] = field_j(byte[c])) that Mosaic cannot lower (see
+    UNPACK_MOSAIC_OK), but blocked planes put each field on a new MAJOR
+    axis — per-plane [rows, 128] writes, no lane shuffle anywhere.  The
+    blocked layout is exactly what ops.fft.rfft_subbyte consumes (its
+    FFT decimation absorbs the blocked->natural permutation), so nothing
+    downstream ever wants sample order.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if nbits not in (1, 2, 4):
+        raise ValueError(f"sub-byte unpack needs nbits in 1/2/4, got {nbits}")
+    count = 8 // nbits
+    m = data.shape[-1]
+    if m % _LANES:
+        raise ValueError(f"byte count {m} not a multiple of {_LANES}")
+    rows_total = m // _LANES
+    rows = min(_ROWS, rows_total)
+    if rows_total % rows:
+        raise ValueError(f"{rows_total} rows not divisible by block {rows}")
+    grid = (rows_total // rows,)
+
+    bytes2d = data.reshape(rows_total, _LANES)
+    apply_window = window_planes is not None
+    if window_planes is None:
+        win3d = jnp.ones((count, 1, _LANES), dtype=jnp.float32)
+        win_block = pl.BlockSpec((count, 1, _LANES), lambda i: (0, 0, 0),
+                                 memory_space=pltpu.VMEM)
+    else:
+        win3d = window_planes.reshape(count, rows_total, _LANES)
+        win_block = pl.BlockSpec((count, rows, _LANES), lambda i: (0, i, 0),
+                                 memory_space=pltpu.VMEM)
+
+    kernel = functools.partial(_unpack_planes_kernel, nbits=nbits,
+                               apply_window=apply_window)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((rows, _LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+                  win_block],
+        out_specs=pl.BlockSpec((count, rows, _LANES), lambda i: (0, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((count, rows_total, _LANES),
+                                       jnp.float32),
+        interpret=interpret,
+    )(bytes2d, win3d)
+    return out.reshape(count, m)
+
+
+# Pending on-chip Mosaic validation (run tools_tpu_r3_queue.sh section
+# "planes unpack probe", then flip to True): the spelling avoids every
+# construct the sample-order kernel died on, but Mosaic acceptance is
+# only provable by compiling on a real chip.  SRTB_PALLAS_PLANES_UNPACK=1
+# opts in before that.
+PLANES_UNPACK_MOSAIC_OK = False
+
+
+def planes_unpack_enabled(interpret: bool) -> bool:
+    import os
+    return interpret or PLANES_UNPACK_MOSAIC_OK or \
+        os.environ.get("SRTB_PALLAS_PLANES_UNPACK", "") == "1"
+
+
+def planes_tiling_ok(m: int) -> bool:
+    """Whether a byte count fits the planes-unpack launch geometry
+    (same pre-flight role as sk_tiling_ok: callers fall back to the XLA
+    unpack instead of crashing at trace)."""
+    if m % _LANES:
+        return False
+    rows_total = m // _LANES
+    return rows_total % min(_ROWS, rows_total) == 0

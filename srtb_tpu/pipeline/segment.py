@@ -186,8 +186,19 @@ class SegmentProcessor:
         if self._blocked_subbyte and strategy in ("four_step", "mxu",
                                                   "pallas",
                                                   "pallas_interpret"):
+            from srtb_tpu.ops import pallas_kernels as pk
+            interp = getattr(self, "_pallas_interpret", False)
+            planes = None
+            if self.cfg.use_pallas and pk.planes_unpack_enabled(interp) \
+                    and pk.planes_tiling_ok(raw.shape[-1]):
+                # fused unpack + blocked-window multiply in one HBM pass
+                # (the Mosaic-lowerable blocked-plane spelling)
+                planes = pk.unpack_subbyte_planes_window(
+                    raw, self.cfg.baseband_input_bits,
+                    self.window_planes, interpret=interp)
             spec = F.rfft_subbyte(raw, self.cfg.baseband_input_bits,
-                                  strategy, self.window_planes)[None, :]
+                                  strategy, self.window_planes,
+                                  planes=planes)[None, :]
         else:
             x = self._unpack(raw)
             spec = F.segment_rfft(x, strategy)             # [S, n/2]

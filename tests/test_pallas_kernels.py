@@ -234,3 +234,97 @@ def test_rfi_s1_dedisperse_fused_matches_jnp_sequence(interpret, with_mask):
     want = rfi.mitigate_rfi_manual(want, mask)[0]
     want = np.asarray(want) * dd.chirp_factor_host(n, f_min, df, f_c, dm)
     assert np.max(np.abs(got - want)) < 5e-3 * np.max(np.abs(want))
+
+
+@pytest.mark.parametrize("nbits", [1, 2, 4])
+def test_unpack_planes_kernel_matches_jnp(nbits):
+    """Blocked-plane Pallas unpack (the Mosaic-lowerable spelling) vs the
+    XLA unpack_subbyte_planes, with and without the blocked window."""
+    from srtb_tpu.ops import fft as F
+    from srtb_tpu.ops import unpack as U
+
+    rng = np.random.default_rng(3)
+    m = 1 << 11
+    data = jnp.asarray(rng.integers(0, 256, m, dtype=np.uint8))
+    want = np.asarray(U.unpack_subbyte_planes(data, nbits))
+    got = np.asarray(pk.unpack_subbyte_planes_window(data, nbits,
+                                                     interpret=True))
+    np.testing.assert_array_equal(got, want)
+    win = F.subbyte_window_planes(
+        (np.hanning((8 // nbits) * m) + 0.1).astype(np.float32), nbits)
+    got_w = np.asarray(pk.unpack_subbyte_planes_window(
+        data, nbits, jnp.asarray(win), interpret=True))
+    np.testing.assert_allclose(got_w, want * win, rtol=1e-6)
+
+
+def test_blocked_pipeline_uses_planes_unpack(monkeypatch):
+    """use_pallas on the blocked sub-byte path must route through the
+    fused planes-unpack kernel (interpret mode) and produce the same
+    waterfall as the XLA unpack."""
+    from srtb_tpu.config import Config
+    from srtb_tpu.pipeline.segment import SegmentProcessor, \
+        waterfall_to_numpy
+
+    cfg = Config(
+        baseband_input_count=1 << 14,
+        baseband_input_bits=2,
+        baseband_format_type="simple",
+        baseband_freq_low=1405.0,
+        baseband_bandwidth=64.0,
+        baseband_sample_rate=128e6,
+        dm=30.0,
+        spectrum_channel_count=1 << 5,
+        mitigate_rfi_average_method_threshold=1e9,
+        mitigate_rfi_spectral_kurtosis_threshold=1e9,
+        baseband_reserve_sample=False,
+        fft_strategy="four_step",
+    )
+    rng = np.random.default_rng(4)
+    raw = rng.integers(0, 256, cfg.segment_bytes(1), dtype=np.uint8)
+    base = waterfall_to_numpy(SegmentProcessor(cfg).process(raw)[0])
+
+    called = []
+    orig = pk.unpack_subbyte_planes_window
+
+    def spy(*a, **kw):
+        called.append(True)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(pk, "unpack_subbyte_planes_window", spy)
+    wf = waterfall_to_numpy(
+        SegmentProcessor(cfg.replace(use_pallas=True)).process(raw)[0])
+    assert called, "planes unpack kernel was not used"
+    np.testing.assert_allclose(wf, base, rtol=2e-3, atol=1e-4)
+
+
+def test_pallas_chirp_exact_fallback_path(monkeypatch):
+    """The exact per-element in-kernel chirp (the anchored rewrite's
+    fallback, forced via SRTB_PALLAS_CHIRP_EXACT=1) must still match the
+    f64 host chirp — a regression here would ship silently since every
+    physical config otherwise takes the anchored path."""
+    from srtb_tpu.ops import dedisperse as dd
+
+    monkeypatch.setenv("SRTB_PALLAS_CHIRP_EXACT", "1")
+    n = 1 << 12
+    f_min, bw, dm = 1405.0 + 32.0, -64.0, -478.80
+    f_c = f_min + bw
+    df = bw / (1 << 22)  # flagship-scale df; i0=0 slice of it
+    rng = np.random.default_rng(5)
+    spec = (rng.standard_normal(n) + 1j * rng.standard_normal(n)).astype(
+        np.complex64)
+    spec_ri = jnp.stack([jnp.asarray(spec.real), jnp.asarray(spec.imag)])
+    assert pk._chirp_consts(n, f_min, df, f_c, dm, 0) is None  # knob works
+    out_ri = np.asarray(pk.dedisperse_df64(spec_ri, f_min, df, f_c, dm,
+                                           interpret=True))
+    got = out_ri[0] + 1j * out_ri[1]
+    host = dd.chirp_factor_host(n, f_min, df, f_c, dm)
+    err = np.abs(got - spec * host)
+    assert err.max() < 5e-3 * np.abs(spec).max(), err.max()
+
+
+def test_planes_tiling_ok_gates_fallback():
+    assert pk.planes_tiling_ok(128 * 256)
+    assert not pk.planes_tiling_ok(64)        # not a multiple of 128
+    assert not pk.planes_tiling_ok(128 * 384)  # rows not divisible
+    # small segments: rows_total < _ROWS uses rows_total itself
+    assert pk.planes_tiling_ok(128 * 8)

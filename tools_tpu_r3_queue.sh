@@ -1,0 +1,89 @@
+#!/bin/bash
+# Round-3 TPU hardware backlog: run everything the round's CPU-side work
+# queued up, in priority order, appending artifacts as it goes.  Safe to
+# re-run; each block is independent.  Run from the repo root with the
+# TPU visible.
+#
+#   bash tools_tpu_r3_queue.sh [quick]
+#
+# "quick" skips the long blocks (2^30, e2e 60s, compile-cache proof).
+set -u
+OUT=PERF_TPU.jsonl
+stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
+note() { echo "{\"ts\": \"$(stamp)\", \"variant\": \"note\", \"note\": \"$1\"}" >> "$OUT"; }
+run() {
+  local tag="$1"; shift
+  echo "== $tag =="
+  local line
+  line=$("$@" 2>/dev/null | grep '^{' | tail -1)
+  if [ -n "$line" ]; then
+    echo "{\"ts\": \"$(stamp)\", \"variant\": \"$tag\", \"result\": $line}" >> "$OUT"
+    echo "$line"
+  else
+    echo "{\"ts\": \"$(stamp)\", \"variant\": \"$tag\", \"error\": true}" >> "$OUT"
+  fi
+}
+
+QUICK=${1:-}
+
+note "r3 queue start: anchored chirp A/B, pallas A/Bs, 2^30 rebench, e2e live, compile cache"
+
+# ---- 1. headline + the round-2 pending A/Bs (VERDICT weak #4) ----
+run baseline    python bench.py
+run pallas      env SRTB_BENCH_USE_PALLAS=1 python bench.py
+run pallas_sk   env SRTB_BENCH_USE_PALLAS=1 SRTB_BENCH_USE_PALLAS_SK=1 python bench.py
+run pallas_fs   env SRTB_BENCH_FFT_STRATEGY=pallas python bench.py
+
+# ---- 1b. blocked-plane Pallas unpack: Mosaic acceptance probe ----
+# (flip ops/pallas_kernels.PLANES_UNPACK_MOSAIC_OK to True if this
+# compiles and matches — the spelling avoids the sample-order kernel's
+# lane interleave, but only a real-chip compile proves Mosaic takes it)
+echo "== planes unpack Mosaic probe =="
+( timeout 300 python - <<'PYEOF'
+import numpy as np, jax.numpy as jnp
+from srtb_tpu.ops import pallas_kernels as pk, unpack as U
+rng = np.random.default_rng(0)
+data = jnp.asarray(rng.integers(0, 256, 1 << 16, dtype=np.uint8))
+got = np.asarray(pk.unpack_subbyte_planes_window(data, 2, interpret=False))
+want = np.asarray(U.unpack_subbyte_planes(data, 2))
+np.testing.assert_array_equal(got, want)
+print('{"probe": "planes_unpack_mosaic", "ok": true}')
+PYEOF
+) > /tmp/planes_probe.json 2>/dev/null
+rc=$?
+line=$(grep '^{' /tmp/planes_probe.json 2>/dev/null | tail -1)
+echo "{\"ts\": \"$(stamp)\", \"variant\": \"planes_unpack_mosaic_probe\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$OUT"
+
+# ---- 2. per-kernel rows incl. the anchored-vs-exact chirp A/B ----
+echo "== kernel bench (anchored chirp A/B) =="
+python -m srtb_tpu.tools.kernel_bench --log2n 28 --reps 5 2>/dev/null \
+  | while read -r line; do
+      echo "{\"ts\": \"$(stamp)\", \"variant\": \"kernel\", \"result\": $line}" >> "$OUT"
+      echo "$line"
+    done
+
+if [ "$QUICK" = "quick" ]; then exit 0; fi
+
+# ---- 3. 2^30 production segment rebench (VERDICT #3) ----
+run n2_30       env SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 SRTB_BENCH_REPS=3 python bench.py
+# the blocked staged stage_a SIGSEGV probe: bounded, in a subshell so a
+# compiler crash cannot wedge this queue (note the rc either way)
+echo "== staged-blocked 2^30 probe =="
+( timeout 900 env SRTB_STAGED_BLOCKED=1 SRTB_BENCH_LOG2N=30 \
+    SRTB_BENCH_LOG2CHAN=15 SRTB_BENCH_REPS=1 SRTB_BENCH_DEADLINE=800 \
+    python bench.py > /tmp/staged_blocked_probe.json 2>/dev/null )
+rc=$?
+line=$(grep '^{' /tmp/staged_blocked_probe.json 2>/dev/null | tail -1)
+echo "{\"ts\": \"$(stamp)\", \"variant\": \"staged_blocked_probe\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$OUT"
+
+# ---- 4. live UDP -> TPU end-to-end, 60 s at 2x wire rate (VERDICT #6) ----
+python -m srtb_tpu.tools.e2e_live --seconds 60 --rate_x 2.0 --log2n 27 \
+  --deadline_s 120 --out E2E_LIVE.jsonl || note "e2e_live failed"
+
+# ---- 5. compile-cache cold/warm proof across process restarts (VERDICT #7) ----
+# same config twice in separate processes; the second run's compile_s is
+# the warm number (target <= 10 s)
+run cache_cold  env SRTB_BENCH_LOG2N=27 SRTB_BENCH_REPS=3 python bench.py
+run cache_warm  env SRTB_BENCH_LOG2N=27 SRTB_BENCH_REPS=3 python bench.py
+
+note "r3 queue done"
