@@ -217,27 +217,46 @@ class SegmentProcessor:
         return self._blocked_subbyte and bool(
             int(os.environ.get("SRTB_STAGED_BLOCKED", "0")))
 
+    @property
+    def _staged_rows_impl(self) -> str:
+        """Who runs the staged plan's batched leg FFTs.  Default XLA;
+        SRTB_STAGED_ROWS_IMPL=pallas moves the legs to the VMEM row-FFT
+        kernel — both a perf experiment and a workaround candidate for
+        the XLA TPU compiler SIGSEGV on the 2^30 blocked stage_a shape
+        (the crash is in XLA's handling of that batched FFT; Pallas legs
+        never hand XLA an FFT op at all)."""
+        impl = os.environ.get("SRTB_STAGED_ROWS_IMPL", "xla")
+        if impl == "pallas" and getattr(self, "_pallas_interpret", False):
+            return "pallas_interpret"
+        return impl
+
     def _stage_a(self, raw: jnp.ndarray):
         """unpack + even/odd pack + four-step first half."""
+        rows_impl = self._staged_rows_impl
         if self._staged_blocked:
             planes = U.unpack_subbyte_planes(
                 raw, self.cfg.baseband_input_bits)
             if self.window_planes is not None:
                 planes = planes * self.window_planes
-            a = F.four_step_stage1(F.subbyte_planes_to_packed(planes))
+            a = F.four_step_stage1(F.subbyte_planes_to_packed(planes),
+                                   rows_impl=rows_impl)
         else:
             x = self._unpack(raw)
-            a = F.four_step_stage1(F.pack_even_odd(x))    # [S, n2, n1]
+            a = F.four_step_stage1(F.pack_even_odd(x),
+                                   rows_impl=rows_impl)  # [S, n2, n1]
         return jnp.stack([jnp.real(a), jnp.imag(a)])
 
     def _stage_b(self, a_ri: jnp.ndarray):
         """four-step second half + Hermitian post -> spectrum [S, n/2]."""
         a = jax.lax.complex(a_ri[0], a_ri[1])
+        rows_impl = self._staged_rows_impl
         if self._staged_blocked:
-            spec = F.finish_rfft_subbyte(F.four_step_stage2(a))[None, :]
+            spec = F.finish_rfft_subbyte(
+                F.four_step_stage2(a, rows_impl=rows_impl))[None, :]
         else:
-            spec = F.hermitian_rfft_post(F.four_step_stage2(a),
-                                         drop_nyquist=True)
+            spec = F.hermitian_rfft_post(
+                F.four_step_stage2(a, rows_impl=rows_impl),
+                drop_nyquist=True)
         return jnp.stack([jnp.real(spec), jnp.imag(spec)])
 
     def _stage_c(self, spec_ri: jnp.ndarray):

@@ -345,3 +345,39 @@ def test_segment_deadline_fires_and_cancels(synthetic_cfg):
     p._sync_with_deadline(lambda: _time.sleep(0.4))
     assert fired
     p.close()
+
+
+def test_staged_pallas_rows_impl_matches_default(monkeypatch):
+    """SRTB_STAGED_ROWS_IMPL=pallas (the 2^30 SIGSEGV workaround
+    candidate: Pallas leg FFTs instead of XLA's batched FFT) must
+    produce the same staged-plan waterfall, blocked and classic."""
+    import numpy as np
+
+    from srtb_tpu.config import Config
+    from srtb_tpu.pipeline.segment import SegmentProcessor, \
+        waterfall_to_numpy
+
+    cfg = Config(
+        baseband_input_count=1 << 14,
+        baseband_input_bits=2,
+        baseband_format_type="simple",
+        baseband_freq_low=1405.0,
+        baseband_bandwidth=64.0,
+        baseband_sample_rate=128e6,
+        dm=30.0,
+        spectrum_channel_count=1 << 5,
+        mitigate_rfi_average_method_threshold=1e9,
+        mitigate_rfi_spectral_kurtosis_threshold=1e9,
+        baseband_reserve_sample=False,
+    )
+    rng = np.random.default_rng(9)
+    raw = rng.integers(0, 256, cfg.segment_bytes(1), dtype=np.uint8)
+    for blocked in ("0", "1"):
+        monkeypatch.setenv("SRTB_STAGED_BLOCKED", blocked)
+        monkeypatch.delenv("SRTB_STAGED_ROWS_IMPL", raising=False)
+        base = waterfall_to_numpy(
+            SegmentProcessor(cfg, staged=True).process(raw)[0])
+        monkeypatch.setenv("SRTB_STAGED_ROWS_IMPL", "pallas")
+        got = waterfall_to_numpy(
+            SegmentProcessor(cfg, staged=True).process(raw)[0])
+        np.testing.assert_allclose(got, base, rtol=2e-3, atol=2e-4)

@@ -75,6 +75,16 @@ echo "== staged-blocked 2^30 probe =="
 rc=$?
 line=$(grep '^{' /tmp/staged_blocked_probe.json 2>/dev/null | tail -1)
 echo "{\"ts\": \"$(stamp)\", \"variant\": \"staged_blocked_probe\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$OUT"
+# workaround candidate: Pallas leg FFTs (no XLA batched-FFT op in the
+# crashing program at all)
+echo "== staged-blocked 2^30 probe, pallas legs =="
+( timeout 900 env SRTB_STAGED_BLOCKED=1 SRTB_STAGED_ROWS_IMPL=pallas \
+    SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 SRTB_BENCH_REPS=1 \
+    SRTB_BENCH_DEADLINE=800 \
+    python bench.py > /tmp/staged_blocked_pallas.json 2>/dev/null )
+rc=$?
+line=$(grep '^{' /tmp/staged_blocked_pallas.json 2>/dev/null | tail -1)
+echo "{\"ts\": \"$(stamp)\", \"variant\": \"staged_blocked_pallas_probe\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$OUT"
 
 # ---- 4. live UDP -> TPU end-to-end, 60 s at 2x wire rate (VERDICT #6) ----
 python -m srtb_tpu.tools.e2e_live --seconds 60 --rate_x 2.0 --log2n 27 \
