@@ -178,11 +178,21 @@ class SegmentProcessor:
         return unpack_streams(raw, self.fmt.unpack_variant,
                               cfg.baseband_input_bits, self.window)
 
+    def _resolve_rows_impl(self, impl: str) -> str:
+        """Single home of the off-TPU downgrade rule: 'pallas' runs the
+        kernels in interpret mode on CPU backends.  Unknown names raise —
+        a typo in SRTB_STAGED_ROWS_IMPL must not silently fall back to
+        XLA while the probe log claims a Pallas result."""
+        if impl not in ("xla", "four_step", "mxu", "monolithic", "auto",
+                        "pallas", "pallas_interpret"):
+            raise ValueError(f"unknown rows impl / fft strategy {impl!r}")
+        if impl == "pallas" and getattr(self, "_pallas_interpret", False):
+            return "pallas_interpret"
+        return impl
+
     def _process(self, raw: jnp.ndarray, chirp_ri: jnp.ndarray):
-        strategy = F.resolve_strategy(self.n, self.cfg.fft_strategy)
-        if strategy == "pallas" and getattr(self, "_pallas_interpret",
-                                            False):
-            strategy = "pallas_interpret"
+        strategy = self._resolve_rows_impl(
+            F.resolve_strategy(self.n, self.cfg.fft_strategy))
         if self._blocked_subbyte and strategy in ("four_step", "mxu",
                                                   "pallas",
                                                   "pallas_interpret"):
@@ -225,10 +235,8 @@ class SegmentProcessor:
         the XLA TPU compiler SIGSEGV on the 2^30 blocked stage_a shape
         (the crash is in XLA's handling of that batched FFT; Pallas legs
         never hand XLA an FFT op at all)."""
-        impl = os.environ.get("SRTB_STAGED_ROWS_IMPL", "xla")
-        if impl == "pallas" and getattr(self, "_pallas_interpret", False):
-            return "pallas_interpret"
-        return impl
+        return self._resolve_rows_impl(
+            os.environ.get("SRTB_STAGED_ROWS_IMPL", "xla"))
 
     def _stage_a(self, raw: jnp.ndarray):
         """unpack + even/odd pack + four-step first half."""

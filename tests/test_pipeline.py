@@ -350,10 +350,17 @@ def test_segment_deadline_fires_and_cancels(synthetic_cfg):
 def test_staged_pallas_rows_impl_matches_default(monkeypatch):
     """SRTB_STAGED_ROWS_IMPL=pallas (the 2^30 SIGSEGV workaround
     candidate: Pallas leg FFTs instead of XLA's batched FFT) must
-    produce the same staged-plan waterfall, blocked and classic."""
+    produce the same staged-plan waterfall, blocked and classic.
+
+    CPU-sized segments have four-step legs below pallas_fft.supported's
+    2^12 minimum, so the kernel itself can't fire here (its numerics
+    are pinned at supported sizes by tests/test_pallas_fft.py); this
+    test asserts the *dispatch* — the env knob reaches _fft_minor as
+    rows_impl='pallas_interpret' — plus numeric parity of the plan."""
     import numpy as np
 
     from srtb_tpu.config import Config
+    from srtb_tpu.ops import fft as F
     from srtb_tpu.pipeline.segment import SegmentProcessor, \
         waterfall_to_numpy
 
@@ -372,12 +379,28 @@ def test_staged_pallas_rows_impl_matches_default(monkeypatch):
     )
     rng = np.random.default_rng(9)
     raw = rng.integers(0, 256, cfg.segment_bytes(1), dtype=np.uint8)
+    impls_seen = []
+    orig = F._fft_minor
+
+    def spy(x, inverse, rows_impl="xla"):
+        impls_seen.append(rows_impl)
+        return orig(x, inverse, rows_impl)
+
     for blocked in ("0", "1"):
         monkeypatch.setenv("SRTB_STAGED_BLOCKED", blocked)
         monkeypatch.delenv("SRTB_STAGED_ROWS_IMPL", raising=False)
         base = waterfall_to_numpy(
             SegmentProcessor(cfg, staged=True).process(raw)[0])
         monkeypatch.setenv("SRTB_STAGED_ROWS_IMPL", "pallas")
+        monkeypatch.setattr(F, "_fft_minor", spy)
+        impls_seen.clear()
         got = waterfall_to_numpy(
             SegmentProcessor(cfg, staged=True).process(raw)[0])
+        monkeypatch.setattr(F, "_fft_minor", orig)
+        assert "pallas_interpret" in impls_seen, impls_seen
         np.testing.assert_allclose(got, base, rtol=2e-3, atol=2e-4)
+    # a typo'd knob value must raise, not silently fall back to XLA
+    monkeypatch.setenv("SRTB_STAGED_ROWS_IMPL", "palas")
+    import pytest
+    with pytest.raises(ValueError, match="rows impl"):
+        SegmentProcessor(cfg, staged=True).process(raw)
