@@ -138,6 +138,15 @@ def run(args) -> dict:
     src = UdpReceiverSource(cfg)
     pipe = ThreadedPipeline(cfg, source=src, keep_waterfall=False)
     try:
+        # compile BEFORE offering load: the first jit of the segment
+        # program takes seconds (CPU) to minutes (TPU tunnel), during
+        # which nothing drains and the kernel socket buffer overflows —
+        # measured 2.9% startup loss at even 0.05x rate without this
+        warm = np.frombuffer(payload_segment, dtype=np.uint8)
+        wf, det = pipe.processor.process(warm)
+        np.asarray(det.signal_counts)
+        del wf, det
+        log.info("[e2e_live] pipeline compiled; starting offered load")
         started.set()
         t0 = time.perf_counter()
         stats = pipe.run(max_segments=expected_segments)
