@@ -87,6 +87,7 @@ def run(args) -> dict:
     from srtb_tpu.utils.metrics import metrics
 
     n = 1 << args.log2n
+    ports = [args.port + i for i in range(args.receivers)]
     cfg = Config(
         baseband_input_count=n,
         baseband_input_bits=2,
@@ -101,8 +102,8 @@ def run(args) -> dict:
         mitigate_rfi_spectral_kurtosis_threshold=1.05,
         baseband_reserve_sample=False,
         baseband_output_file_prefix=args.prefix,
-        udp_receiver_address=["127.0.0.1"],
-        udp_receiver_port=[args.port],
+        udp_receiver_address=["127.0.0.1"] * len(ports),
+        udp_receiver_port=ports,
         udp_packet_provider=args.provider,
         segment_deadline_s=args.deadline_s,
         fft_strategy=args.fft_strategy,
@@ -124,18 +125,26 @@ def run(args) -> dict:
     real_time_bps = cfg.baseband_sample_rate * 2 / 8  # 2-bit payload
     pace_pps = args.rate_x * real_time_bps / fmt.payload_bytes
     expected_segments = max(1, int(
-        args.seconds * args.rate_x * cfg.baseband_sample_rate / n))
+        args.seconds * args.rate_x * cfg.baseband_sample_rate / n)) \
+        * len(ports)   # each receiver contributes its own segment stream
 
     started = threading.Event()
     stop = threading.Event()
-    sender = threading.Thread(
-        target=_sender, args=(args.port, fmt, payload_segment, pace_pps,
+    senders = [threading.Thread(
+        target=_sender, args=(port, fmt, payload_segment, pace_pps,
                               started, stop),
-        name="e2e-live-sender", daemon=True)
-    sender.start()
+        name=f"e2e-live-sender-{port}", daemon=True) for port in ports]
+    for s in senders:
+        s.start()
 
     http_srv = WaterfallHTTPServer(args.prefix, port=args.http_port).start()
-    src = UdpReceiverSource(cfg)
+    if len(ports) > 1:
+        # the reference's production shape: one udp_receiver_pipe per
+        # polarization (ref: main.cpp:261-271) -> MultiUdpSource
+        from srtb_tpu.io.udp import MultiUdpSource
+        src = MultiUdpSource(cfg)
+    else:
+        src = UdpReceiverSource(cfg)
     pipe = ThreadedPipeline(cfg, source=src, keep_waterfall=False)
     try:
         # compile BEFORE offering load: the first jit of the segment
@@ -153,7 +162,8 @@ def run(args) -> dict:
         wall = time.perf_counter() - t0
     finally:
         stop.set()
-        sender.join(timeout=5)
+        for s in senders:
+            s.join(timeout=5)
         src.close()
         pipe.close()
 
@@ -172,6 +182,7 @@ def run(args) -> dict:
         "seconds": round(wall, 1),
         "rate_x": args.rate_x,
         "log2n": args.log2n,
+        "receivers": len(ports),
         "provider": args.provider,
         "segments": stats.segments,
         "msamples_per_s": round(stats.msamples_per_sec, 1),
@@ -204,6 +215,9 @@ def main(argv=None) -> int:
     p.add_argument("--log2n", type=int, default=24)
     p.add_argument("--log2chan", type=int, default=11)
     p.add_argument("--port", type=int, default=42150)
+    p.add_argument("--receivers", type=int, default=1,
+                   help="N receivers on ports port..port+N-1 "
+                        "(MultiUdpSource, the reference's per-pol shape)")
     p.add_argument("--http_port", type=int, default=0)
     p.add_argument("--provider", default="recvmmsg",
                    choices=["recvmmsg", "packet_ring", "recvfrom",

@@ -86,9 +86,11 @@ rc=$?
 line=$(grep '^{' /tmp/staged_blocked_pallas.json 2>/dev/null | tail -1)
 echo "{\"ts\": \"$(stamp)\", \"variant\": \"staged_blocked_pallas_probe\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$OUT"
 
-# ---- 4. live UDP -> TPU end-to-end, 60 s at 2x wire rate (VERDICT #6) ----
+# ---- 4. live UDP -> TPU end-to-end, 60 s at 2x wire rate (VERDICT #6),
+#         two receivers = the reference's per-polarization deployment ----
 python -m srtb_tpu.tools.e2e_live --seconds 60 --rate_x 2.0 --log2n 27 \
-  --deadline_s 120 --out E2E_LIVE.jsonl || note "e2e_live failed"
+  --receivers 2 --deadline_s 120 --out E2E_LIVE.jsonl \
+  || note "e2e_live failed"
 
 # ---- 5. compile-cache cold/warm proof across process restarts (VERDICT #7) ----
 # same config twice in separate processes; the second run's compile_s is
