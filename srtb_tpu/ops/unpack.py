@@ -21,6 +21,7 @@ Packet-format de-interleave variants:
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -85,11 +86,32 @@ def unpack(data: jnp.ndarray, nbits: int,
     elif nbits == 32:
         out = data.view(jnp.float32)
     elif nbits == 64:
-        # float64 input; bit-accurate truncation to f32 without enabling x64:
-        # split the double into high-word sign/exponent/mantissa-high on host
-        # is overkill — XLA on CPU supports f64 loads; on TPU 64-bit input is
-        # not a real ingest format. Use f64 view when available.
-        out = data.view(jnp.float64).astype(jnp.float32)
+        # float64 input decoded to f32 from the raw bit pattern — without
+        # x64, jnp's .view(float64) silently truncates to a float32 view
+        # (doubling the sample count and corrupting every value), so the
+        # double is reassembled from its little-endian uint32 halves:
+        # sign/exponent/mantissa-high in the high word, mantissa-low in
+        # the low word, combined to f32 precision.
+        u = data.view(jnp.uint32)
+        lo = u[..., 0::2].astype(jnp.float32)
+        hi = u[..., 1::2]
+        sign = jnp.where((hi >> 31) != 0, jnp.float32(-1.0),
+                         jnp.float32(1.0))
+        exp = ((hi >> 20) & 0x7FF).astype(jnp.int32)
+        frac = ((hi & 0xFFFFF).astype(jnp.float32) * jnp.float32(2.0 ** -20)
+                + lo * jnp.float32(2.0 ** -52))
+        # exact power of two via the f32 exponent field (jnp.exp2 lowers
+        # to exp(x*ln2) and is ~1e-7-relative WRONG for large exponents);
+        # clamping the biased exponent to [0, 255] makes out-of-f32-range
+        # doubles flush to 0 / +-inf, and f64 subnormals (exp == 0,
+        # magnitude < 2^-1021) flush to 0 — all correct truncations
+        pw = jax.lax.bitcast_convert_type(
+            (jnp.clip(exp - 1023 + 127, 0, 255) << 23).astype(jnp.int32),
+            jnp.float32)
+        mag = jnp.where(exp == 0, jnp.float32(0.0), (1.0 + frac) * pw)
+        out = sign * mag
+        out = jnp.where((exp == 0x7FF) & (frac > 0), jnp.float32(jnp.nan),
+                        out)
     if window is not None:
         out = out * window
     return out
