@@ -78,6 +78,19 @@ class DistSegmentProcessor:
         self.watfft_len = self.n_spectrum // self.channel_count
         if self.channel_count % self.n_seq:
             raise ValueError("spectrum_channel_count must divide by seq axis")
+        if self.n_spectrum % self.channel_count:
+            # the single-chip path truncates the spectrum tail to a
+            # whole number of waterfall rows; sharded, that truncation
+            # would straddle a shard boundary (channel rows are
+            # contiguous wlen-blocks of the seq-sharded spectrum), so
+            # non-dividing channel counts must be rejected loudly here
+            # rather than fail as a reshape deep inside shard_map
+            raise ValueError(
+                f"spectrum_channel_count {self.channel_count} must divide "
+                f"the {self.n_spectrum}-channel spectrum for the "
+                "distributed plan (power-of-two counts always do); the "
+                "single-chip pipeline handles non-dividing counts by "
+                "truncation")
 
         f_min, f_c, df = dd.spectrum_frequencies(cfg, self.n_spectrum)
         self.f_min, self.f_c, self.df = f_min, f_c, df

@@ -208,3 +208,19 @@ def test_dist_segment_chirp_on_device_matches_bank(raw_segment):
                                rtol=2e-3, atol=2e-2)
     np.testing.assert_array_equal(np.asarray(res_a.signal_counts),
                                   np.asarray(res_b.signal_counts))
+
+
+def test_dist_rejects_non_dividing_channel_count():
+    """Non-power-of-two channel counts that don't divide the spectrum
+    truncate on the single-chip path but would straddle a shard boundary
+    distributed — the round-3 sweep caught this as a cryptic reshape
+    failure deep inside shard_map; it must be a clear constructor error."""
+    cfg = Config(
+        baseband_input_count=1 << 14, baseband_input_bits=2,
+        baseband_format_type="simple", baseband_freq_low=1405.0,
+        baseband_bandwidth=64.0, baseband_sample_rate=128e6, dm=5.0,
+        spectrum_channel_count=48, signal_detect_max_boxcar_length=8,
+        baseband_reserve_sample=False)
+    mesh = M.make_mesh(n_dm=2, n_seq=2, devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="must divide"):
+        DistSegmentProcessor(cfg, mesh, dm_list=[1.0, 2.0, 3.0, 4.0])
