@@ -250,6 +250,10 @@ class PythonBlockReceiver:
         begin = self._next_counter
         filled = 0
         seen = 0
+        # per-slot fill map: a duplicated counter must not inflate the
+        # fill count, or the block closes early with a silently-zeroed
+        # slot and lost = 0 (found by the round-3 packet-sequence fuzz)
+        slot_filled = bytearray(packets_per_block)
         while True:
             if self._pending is not None:
                 c, pkt = self._pending
@@ -269,7 +273,9 @@ class PythonBlockReceiver:
             out[start:start + payload] = np.frombuffer(
                 pkt, dtype=np.uint8,
                 count=payload, offset=fmt.packet_header_size)
-            filled += 1
+            if not slot_filled[slot]:
+                slot_filled[slot] = 1
+                filled += 1
             seen += 1
             if filled == packets_per_block:
                 break

@@ -23,6 +23,7 @@
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
+#include <vector>
 
 #include <linux/filter.h>
 #include <linux/if_packet.h>
@@ -292,6 +293,10 @@ int32_t srtb_pkt_ring_receive_block(PktRing* r, uint8_t* out,
   }
   uint64_t filled = 0;
   uint64_t seen = 0;
+  // per-slot fill map: a duplicated counter must not inflate the fill
+  // count, or the block closes early with a silently-zeroed slot and
+  // lost = 0 (mirrors the Python provider's fix)
+  std::vector<uint8_t> slot_filled(packets_per_block, 0);
 
   for (;;) {
     const uint8_t* pkt;
@@ -327,7 +332,10 @@ int32_t srtb_pkt_ring_receive_block(PktRing* r, uint8_t* out,
       return 0;
     }
     std::memcpy(out + slot * payload, pkt + r->header_size, payload);
-    filled++;
+    if (!slot_filled[slot]) {
+      slot_filled[slot] = 1;
+      filled++;
+    }
     seen++;
     if (filled == packets_per_block) {
       r->next_counter = begin_counter + packets_per_block;

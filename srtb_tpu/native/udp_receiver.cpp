@@ -155,6 +155,10 @@ int32_t srtb_udp_rx_receive_block(UdpRx* rx, uint8_t* out,
   }
   uint64_t filled = 0;
   uint64_t seen = 0;
+  // per-slot fill map: a duplicated counter must not inflate the fill
+  // count, or the block closes early with a silently-zeroed slot and
+  // lost = 0 (mirrors the Python provider's fix)
+  std::vector<uint8_t> slot_filled(packets_per_block, 0);
 
   while (true) {
     if (rx->batch_pos >= rx->batch_len) {
@@ -183,7 +187,10 @@ int32_t srtb_udp_rx_receive_block(UdpRx* rx, uint8_t* out,
         return 0;
       }
       std::memcpy(out + slot * payload, pkt + rx->header_size, payload);
-      filled++;
+      if (!slot_filled[slot]) {
+        slot_filled[slot] = 1;
+        filled++;
+      }
       seen++;
       if (filled == packets_per_block) {
         rx->batch_pos++;
