@@ -76,6 +76,7 @@ struct PktRing {
   uint32_t pkt_index = 0;      // next frame index within cur_block
   uint32_t num_pkts = 0;       // frames in cur_block (0 = block not open)
   uint8_t* frame = nullptr;    // next frame pointer
+  std::vector<uint8_t> slot_filled;  // per-block fill map (reused)
 
   uint64_t next_counter = 0;
   bool have_counter = false;
@@ -295,8 +296,10 @@ int32_t srtb_pkt_ring_receive_block(PktRing* r, uint8_t* out,
   uint64_t seen = 0;
   // per-slot fill map: a duplicated counter must not inflate the fill
   // count, or the block closes early with a silently-zeroed slot and
-  // lost = 0 (mirrors the Python provider's fix)
-  std::vector<uint8_t> slot_filled(packets_per_block, 0);
+  // lost = 0 (mirrors the Python provider's fix).  Member buffer: no
+  // per-block allocation in the line-rate drain loop
+  r->slot_filled.assign(packets_per_block, 0);
+  std::vector<uint8_t>& slot_filled = r->slot_filled;
 
   for (;;) {
     const uint8_t* pkt;

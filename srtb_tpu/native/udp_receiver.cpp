@@ -57,6 +57,7 @@ struct UdpRx {
 
   // batch state: received but not yet consumed packets
   std::vector<uint8_t> buf;           // kBatch * packet_size
+  std::vector<uint8_t> slot_filled;   // per-block fill map (reused)
   std::vector<mmsghdr> msgs;
   std::vector<iovec> iovs;
   size_t batch_pos = 0;
@@ -157,8 +158,10 @@ int32_t srtb_udp_rx_receive_block(UdpRx* rx, uint8_t* out,
   uint64_t seen = 0;
   // per-slot fill map: a duplicated counter must not inflate the fill
   // count, or the block closes early with a silently-zeroed slot and
-  // lost = 0 (mirrors the Python provider's fix)
-  std::vector<uint8_t> slot_filled(packets_per_block, 0);
+  // lost = 0 (mirrors the Python provider's fix).  Member buffer: no
+  // per-block allocation in the line-rate drain loop
+  rx->slot_filled.assign(packets_per_block, 0);
+  std::vector<uint8_t>& slot_filled = rx->slot_filled;
 
   while (true) {
     if (rx->batch_pos >= rx->batch_len) {

@@ -491,49 +491,43 @@ def test_asyncio_provider_selection_and_refusals():
                                      **fmt_kwargs), use_native=True)
 
 
-def test_block_assembly_duplicate_counter_accounting():
+@pytest.mark.parametrize("impl", ["native", "python"])
+def test_block_assembly_duplicate_counter_accounting(impl):
     """A duplicated packet counter must not inflate the fill count: the
     round-3 fuzz found duplicates closing the block early with a
-    silently-zeroed slot and lost=0.  Now the dup overwrites its slot
-    (idempotent) and the block completes only when every distinct slot
-    fills — and a dup alongside a real gap still reports the loss."""
-    import socket
-    import struct
-    import threading
-    import time
-
-    fmt = formats.resolve("fastmb_roach2")
+    silently-zeroed slot and lost=0 — in all three assemblers.  Now the
+    dup overwrites its slot (idempotent) and the block completes only
+    when every distinct slot fills; a dup alongside a real gap still
+    reports the loss."""
+    if impl == "native" and udp._NATIVE is None:
+        pytest.skip("native lib not built")
+    fmt = formats.FASTMB_ROACH2
     payload = fmt.payload_bytes
+    cls = (udp.NativeBlockReceiver if impl == "native"
+           else udp.PythonBlockReceiver)
+
+    def payload_fn(c):
+        return bytes([c % 251]) * payload
 
     def run_case(counters, port):
-        rx = udp.PythonBlockReceiver("127.0.0.1", port, fmt)
-        tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        tx.connect(("127.0.0.1", port))
-        done = threading.Event()
-
-        def sender():
-            time.sleep(0.05)
-            for c in counters:
-                tx.send(struct.pack("<Q", c) + bytes([c & 0xFF]) * payload)
-                time.sleep(0.001)
-            done.set()
-
-        t = threading.Thread(target=sender, daemon=True)
-        t.start()
+        rx = cls("127.0.0.1", port, fmt)
+        sender = threading.Thread(
+            target=_send_packets,
+            args=(port, fmt, counters, payload_fn, 0.001))
+        sender.start()
         buf = np.zeros(4 * payload, dtype=np.uint8)
         try:
             first, lost, total = rx.receive_block(buf)
         finally:
-            done.wait(timeout=2)
+            sender.join(timeout=5)
             rx.close()
-            tx.close()
-        heads = [int(buf[i * payload]) for i in range(4)]
-        return first, lost, heads
+        return first, lost, [int(buf[i * payload]) for i in range(4)]
 
+    base = 42190 + (0 if impl == "native" else 4)
     # dup only: all four slots fill, no loss, no zeroed slot
-    first, lost, heads = run_case([0, 1, 1, 2, 3], port=42191)
+    first, lost, heads = run_case([0, 1, 1, 2, 3], port=base)
     assert (first, lost, heads) == (0, 0, [0, 1, 2, 3])
     # dup + real gap (slot 2 missing): the loss must be reported
-    first, lost, heads = run_case([0, 1, 1, 3, 4], port=42192)
+    first, lost, heads = run_case([0, 1, 1, 3, 4], port=base + 1)
     assert (first, lost) == (0, 1)
     assert heads == [0, 1, 0, 3]
