@@ -68,8 +68,11 @@ if [ "$QUICK" = "quick" ]; then exit 0; fi
 run n2_30       env SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 SRTB_BENCH_REPS=3 python bench.py
 # classic staged plan with Pallas leg FFTs (VMEM rows instead of XLA's
 # giant batched FFTs) — candidate for the >=2x 2^30 target
+# first run of Pallas legs at this shape: bound it tighter than
+# bench.py's default 3000 s watchdog so a hang can't eat the queue
 run n2_30_pallas_legs env SRTB_STAGED_ROWS_IMPL=pallas SRTB_BENCH_LOG2N=30 \
-    SRTB_BENCH_LOG2CHAN=15 SRTB_BENCH_REPS=3 python bench.py
+    SRTB_BENCH_LOG2CHAN=15 SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=1200 \
+    python bench.py
 # the blocked staged stage_a SIGSEGV probe: bounded, in a subshell so a
 # compiler crash cannot wedge this queue (note the rc either way)
 echo "== staged-blocked 2^30 probe =="
