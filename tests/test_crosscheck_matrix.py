@@ -68,6 +68,11 @@ def _processor(cfg: Config, plan: str) -> SegmentProcessor:
         return SegmentProcessor(cfg, staged=True)
     if plan == "pallas":
         return SegmentProcessor(cfg.replace(use_pallas=True))
+    if plan == "pallas_sk":
+        # fused RFI+chirp front half AND the fused waterfall+SK-stats
+        # epilogue (fft_rows_stats_ri + sk_apply_timeseries)
+        return SegmentProcessor(cfg.replace(use_pallas=True,
+                                            use_pallas_sk=True))
     if plan == "mxu":
         return SegmentProcessor(cfg.replace(fft_strategy="mxu"))
     raise ValueError(plan)
@@ -110,7 +115,7 @@ def test_format_matrix(fmt, nbits, streams, plan):
 @pytest.mark.parametrize("fmt,nbits,streams",
                          [("simple", 2, 1), ("gznupsr_a1", -8, 2)],
                          ids=["simple_2", "gznupsr_a1"])
-@pytest.mark.parametrize("plan", ["pallas", "mxu"])
+@pytest.mark.parametrize("plan", ["pallas", "pallas_sk", "mxu"])
 def test_plan_matrix(fmt, nbits, streams, plan):
     """The alternate compute plans on the flagship sub-byte format and a
     word-interleaved multi-stream format."""
