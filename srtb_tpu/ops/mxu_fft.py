@@ -44,7 +44,16 @@ import numpy as np
 # lengths <= _RADIX with a single DFT-matrix contraction.
 _RADIX = 128
 
-_PRECISION = jax.lax.Precision.HIGHEST
+# 6-pass bf16 by default; SRTB_MXU_PRECISION=high selects 3-pass bf16
+# (pallas_fft runs 3-pass at even longer contractions with ~1e-6
+# relative error on chip) — the accuracy x throughput A/B at this
+# radix is probed on hardware by tools_tpu_r3_queue.sh before any
+# default flip.  Read at trace time.
+def _precision():
+    import os
+    return (jax.lax.Precision.HIGH
+            if os.environ.get("SRTB_MXU_PRECISION", "") == "high"
+            else jax.lax.Precision.HIGHEST)
 
 
 @functools.lru_cache(maxsize=None)
@@ -80,7 +89,7 @@ def _dft_contract(ar: jnp.ndarray, ai: jnp.ndarray, r: int, inverse: bool):
     wr, wi = jnp.asarray(wr_np), jnp.asarray(wi_np)
     # y[..., k, t] = sum_j W[j, k] * a[..., j, t]
     def mm(w, x):
-        return jnp.einsum("jk,...jt->...kt", w, x, precision=_PRECISION)
+        return jnp.einsum("jk,...jt->...kt", w, x, precision=_precision())
     yr = mm(wr, ar) - mm(wi, ai)
     yi = mm(wr, ai) + mm(wi, ar)
     return yr, yi
@@ -95,7 +104,7 @@ def _fft_ri(ar: jnp.ndarray, ai: jnp.ndarray, inverse: bool,
         wr_np, wi_np = _dft_matrix(n, inverse)
         wr, wi = jnp.asarray(wr_np), jnp.asarray(wi_np)
         def mm(x, w):
-            return jnp.einsum("...j,jk->...k", x, w, precision=_PRECISION)
+            return jnp.einsum("...j,jk->...k", x, w, precision=_precision())
         return (mm(ar, wr) - mm(ai, wi), mm(ai, wr) + mm(ar, wi))
     n1 = radix
     n2 = n // n1

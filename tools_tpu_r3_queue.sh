@@ -54,6 +54,36 @@ rc=$?
 line=$(grep '^{' /tmp/planes_probe.json 2>/dev/null | tail -1)
 echo "{\"ts\": \"$(stamp)\", \"variant\": \"planes_unpack_mosaic_probe\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$OUT"
 
+# ---- 1c. MXU DFT precision A/B: 3-pass vs 6-pass bf16 on chip ----
+# accuracy is only provable on real bf16 MXU passes (CPU computes f32
+# exactly); if 'high' holds ~1e-6 while running ~2x, flip the default
+echo "== mxu precision probe =="
+( timeout 600 python - <<'PYEOF'
+import json, os, time
+import numpy as np, jax, jax.numpy as jnp
+from srtb_tpu.ops.mxu_fft import mxu_fft
+n = 1 << 22
+rng = np.random.default_rng(0)
+x = (rng.standard_normal(n) + 1j * rng.standard_normal(n)).astype(np.complex64)
+want = np.fft.fft(x.astype(np.complex128))
+for prec in ("highest", "high"):
+    os.environ["SRTB_MXU_PRECISION"] = prec
+    f = jax.jit(lambda v: mxu_fft(v))
+    y = f(jnp.asarray(x))
+    re, im = np.asarray(jnp.real(y)), np.asarray(jnp.imag(y))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        y = f(jnp.asarray(x))
+    np.asarray(jnp.real(y)[:8])
+    dt = (time.perf_counter() - t0) / 5
+    err = np.abs((re + 1j * im) - want).max() / np.abs(want).max()
+    print(json.dumps({"probe": "mxu_precision", "prec": prec,
+                      "rel_err": float(err), "ms": round(dt * 1e3, 2)}))
+PYEOF
+) | while read -r line; do
+      case "$line" in {*) echo "{\"ts\": \"$(stamp)\", \"variant\": \"mxu_precision_probe\", \"result\": $line}" >> "$OUT"; echo "$line";; esac
+    done
+
 # ---- 2. per-kernel rows incl. the anchored-vs-exact chirp A/B ----
 echo "== kernel bench (anchored chirp A/B) =="
 python -m srtb_tpu.tools.kernel_bench --log2n 28 --reps 5 2>/dev/null \
