@@ -29,7 +29,7 @@ QUICK=${1:-}
 note "r3 queue start: anchored chirp A/B, pallas A/Bs, 2^30 rebench, e2e live, compile cache"
 
 # ---- 1. headline + the round-2 pending A/Bs (VERDICT weak #4) ----
-run baseline    python bench.py
+run baseline    env SRTB_BENCH_TRACE_DIR=/tmp/r3_trace_baseline python bench.py
 run pallas      env SRTB_BENCH_USE_PALLAS=1 python bench.py
 run pallas_sk   env SRTB_BENCH_USE_PALLAS=1 SRTB_BENCH_USE_PALLAS_SK=1 python bench.py
 run pallas_fs   env SRTB_BENCH_FFT_STRATEGY=pallas python bench.py
