@@ -8,7 +8,7 @@
 #
 # "quick" skips the long blocks (2^30, e2e 60s, compile-cache proof).
 set -u
-OUT=PERF_TPU.jsonl
+OUT=${SRTB_PERF_OUT:-PERF_TPU.jsonl}
 stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
 note() { echo "{\"ts\": \"$(stamp)\", \"variant\": \"note\", \"note\": \"$1\"}" >> "$OUT"; }
 run() {
@@ -60,6 +60,8 @@ echo "{\"ts\": \"$(stamp)\", \"variant\": \"planes_unpack_mosaic_probe\", \"rc\"
 echo "== mxu precision probe =="
 ( timeout 600 python - <<'PYEOF'
 import json, os, time
+from srtb_tpu.utils.platform import apply_platform_env
+apply_platform_env()
 import numpy as np, jax, jax.numpy as jnp
 from srtb_tpu.ops.mxu_fft import mxu_fft
 n = 1 << 22
