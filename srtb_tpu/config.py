@@ -104,7 +104,8 @@ class Config:
     # persistent XLA compile cache dir; the FFTW-wisdom analog
     # ("" = default ~/.cache location, "off" = disabled)
     fft_fftw_wisdom_path: str = ""
-    # segment R2C strategy: auto | monolithic | four_step | mxu | pallas
+    # segment R2C strategy:
+    # auto | monolithic | four_step | mxu | pallas | pallas2
     fft_strategy: str = "auto"
     # use Pallas fused kernels where available (fused RFI-s1 + df64
     # chirp-multiply, VMEM row-FFT waterfall C2C)

@@ -403,9 +403,23 @@ def rfft_subbyte(data: jnp.ndarray, nbits: int, strategy: str = "four_step",
         a = jnp.fft.fft(z, axis=-1)  # one batched XLA FFT over the planes
     elif strategy in ("pallas", "pallas_interpret"):
         a = _fft_minor(z, inverse=False, rows_impl=strategy)
+    elif strategy in ("pallas2", "pallas2_interpret"):
+        a = _pallas2_or_fallback(z, strategy)
     else:
         a = _fft_minor(z, inverse=False)
     return finish_rfft_subbyte(a, drop_nyquist)
+
+
+def _pallas2_or_fallback(z: jnp.ndarray, strategy: str) -> jnp.ndarray:
+    """The fused two-pass Pallas C2C (ops/pallas_fft2) on [..., L] complex
+    z, falling back to the four-step-with-Pallas-legs form for lengths
+    outside its [2^24, 2^29] window (tiny test configs)."""
+    from srtb_tpu.ops import pallas_fft2 as pf2
+    interp = strategy.endswith("interpret")
+    if pf2.supported(z.shape[-1]):
+        return pf2.fft2_c2c(z, inverse=False, interpret=interp)
+    return _fft_minor(z, inverse=False,
+                      rows_impl="pallas_interpret" if interp else "pallas")
 
 
 def subbyte_planes_to_packed(planes: jnp.ndarray) -> jnp.ndarray:
@@ -470,9 +484,16 @@ def segment_rfft(x: jnp.ndarray, strategy: str = "auto") -> jnp.ndarray:
       the monolithic XLA R2C at the 2^27 bench size on a v5e;
     - "pallas" ("pallas_interpret" off-TPU): the four-step decomposition
       with its batched row FFTs executed by the VMEM Pallas kernel
-      (ops/pallas_fft) — one HBM read+write per point per leg.
+      (ops/pallas_fft) — one HBM read+write per point per leg;
+    - "pallas2" ("pallas2_interpret" off-TPU): the fused two-pass
+      four-step (ops/pallas_fft2) — transposes and twiddles absorbed
+      into the two leg kernels, two HBM round trips for the whole C2C
+      and no XLA FFT op anywhere.
     """
     strategy = resolve_strategy(x.shape[-1], strategy)
+    if strategy in ("pallas2", "pallas2_interpret"):
+        zf = _pallas2_or_fallback(pack_even_odd(x), strategy)
+        return hermitian_rfft_post(zf, drop_nyquist=True)
     if strategy in ("pallas", "pallas_interpret"):
         z = pack_even_odd(x)
         zf = four_step_fft(z, rows_impl=strategy)

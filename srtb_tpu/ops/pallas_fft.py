@@ -77,41 +77,50 @@ def supported(length: int, batch: int) -> bool:
     return _split_la_lb(length) is not None and batch >= 1
 
 
-def _fft_rows_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref, wbi_ref,
-                     twr_ref, twi_ref, out_re_ref, out_im_ref, *,
-                     la, lb, rows):
+def vmem_fft_rows(xr, xi, war, wai, wbr, wbi, twr, twi, *, la, lb, rows):
+    """The in-VMEM two-level row FFT on value arrays: [rows, L] f32
+    (re, im) -> length-L C2C along each row in natural order, L = la*lb.
+    Pure function of VMEM-resident values — shared by the kernels here
+    and by the fused two-pass four-step in ops/pallas_fft2."""
     def mm(a, b):
         return jax.lax.dot(a, b, precision=_PRECISION,
                            preferred_element_type=jnp.float32)
 
     # [rows, L] -> [La, rows*Lb]  (j1 major for the level-1 contraction)
-    def to_stage1(ref):
-        x = ref[:].reshape(rows, la, lb)
+    def to_stage1(x):
+        x = x.reshape(rows, la, lb)
         return jnp.transpose(x, (1, 0, 2)).reshape(la, rows * lb)
 
-    xr, xi = to_stage1(re_ref), to_stage1(im_ref)
-    war, wai = war_ref[:], wai_ref[:]
+    xr, xi = to_stage1(xr), to_stage1(xi)
     # A[k1, (r, j2)] = sum_j1 Wa[j1, k1] x[j1, (r, j2)]
     ar = mm(war.T, xr) - mm(wai.T, xi)
     ai = mm(war.T, xi) + mm(wai.T, xr)
     # twiddle w[k1, j2], broadcast over rows
     a3r = ar.reshape(la, rows, lb)
     a3i = ai.reshape(la, rows, lb)
-    twr = twr_ref[:].reshape(la, 1, lb)
-    twi = twi_ref[:].reshape(la, 1, lb)
+    twr = twr.reshape(la, 1, lb)
+    twi = twi.reshape(la, 1, lb)
     br = a3r * twr - a3i * twi
     bi = a3r * twi + a3i * twr
     # B[(k1, r), k2] = sum_j2 A[(k1, r), j2] Wb[j2, k2]
     b2r = br.reshape(la * rows, lb)
     b2i = bi.reshape(la * rows, lb)
-    wbr, wbi = wbr_ref[:], wbi_ref[:]
     cr = mm(b2r, wbr) - mm(b2i, wbi)
     ci = mm(b2r, wbi) + mm(b2i, wbr)
     # natural order: X[k2*La + k1] -> [rows, Lb(k2), La(k1)] -> [rows, L]
     c3r = cr.reshape(la, rows, lb)
     c3i = ci.reshape(la, rows, lb)
-    out_re_ref[:] = jnp.transpose(c3r, (1, 2, 0)).reshape(rows, la * lb)
-    out_im_ref[:] = jnp.transpose(c3i, (1, 2, 0)).reshape(rows, la * lb)
+    yr = jnp.transpose(c3r, (1, 2, 0)).reshape(rows, la * lb)
+    yi = jnp.transpose(c3i, (1, 2, 0)).reshape(rows, la * lb)
+    return yr, yi
+
+
+def _fft_rows_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref, wbi_ref,
+                     twr_ref, twi_ref, out_re_ref, out_im_ref, *,
+                     la, lb, rows):
+    out_re_ref[:], out_im_ref[:] = vmem_fft_rows(
+        re_ref[:], im_ref[:], war_ref[:], wai_ref[:], wbr_ref[:],
+        wbi_ref[:], twr_ref[:], twi_ref[:], la=la, lb=lb, rows=rows)
 
 
 def _fft_rows_stats_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref,
@@ -168,6 +177,32 @@ def _dft_matrix_np(r: int, inverse: bool):
             np.ascontiguousarray(w.imag.astype(np.float32)))
 
 
+def leg_consts(length: int, inverse: bool):
+    """(la, lb, const arrays) for a two-level in-VMEM row FFT of this
+    length — the DFT matrices and inner twiddle every kernel using
+    :func:`vmem_fft_rows` must pass in.  Single home (with
+    :func:`leg_const_specs`) so _Launch and ops/pallas_fft2 can never
+    drift apart on split bounds, precision, or twiddle discipline."""
+    split = _split_la_lb(length)
+    if split is None:
+        raise ValueError(f"row-FFT length {length} unsupported")
+    la, lb = split
+    war, wai = _dft_matrix_np(la, inverse)
+    wbr, wbi = _dft_matrix_np(lb, inverse)
+    # tw[k1, j2] = exp(+-2*pi*i*k1*j2/L): exact integer residues
+    # through the hi/lo phase split (ops.fft._twiddle discipline)
+    tw = F._twiddle(la, lb, inverse)
+    return la, lb, (jnp.asarray(war), jnp.asarray(wai),
+                    jnp.asarray(wbr), jnp.asarray(wbi),
+                    jnp.real(tw), jnp.imag(tw))
+
+
+def leg_const_specs(la: int, lb: int):
+    """BlockSpecs matching :func:`leg_consts`'s arrays, in order."""
+    return [_Launch.const_spec(s) for s in
+            [(la, la), (la, la), (lb, lb), (lb, lb), (la, lb), (la, lb)]]
+
+
 class _Launch:
     """Shared launch recipe for the row-FFT kernels: shape checks, the
     La/Lb split, VMEM block sizing, and the DFT/twiddle constants — one
@@ -191,22 +226,8 @@ class _Launch:
         self.block = pl.BlockSpec((self.rows, self.length),
                                   lambda i: (i, 0),
                                   memory_space=pltpu.VMEM)
-        war, wai = _dft_matrix_np(self.la, inverse)
-        wbr, wbi = _dft_matrix_np(self.lb, inverse)
-        # tw[k1, j2] = exp(+-2*pi*i*k1*j2/L): exact integer residues
-        # through the hi/lo phase split (ops.fft._twiddle discipline)
-        tw = F._twiddle(self.la, self.lb, inverse)
-        self.consts = (jnp.asarray(war), jnp.asarray(wai),
-                       jnp.asarray(wbr), jnp.asarray(wbi),
-                       jnp.real(tw), jnp.imag(tw))
-        self.const_specs = [
-            self.const_spec((self.la, self.la)),
-            self.const_spec((self.la, self.la)),
-            self.const_spec((self.lb, self.lb)),
-            self.const_spec((self.lb, self.lb)),
-            self.const_spec((self.la, self.lb)),
-            self.const_spec((self.la, self.lb)),
-        ]
+        _, _, self.consts = leg_consts(self.length, inverse)
+        self.const_specs = leg_const_specs(self.la, self.lb)
 
     @staticmethod
     def const_spec(shp):

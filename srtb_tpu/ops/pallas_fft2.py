@@ -1,0 +1,294 @@
+"""Fused two-pass Pallas four-step C2C: the whole large-m transform in
+two kernel passes plus one fusable transpose.
+
+The existing "pallas" strategy runs the four-step legs (ops/pallas_fft)
+inside XLA's decomposition: transpose, leg FFT, twiddle multiply,
+transpose, leg FFT, transpose — each arrow a full HBM pass, ~6 round
+trips for the C2C (measured 1481 vs monolithic's 1746 Msamples/s at
+2^27, PERF_TPU.jsonl).  This module fuses each leg's surrounding
+layout work *into the leg's kernel* so the C2C is two passes total:
+
+  pass 1 (grid over j2 column blocks of z viewed [n1, n2] row-major):
+    DMA a strided [n1, bb] column block into VMEM, transpose in-VMEM to
+    [bb, n1] rows, run the two-level DFT-matmul row FFT over j1
+    (ops/pallas_fft.vmem_fft_rows), apply the four-step twiddle
+    w[k1, j2] = exp(s*2*pi*i*k1*j2/m) computed *in-kernel* from iota
+    with the exact hi/lo phase split (no m-sized table exists anywhere),
+    transpose back and DMA out: intermediate B[k1, j2] laid out [n1, n2].
+
+  pass 2 (grid over k1 row blocks):
+    DMA a contiguous [rb, n2] row block, run the row FFT over j2, store
+    C[k1, k2] row-major.  The k1-major blocked order is deliberate: a
+    natural-order [n2, rb] output block would lane-pad rb -> 128 in
+    VMEM (8-32 MB/plane at production n2), so the blocked->natural
+    permutation is instead an XLA transpose (``unblock``) that fuses
+    into the consumer's next pass — the Hermitian post-process here.
+
+Two kernel passes plus one fusable transpose, versus ~6 separate HBM
+round trips for the XLA-orchestrated form.
+
+No XLA FFT op appears anywhere in this path — which also makes it a
+workaround candidate for the XLA TPU compiler SIGSEGV on the 2^30
+staged blocked shape (PERF.md).  Like every FFT backend here it is
+unnormalized in both directions and held to the same float64 oracle
+tests (tests/test_pallas_fft2.py); the TPU answer to the reference's
+single-call vendor FFTs for full segments (ref: fft/fft.hpp:54-160,
+fft_pipe.hpp:44-78).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from srtb_tpu.ops import fft as F
+from srtb_tpu.ops import pallas_fft as PF
+
+
+def _factor(m: int):
+    """m = n1 * n2 with n1 the resident-column length (the whole n1 axis
+    of a [n1, bb] block must fit VMEM, so n1 stays small) and n2 a row
+    length the two-level kernel handles.  Both need la=128 splits with
+    lb >= 32 to bound sublane padding, hence n1 in {4096, 8192} and
+    n2 in [4096, 65536]: m in [2^24, 2^29] — exactly the segment sizes
+    where monolithic XLA falters (PERF.md)."""
+    if m & (m - 1):
+        return None
+    for n1 in (4096, 8192):
+        n2 = m // n1
+        if m % n1 == 0 and 4096 <= n2 <= 65536:
+            return n1, n2
+    return None
+
+
+def supported(m: int) -> bool:
+    return _factor(m) is not None
+
+
+def _rows_budget(length: int) -> int:
+    """Rows per grid step for an in-VMEM leg FFT of this length, sized
+    from the *padded* dominant intermediate: vmem_fft_rows materializes
+    [la, rows, lb] stage arrays whose minor dim lane-pads to >= 128, so
+    the footprint is la*rows*max(lb, 128)*4 B per f32 plane — hold that
+    to ~1 MB (several such arrays + in/out blocks + consts must coexist
+    in ~16 MB of VMEM)."""
+    la, lb = PF._split_la_lb(length)
+    return max(8, min(128, (1 << 18) // (la * max(lb, 128))))
+
+
+def _block_cols(n1: int) -> int:
+    """Pass-1 column-block width (= rows of the in-kernel leg FFT);
+    overridable for hardware tuning."""
+    env = os.environ.get("SRTB_PALLAS2_BB")
+    if env:
+        return int(env)
+    return _rows_budget(n1)
+
+
+def _block_rows(n2: int) -> int:
+    """Pass-2 row-block height, same budget."""
+    env = os.environ.get("SRTB_PALLAS2_RB")
+    if env:
+        return int(env)
+    return _rows_budget(n2)
+
+
+def _fourstep_twiddle(rows_j2, n1: int, m: int, sign: float, j2_0):
+    """w[d, k1] = exp(sign*2*pi*i*(j2_0 + d)*k1/m) for d < rows_j2,
+    k1 < n1, computed in-kernel from iota.  j2*k1 < m <= 2^29 is exact
+    in int32; the residue is split hi/lo so each cos/sin argument is
+    f32-exact (the ops.fft._phase_exp discipline, in-register)."""
+    d = jax.lax.broadcasted_iota(jnp.int32, (rows_j2, n1), 0) + j2_0
+    k1 = jax.lax.broadcasted_iota(jnp.int32, (rows_j2, n1), 1)
+    r = d * k1
+    half = 1 << 15
+    scale = jnp.float32(sign * 2.0 * np.pi / m)
+    a = (r // half).astype(jnp.float32) * (half * scale)
+    b = (r % half).astype(jnp.float32) * scale
+    ca, sa = jnp.cos(a), jnp.sin(a)
+    cb, sb = jnp.cos(b), jnp.sin(b)
+    return ca * cb - sa * sb, sa * cb + ca * sb
+
+
+def _pass1_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref, wbi_ref,
+                  twr_ref, twi_ref, out_re_ref, out_im_ref, *,
+                  n1, bb, la, lb, m, sign):
+    from jax.experimental import pallas as pl
+
+    # strided [n1(j1), bb(j2)] column block -> [bb, n1] rows (j2-major)
+    xr = re_ref[:].T
+    xi = im_ref[:].T
+    yr, yi = PF.vmem_fft_rows(xr, xi, war_ref[:], wai_ref[:], wbr_ref[:],
+                              wbi_ref[:], twr_ref[:], twi_ref[:],
+                              la=la, lb=lb, rows=bb)   # A[j2, k1]
+    wr, wi = _fourstep_twiddle(bb, n1, m, sign, pl.program_id(0) * bb)
+    zr = yr * wr - yi * wi
+    zi = yr * wi + yi * wr
+    # back to [n1(k1), bb(j2)] for the strided column-block write
+    out_re_ref[:] = zr.T
+    out_im_ref[:] = zi.T
+
+
+def _pass2_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref, wbi_ref,
+                  twr_ref, twi_ref, out_re_ref, out_im_ref, *,
+                  n2, rb, la, lb):
+    # output stays [rb, n2] = C[k1, k2] k1-major blocked: a natural-order
+    # [n2, rb] column block would lane-pad rb -> 128 in VMEM (8-32 MB per
+    # plane at production n2) — callers restore order with unblock(), an
+    # XLA transpose the next elementwise pass absorbs
+    yr, yi = PF.vmem_fft_rows(re_ref[:], im_ref[:], war_ref[:], wai_ref[:],
+                              wbr_ref[:], wbi_ref[:], twr_ref[:],
+                              twi_ref[:], la=la, lb=lb, rows=rb)
+    out_re_ref[:] = yr
+    out_im_ref[:] = yi
+
+
+
+
+def pass1_2d(re2, im2, inverse: bool = False, interpret: bool = False):
+    """Fused pass 1 on one [n1, n2]-viewed transform: column FFTs over
+    j1 + four-step twiddle, intermediate B[k1, j2] as an [n1, n2] f32
+    pair.  Split out so the staged 2^30 plan can run each pass as its
+    own XLA program (pipeline/segment.py)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n1, n2 = re2.shape
+    m = n1 * n2
+    sign = 1.0 if inverse else -1.0
+    bb = _block_cols(n1)
+    if n2 % bb:
+        raise ValueError(f"pass-1 block {bb} must divide n2={n2}")
+    la1, lb1, consts1 = PF.leg_consts(n1, inverse)
+    col_block = pl.BlockSpec((n1, bb), lambda i: (0, i),
+                             memory_space=pltpu.VMEM)
+    k1 = functools.partial(_pass1_kernel, n1=n1, bb=bb, la=la1, lb=lb1,
+                           m=m, sign=sign)
+    mid_shape = jax.ShapeDtypeStruct((n1, n2), jnp.float32)
+    return pl.pallas_call(
+        k1,
+        grid=(n2 // bb,),
+        in_specs=[col_block, col_block] + PF.leg_const_specs(la1, lb1),
+        out_specs=[col_block, col_block],
+        out_shape=[mid_shape, mid_shape],
+        interpret=interpret,
+    )(re2, im2, *consts1)
+
+
+def pass2_2d(br, bi, inverse: bool = False, interpret: bool = False):
+    """Fused pass 2 on the [n1, n2] intermediate: row FFTs over j2.
+    Output is [n1, n2] k1-major blocked (C[k1, k2]; the true transform
+    index is k2*n1 + k1) — callers restore natural order with
+    :func:`unblock`, whose XLA transpose fuses into their next
+    elementwise pass."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n1, n2 = br.shape
+    rb = _block_rows(n2)
+    if n1 % rb:
+        raise ValueError(f"pass-2 block {rb} must divide n1={n1}")
+    la2, lb2, consts2 = PF.leg_consts(n2, inverse)
+    row_block = pl.BlockSpec((rb, n2), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    k2 = functools.partial(_pass2_kernel, n2=n2, rb=rb, la=la2, lb=lb2)
+    out_shape = jax.ShapeDtypeStruct((n1, n2), jnp.float32)
+    return pl.pallas_call(
+        k2,
+        grid=(n1 // rb,),
+        in_specs=[row_block, row_block] + PF.leg_const_specs(la2, lb2),
+        out_specs=[row_block, row_block],
+        out_shape=[out_shape, out_shape],
+        interpret=interpret,
+    )(br, bi, *consts2)
+
+
+def _fft2_2d(re2, im2, n1, n2, inverse, natural, interpret):
+    """The two fused passes on one [n1, n2]-viewed transform; with
+    ``natural`` the blocked result is unblocked by an XLA transpose
+    (fused into the caller's consumer pass)."""
+    br, bi = pass1_2d(re2, im2, inverse, interpret)
+    yr, yi = pass2_2d(br, bi, inverse, interpret)
+    if natural:
+        return yr.T, yi.T
+    return yr, yi
+
+
+def pass1_ri(re: jnp.ndarray, im: jnp.ndarray, inverse: bool = False,
+             interpret: bool = False):
+    """Batched pass 1: [..., m] f32 pair -> [..., n1, n2] intermediate
+    pair (the staged plan's (a)/(b) boundary representation)."""
+    m = re.shape[-1]
+    n1, n2 = _factor(m)
+    lead = re.shape[:-1]
+    re2 = re.reshape(-1, m)
+    im2 = im.reshape(-1, m)
+    outs = [pass1_2d(re2[b].reshape(n1, n2), im2[b].reshape(n1, n2),
+                     inverse, interpret) for b in range(re2.shape[0])]
+    br = jnp.stack([o[0] for o in outs]).reshape(*lead, n1, n2)
+    bi = jnp.stack([o[1] for o in outs]).reshape(*lead, n1, n2)
+    return br, bi
+
+
+def pass2_ri(br: jnp.ndarray, bi: jnp.ndarray, inverse: bool = False,
+             interpret: bool = False):
+    """Batched pass 2: [..., n1, n2] intermediate pair -> [..., m]
+    natural-order f32 pair."""
+    n1, n2 = br.shape[-2], br.shape[-1]
+    m = n1 * n2
+    lead = br.shape[:-2]
+    br2 = br.reshape(-1, n1, n2)
+    bi2 = bi.reshape(-1, n1, n2)
+    outs = [pass2_2d(br2[b], bi2[b], inverse, interpret)
+            for b in range(br2.shape[0])]
+    # unblock: C[k1, k2] -> natural k2*n1 + k1 (XLA transpose, fused
+    # into the Hermitian post-process that consumes this)
+    yr = jnp.stack([o[0].T.reshape(m) for o in outs]).reshape(*lead, m)
+    yi = jnp.stack([o[1].T.reshape(m) for o in outs]).reshape(*lead, m)
+    return yr, yi
+
+
+def fft2_c2c_ri(re: jnp.ndarray, im: jnp.ndarray, inverse: bool = False,
+                natural: bool = True, interpret: bool = False):
+    """C2C FFT along the last axis of split re/im f32 [..., m] arrays in
+    two fused Pallas passes.  Unnormalized both directions (ops.fft
+    conventions).  ``natural=False`` returns the result in [n1, n2]
+    k1-major blocked order (flatten index k1*n2 + k2; true index is
+    k2*n1 + k1) for consumers that absorb the permutation — use
+    :func:`unblock` to restore natural order.
+    """
+    m = re.shape[-1]
+    fac = _factor(m)
+    if fac is None:
+        raise ValueError(f"pallas2 unsupported length {m}")
+    n1, n2 = fac
+    lead = re.shape[:-1]
+    re2 = re.reshape(-1, m)
+    im2 = im.reshape(-1, m)
+    outs = [_fft2_2d(re2[b].reshape(n1, n2), im2[b].reshape(n1, n2),
+                     n1, n2, inverse, natural, interpret)
+            for b in range(re2.shape[0])]
+    yr = jnp.stack([o[0].reshape(m) for o in outs])
+    yi = jnp.stack([o[1].reshape(m) for o in outs])
+    return yr.reshape(*lead, m), yi.reshape(*lead, m)
+
+
+def fft2_c2c(x: jnp.ndarray, inverse: bool = False, natural: bool = True,
+             interpret: bool = False) -> jnp.ndarray:
+    """Complex convenience wrapper over :func:`fft2_c2c_ri`."""
+    yr, yi = fft2_c2c_ri(jnp.real(x), jnp.imag(x), inverse, natural,
+                         interpret)
+    return jax.lax.complex(yr, yi)
+
+
+def unblock(y: jnp.ndarray, m: int) -> jnp.ndarray:
+    """[..., m] in k1-major blocked order (from ``natural=False``) ->
+    natural order, as an XLA transpose the consumer's next elementwise
+    pass can fuse with."""
+    n1, n2 = _factor(m)
+    y2 = y.reshape(*y.shape[:-1], n1, n2)
+    return jnp.swapaxes(y2, -1, -2).reshape(*y.shape[:-1], m)

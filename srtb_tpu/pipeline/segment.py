@@ -184,10 +184,12 @@ class SegmentProcessor:
         a typo in SRTB_STAGED_ROWS_IMPL must not silently fall back to
         XLA while the probe log claims a Pallas result."""
         if impl not in ("xla", "four_step", "mxu", "monolithic", "auto",
-                        "pallas", "pallas_interpret"):
+                        "pallas", "pallas_interpret",
+                        "pallas2", "pallas2_interpret"):
             raise ValueError(f"unknown rows impl / fft strategy {impl!r}")
-        if impl == "pallas" and getattr(self, "_pallas_interpret", False):
-            return "pallas_interpret"
+        if impl in ("pallas", "pallas2") \
+                and getattr(self, "_pallas_interpret", False):
+            return impl + "_interpret"
         return impl
 
     def _process(self, raw: jnp.ndarray, chirp_ri: jnp.ndarray):
@@ -195,7 +197,9 @@ class SegmentProcessor:
             F.resolve_strategy(self.n, self.cfg.fft_strategy))
         if self._blocked_subbyte and strategy in ("four_step", "mxu",
                                                   "pallas",
-                                                  "pallas_interpret"):
+                                                  "pallas_interpret",
+                                                  "pallas2",
+                                                  "pallas2_interpret"):
             from srtb_tpu.ops import pallas_kernels as pk
             interp = getattr(self, "_pallas_interpret", False)
             planes = None
@@ -238,33 +242,62 @@ class SegmentProcessor:
         return self._resolve_rows_impl(
             os.environ.get("SRTB_STAGED_ROWS_IMPL", "xla"))
 
-    def _stage_a(self, raw: jnp.ndarray):
-        """unpack + even/odd pack + four-step first half."""
-        rows_impl = self._staged_rows_impl
+    def _staged_impl(self) -> str:
+        """The staged plan's leg implementation after the pallas2 window
+        check: the fused two-pass form only covers leg lengths in
+        [2^24, 2^29], so tiny forced-staged test configs downgrade to
+        the pallas-legs four-step (same numeric contract)."""
+        impl = self._staged_rows_impl
+        if impl in ("pallas2", "pallas2_interpret"):
+            from srtb_tpu.ops import pallas_fft2 as pf2
+            count = (8 // self.cfg.baseband_input_bits
+                     if self._staged_blocked else 2)
+            if not pf2.supported(self.n // count):
+                return ("pallas_interpret" if impl.endswith("interpret")
+                        else "pallas")
+        return impl
+
+    def _staged_pack(self, raw: jnp.ndarray) -> jnp.ndarray:
+        """unpack + pack for the staged plan: blocked field-plane pairs
+        [S, p, M] (sub-byte, lane-dense by construction) or even/odd
+        packed [S, m]."""
         if self._staged_blocked:
             planes = U.unpack_subbyte_planes(
                 raw, self.cfg.baseband_input_bits)
             if self.window_planes is not None:
                 planes = planes * self.window_planes
-            a = F.four_step_stage1(F.subbyte_planes_to_packed(planes),
-                                   rows_impl=rows_impl)
-        else:
-            x = self._unpack(raw)
-            a = F.four_step_stage1(F.pack_even_odd(x),
-                                   rows_impl=rows_impl)  # [S, n2, n1]
+            return F.subbyte_planes_to_packed(planes)[None]
+        return F.pack_even_odd(self._unpack(raw))
+
+    def _stage_a(self, raw: jnp.ndarray):
+        """unpack + even/odd pack + segment-FFT first half."""
+        impl = self._staged_impl()
+        z = self._staged_pack(raw)
+        if impl in ("pallas2", "pallas2_interpret"):
+            # fused pass 1: transpose + leg FFT + four-step twiddle in
+            # ONE kernel; boundary is the [.., n1, n2] intermediate
+            from srtb_tpu.ops import pallas_fft2 as pf2
+            br, bi = pf2.pass1_ri(jnp.real(z), jnp.imag(z),
+                                  interpret=impl.endswith("interpret"))
+            return jnp.stack([br, bi])
+        a = F.four_step_stage1(z, rows_impl=impl)  # [..., n2, n1]
         return jnp.stack([jnp.real(a), jnp.imag(a)])
 
     def _stage_b(self, a_ri: jnp.ndarray):
-        """four-step second half + Hermitian post -> spectrum [S, n/2]."""
-        a = jax.lax.complex(a_ri[0], a_ri[1])
-        rows_impl = self._staged_rows_impl
-        if self._staged_blocked:
-            spec = F.finish_rfft_subbyte(
-                F.four_step_stage2(a, rows_impl=rows_impl))[None, :]
+        """segment-FFT second half + Hermitian post -> spectrum [S, n/2]."""
+        impl = self._staged_impl()
+        if impl in ("pallas2", "pallas2_interpret"):
+            from srtb_tpu.ops import pallas_fft2 as pf2
+            yr, yi = pf2.pass2_ri(a_ri[0], a_ri[1],
+                                  interpret=impl.endswith("interpret"))
+            zf = jax.lax.complex(yr, yi)
         else:
-            spec = F.hermitian_rfft_post(
-                F.four_step_stage2(a, rows_impl=rows_impl),
-                drop_nyquist=True)
+            zf = F.four_step_stage2(jax.lax.complex(a_ri[0], a_ri[1]),
+                                    rows_impl=impl)
+        if self._staged_blocked:
+            spec = F.finish_rfft_subbyte(zf[0])[None, :]
+        else:
+            spec = F.hermitian_rfft_post(zf, drop_nyquist=True)
         return jnp.stack([jnp.real(spec), jnp.imag(spec)])
 
     def _stage_c(self, spec_ri: jnp.ndarray):

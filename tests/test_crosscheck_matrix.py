@@ -38,7 +38,7 @@ FORMATS = [
     ("gznupsr_a1_v1", -8, 4),
 ]
 
-PLANS = ["fused", "staged", "pallas", "mxu"]
+PLANS = ["fused", "staged", "pallas", "pallas_sk", "mxu", "pallas2"]
 
 N = 1 << 14
 
@@ -75,6 +75,11 @@ def _processor(cfg: Config, plan: str) -> SegmentProcessor:
                                             use_pallas_sk=True))
     if plan == "mxu":
         return SegmentProcessor(cfg.replace(fft_strategy="mxu"))
+    if plan == "pallas2":
+        # at this N the strategy takes its documented fallback (pallas
+        # legs); the [2^24, 2^29] window itself is oracle-checked in
+        # test_pallas_fft2 — this row pins the in-pipeline plumbing
+        return SegmentProcessor(cfg.replace(fft_strategy="pallas2"))
     raise ValueError(plan)
 
 
@@ -115,7 +120,8 @@ def test_format_matrix(fmt, nbits, streams, plan):
 @pytest.mark.parametrize("fmt,nbits,streams",
                          [("simple", 2, 1), ("gznupsr_a1", -8, 2)],
                          ids=["simple_2", "gznupsr_a1"])
-@pytest.mark.parametrize("plan", ["pallas", "pallas_sk", "mxu"])
+@pytest.mark.parametrize("plan", ["pallas", "pallas_sk", "mxu",
+                                  "pallas2"])
 def test_plan_matrix(fmt, nbits, streams, plan):
     """The alternate compute plans on the flagship sub-byte format and a
     word-interleaved multi-stream format."""

@@ -1,0 +1,105 @@
+"""Fused two-pass Pallas four-step C2C (ops/pallas_fft2) vs numpy.
+
+CPU CI runs interpret mode at the smallest supported size (m = 2^24 —
+the module deliberately only covers the segment sizes where monolithic
+XLA falters); SRTB_TEST_TPU=1 lowers the same cases through Mosaic.
+The tolerance is looser than the single-level row kernel's: the value
+passes through four bf16x3 DFT-matmul levels plus two twiddle stages.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from srtb_tpu.ops import fft as F
+from srtb_tpu.ops import pallas_fft2 as PF2
+
+ON_TPU = jax.default_backend() in ("tpu", "axon")
+INTERPRET = not ON_TPU
+
+M = 1 << 24  # smallest pallas2 size (n1=4096, n2=4096)
+
+
+def _rand_c64(shape, seed):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(shape)
+            + 1j * rng.standard_normal(shape)).astype(np.complex64)
+
+
+def test_factorization_window():
+    assert PF2._factor(M) == (4096, 4096)
+    assert PF2._factor(1 << 26) == (4096, 1 << 14)
+    assert PF2._factor(1 << 29) == (8192, 1 << 16)
+    assert not PF2.supported(1 << 23)   # below the window
+    assert not PF2.supported(1 << 30)   # above the window
+    assert not PF2.supported(3 * (1 << 22))  # not a power of two
+
+
+@pytest.mark.parametrize("inverse", [False, True])
+def test_fft2_matches_numpy(inverse):
+    x = _rand_c64(M, 7 + inverse)
+    want = (np.fft.ifft(x.astype(np.complex128), norm="forward") if inverse
+            else np.fft.fft(x.astype(np.complex128)))
+    got = np.asarray(PF2.fft2_c2c(jnp.asarray(x), inverse=inverse,
+                                  interpret=INTERPRET))
+    scale = np.abs(want).max()
+    assert np.abs(got - want).max() / scale < 2e-5
+
+
+def test_fft2_blocked_output_unblocks():
+    x = _rand_c64(M, 3)
+    want = np.fft.fft(x.astype(np.complex128))
+    raw = PF2.fft2_c2c(jnp.asarray(x), natural=False, interpret=INTERPRET)
+    got = np.asarray(PF2.unblock(raw, M))
+    assert np.abs(got - want).max() / np.abs(want).max() < 2e-5
+
+
+def test_fft2_leading_dims():
+    x = _rand_c64((2, M), 5)
+    want = np.fft.fft(x.astype(np.complex128))
+    got = np.asarray(PF2.fft2_c2c(jnp.asarray(x), interpret=INTERPRET))
+    assert got.shape == x.shape
+    assert np.abs(got - want).max() / np.abs(want).max() < 2e-5
+
+
+def test_segment_rfft_pallas2_strategy():
+    """End-to-end R2C through the pallas2 strategy (pack + two-pass C2C +
+    Hermitian post) against the monolithic rfft at n = 2^25."""
+    n = 2 * M
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal(n).astype(np.float32)
+    want = np.fft.rfft(x.astype(np.float64))[:-1]
+    got = np.asarray(F.segment_rfft(
+        jnp.asarray(x), "pallas2_interpret" if INTERPRET else "pallas2"))
+    assert np.abs(got - want).max() / np.abs(want).max() < 2e-5
+
+
+def test_rfft_subbyte_pallas2_blocked_planes():
+    """The blocked-plane sub-byte R2C with pallas2 plane FFTs (the
+    production 2^30 ingest composition) against the f64 oracle: 4-bit
+    (count=2, one packed plane of length n/2 = 2^24)."""
+    from srtb_tpu.ops import unpack as U
+
+    n = 2 * M
+    rng = np.random.default_rng(17)
+    raw = rng.integers(0, 256, n // 2, dtype=np.uint8)
+    x = np.asarray(U.unpack(jnp.asarray(raw), 4, None)).astype(np.float64)
+    want = np.fft.rfft(x)[:-1]
+    got = np.asarray(F.rfft_subbyte(
+        jnp.asarray(raw), 4,
+        "pallas2_interpret" if INTERPRET else "pallas2"))
+    assert np.abs(got - want).max() / np.abs(want).max() < 2e-5
+
+
+def test_segment_rfft_pallas2_small_falls_back():
+    """Below the pallas2 window the strategy silently takes the
+    pallas-legs four-step — tiny configs must not crash."""
+    n = 1 << 16
+    rng = np.random.default_rng(13)
+    x = rng.standard_normal(n).astype(np.float32)
+    want = np.fft.rfft(x.astype(np.float64))[:-1]
+    got = np.asarray(F.segment_rfft(
+        jnp.asarray(x), "pallas2_interpret" if INTERPRET else "pallas2"))
+    assert np.abs(got - want).max() / np.abs(want).max() < 5e-6

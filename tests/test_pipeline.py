@@ -404,3 +404,77 @@ def test_staged_pallas_rows_impl_matches_default(monkeypatch):
     import pytest
     with pytest.raises(ValueError, match="rows impl"):
         SegmentProcessor(cfg, staged=True).process(raw)
+
+
+def test_staged_pallas2_downgrades_below_window(monkeypatch):
+    """SRTB_STAGED_ROWS_IMPL=pallas2 at a leg length below the fused
+    two-pass window must downgrade to the pallas-legs four-step (and
+    stay numerically on-plan), not crash a tiny forced-staged config."""
+    import numpy as np
+
+    from srtb_tpu.config import Config
+    from srtb_tpu.pipeline.segment import SegmentProcessor, \
+        waterfall_to_numpy
+
+    cfg = Config(
+        baseband_input_count=1 << 14,
+        baseband_input_bits=2,
+        baseband_format_type="simple",
+        baseband_freq_low=1405.0,
+        baseband_bandwidth=64.0,
+        baseband_sample_rate=128e6,
+        dm=30.0,
+        spectrum_channel_count=1 << 5,
+        mitigate_rfi_average_method_threshold=1e9,
+        mitigate_rfi_spectral_kurtosis_threshold=1e9,
+        baseband_reserve_sample=False,
+    )
+    rng = np.random.default_rng(21)
+    raw = rng.integers(0, 256, cfg.segment_bytes(1), dtype=np.uint8)
+    monkeypatch.delenv("SRTB_STAGED_ROWS_IMPL", raising=False)
+    base = waterfall_to_numpy(
+        SegmentProcessor(cfg, staged=True).process(raw)[0])
+    monkeypatch.setenv("SRTB_STAGED_ROWS_IMPL", "pallas2")
+    proc = SegmentProcessor(cfg, staged=True)
+    assert proc._staged_impl() == "pallas_interpret"
+    got = waterfall_to_numpy(proc.process(raw)[0])
+    np.testing.assert_allclose(got, base, rtol=2e-3, atol=2e-4)
+
+
+def test_staged_pallas2_blocked_production_shape(monkeypatch):
+    """The 2^30 production plan in miniature: blocked-plane sub-byte
+    unpack + fused two-pass Pallas FFT legs across the staged (a)/(b)
+    boundary, at the smallest in-window leg (n = 2^25, 4-bit, leg
+    M = 2^24).  No XLA FFT op exists in stages a/b — the SIGSEGV
+    workaround shape — and the waterfall must match the default staged
+    plan."""
+    import numpy as np
+
+    from srtb_tpu.config import Config
+    from srtb_tpu.pipeline.segment import SegmentProcessor, \
+        waterfall_to_numpy
+
+    cfg = Config(
+        baseband_input_count=1 << 25,
+        baseband_input_bits=4,
+        baseband_format_type="simple",
+        baseband_freq_low=1405.0,
+        baseband_bandwidth=64.0,
+        baseband_sample_rate=128e6,
+        dm=30.0,
+        spectrum_channel_count=1 << 9,
+        mitigate_rfi_average_method_threshold=1e9,
+        mitigate_rfi_spectral_kurtosis_threshold=1e9,
+        baseband_reserve_sample=False,
+    )
+    rng = np.random.default_rng(23)
+    raw = rng.integers(0, 256, cfg.segment_bytes(1), dtype=np.uint8)
+    monkeypatch.setenv("SRTB_STAGED_BLOCKED", "1")
+    monkeypatch.delenv("SRTB_STAGED_ROWS_IMPL", raising=False)
+    base = waterfall_to_numpy(
+        SegmentProcessor(cfg, staged=True).process(raw)[0])
+    monkeypatch.setenv("SRTB_STAGED_ROWS_IMPL", "pallas2")
+    proc = SegmentProcessor(cfg, staged=True)
+    assert proc._staged_impl() == "pallas2_interpret"
+    got = waterfall_to_numpy(proc.process(raw)[0])
+    np.testing.assert_allclose(got, base, rtol=2e-3, atol=2e-4)

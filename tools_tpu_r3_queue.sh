@@ -33,6 +33,17 @@ run baseline    env SRTB_BENCH_TRACE_DIR=/tmp/r3_trace_baseline python bench.py
 run pallas      env SRTB_BENCH_USE_PALLAS=1 python bench.py
 run pallas_sk   env SRTB_BENCH_USE_PALLAS=1 SRTB_BENCH_USE_PALLAS_SK=1 python bench.py
 run pallas_fs   env SRTB_BENCH_FFT_STRATEGY=pallas python bench.py
+# the fused two-pass four-step (ops/pallas_fft2): segment C2C in 2 HBM
+# round trips, no XLA FFT op — the round-3 roofline-gap candidate.
+# First hardware exposure: bound it so a Mosaic/VMEM failure can't eat
+# the queue; if VMEM overflows, retry with smaller blocks.
+run pallas2     env SRTB_BENCH_FFT_STRATEGY=pallas2 SRTB_BENCH_DEADLINE=900 python bench.py
+run pallas2_small_blk env SRTB_BENCH_FFT_STRATEGY=pallas2 SRTB_PALLAS2_BB=64 \
+    SRTB_PALLAS2_RB=8 SRTB_BENCH_DEADLINE=900 python bench.py
+# everything-fused flagship: two-pass FFT + fused RFI/chirp + fused
+# waterfall/SK stats
+run pallas2_full env SRTB_BENCH_FFT_STRATEGY=pallas2 SRTB_BENCH_USE_PALLAS=1 \
+    SRTB_BENCH_USE_PALLAS_SK=1 SRTB_BENCH_DEADLINE=900 python bench.py
 
 # ---- 1b. blocked-plane Pallas unpack: Mosaic acceptance probe ----
 # (flip ops/pallas_kernels.PLANES_UNPACK_MOSAIC_OK to True if this
@@ -124,6 +135,20 @@ echo "== staged-blocked 2^30 probe, pallas legs =="
 rc=$?
 line=$(grep '^{' /tmp/staged_blocked_pallas.json 2>/dev/null | tail -1)
 echo "{\"ts\": \"$(stamp)\", \"variant\": \"staged_blocked_pallas_probe\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$OUT"
+# fused two-pass legs across the staged boundary (pass1 | pass2): the
+# fewest-HBM-passes 2^30 plan, classic unpack first, then the
+# lane-dense blocked unpack (both XLA-FFT-free)
+run n2_30_pallas2 env SRTB_STAGED_ROWS_IMPL=pallas2 SRTB_BENCH_LOG2N=30 \
+    SRTB_BENCH_LOG2CHAN=15 SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=1200 \
+    python bench.py
+echo "== staged-blocked 2^30 probe, pallas2 legs =="
+( timeout 1200 env SRTB_STAGED_BLOCKED=1 SRTB_STAGED_ROWS_IMPL=pallas2 \
+    SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 SRTB_BENCH_REPS=3 \
+    SRTB_BENCH_DEADLINE=1100 \
+    python bench.py > /tmp/staged_blocked_pallas2.json 2>/dev/null )
+rc=$?
+line=$(grep '^{' /tmp/staged_blocked_pallas2.json 2>/dev/null | tail -1)
+echo "{\"ts\": \"$(stamp)\", \"variant\": \"staged_blocked_pallas2_probe\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$OUT"
 
 # ---- 4. live UDP -> TPU end-to-end, 60 s at 2x wire rate (VERDICT #6),
 #         two receivers = the reference's per-polarization deployment ----
