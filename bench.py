@@ -184,7 +184,12 @@ def run_bench(platform_error):
         use_pallas_sk=bool(int(os.environ.get("SRTB_BENCH_USE_PALLAS_SK",
                                               "0"))),
     )
-    proc = SegmentProcessor(cfg)
+    # "" = auto (staged at n >= 2^30); "0"/"1" force the plan — the
+    # one-program 2^30 experiment (pallas2 has no XLA FFT scratch, so
+    # the fused plan may fit where it used to OOM) needs the override
+    staged_env = os.environ.get("SRTB_BENCH_STAGED", "")
+    proc = SegmentProcessor(
+        cfg, staged=None if staged_env == "" else bool(int(staged_env)))
 
     rng = np.random.default_rng(0)
     raw = rng.integers(0, 256, size=cfg.segment_bytes(1), dtype=np.uint8)

@@ -30,7 +30,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from srtb_tpu.ops.fft import _phase_exp, pack_even_odd
+from srtb_tpu.ops.fft import _fft_minor, _phase_exp, pack_even_odd
 
 
 def _local_transpose_a2a(x_block, axis_name, n_dev):
@@ -48,18 +48,18 @@ def _local_transpose_a2a(x_block, axis_name, n_dev):
     return t
 
 
-def _dist_fft_block(x_block, *, axis_name, n1, n2, n_dev, inverse):
+def _dist_fft_block(x_block, *, axis_name, n1, n2, n_dev, inverse,
+                    rows_impl="xla"):
     """shard_map body: x_block [n_local] = this device's j1-block rows,
-    viewed as [n1/D, n2]."""
-    sign = 2.0j if inverse else -2.0j
+    viewed as [n1/D, n2].  ``rows_impl`` selects who runs the local leg
+    FFTs (ops.fft._fft_minor dispatch): "xla", or "pallas"/
+    "pallas_interpret" for the VMEM row kernel — the same per-chip
+    kernels the single-chip plans use, now under the a2a transposes."""
     a = x_block.reshape(n1 // n_dev, n2)
 
     # transpose so columns (j1 axis) become local rows
     at = _local_transpose_a2a(a, axis_name, n_dev)          # [n2/D, n1]
-    if inverse:
-        bt = jnp.fft.ifft(at, axis=-1, norm="forward")
-    else:
-        bt = jnp.fft.fft(at, axis=-1)
+    bt = _fft_minor(at, inverse, rows_impl)
     # twiddle: row j2 (global), column k1: exp(sign*2*pi*i*k1*j2/n).
     # The residue k1*j2 < n1*n2 = n fits int32 exactly for n <= 2^30, and
     # _phase_exp splits it hi/lo so the f32 phase stays exact at large n
@@ -75,22 +75,34 @@ def _dist_fft_block(x_block, *, axis_name, n1, n2, n_dev, inverse):
 
     # transpose back: rows k1 local again
     b = _local_transpose_a2a(bt, axis_name, n_dev)          # [n1/D, n2]
-    if inverse:
-        c = jnp.fft.ifft(b, axis=-1, norm="forward")
-    else:
-        c = jnp.fft.fft(b, axis=-1)
+    c = _fft_minor(b, inverse, rows_impl)
     # natural order: X[k2*n1 + k1] = C[k1, k2] -> global transpose
     ct = _local_transpose_a2a(c, axis_name, n_dev)          # [n2/D, n1]
     return ct.reshape(-1)
 
 
+def resolve_rows_impl(impl: str) -> str:
+    """Validate + resolve a distributed leg implementation: typos must
+    fail loudly (the segment.py:_resolve_rows_impl rule), and "pallas"
+    downgrades to interpret mode off-TPU (utils.platform.on_accelerator
+    is the single home of the backend set)."""
+    if impl not in ("xla", "pallas", "pallas_interpret"):
+        raise ValueError(
+            f"unknown SRTB_DIST_ROWS_IMPL / rows_impl {impl!r}")
+    from srtb_tpu.utils.platform import on_accelerator
+    if impl == "pallas" and not on_accelerator():
+        return "pallas_interpret"
+    return impl
+
+
 def dist_fft(x, mesh: Mesh, axis_name: str = "seq",
-             inverse: bool = False):
+             inverse: bool = False, rows_impl: str = "xla"):
     """Distributed unnormalized C2C FFT of a 1-D power-of-two array sharded
     (or shardable) over ``axis_name``.  Returns the spectrum in natural
     order with the same sharding."""
     n = x.shape[-1]
     n_dev = mesh.shape[axis_name]
+    rows_impl = resolve_rows_impl(rows_impl)
     if n > 1 << 30:
         # the twiddle residue j2*k1 is int32; products stay < n, so 2^30
         # is a safe static ceiling (2^31 would need int64 residues)
@@ -101,10 +113,13 @@ def dist_fft(x, mesh: Mesh, axis_name: str = "seq",
     n2 = n // n1
     if n1 % n_dev or n2 % n_dev:
         raise ValueError(f"n1={n1}, n2={n2} must divide by {n_dev} devices")
+    # pallas_call inside shard_map can't annotate its outputs' varying
+    # mesh axes (vma), so the checker must be off for the Pallas legs
     fn = shard_map(
         partial(_dist_fft_block, axis_name=axis_name, n1=n1, n2=n2,
-                n_dev=n_dev, inverse=inverse),
-        mesh=mesh, in_specs=P(axis_name), out_specs=P(axis_name))
+                n_dev=n_dev, inverse=inverse, rows_impl=rows_impl),
+        mesh=mesh, in_specs=P(axis_name), out_specs=P(axis_name),
+        check_vma=rows_impl == "xla")
     return fn(x.astype(jnp.complex64))
 
 
@@ -143,7 +158,8 @@ def _dist_rfft_post_block(zf_block, *, axis_name, m, n_dev):
     return even + w * odd
 
 
-def dist_rfft_drop_nyquist(x, mesh: Mesh, axis_name: str = "seq"):
+def dist_rfft_drop_nyquist(x, mesh: Mesh, axis_name: str = "seq",
+                           rows_impl: str = "xla"):
     """Distributed R2C of 2m reals -> m complex bins (drop-Nyquist
     convention of the segment FFT, ref: fft_pipe.hpp:75-77)."""
     n = x.shape[-1]
@@ -165,7 +181,7 @@ def dist_rfft_drop_nyquist(x, mesh: Mesh, axis_name: str = "seq"):
 
     z = shard_map(pack, mesh=mesh, in_specs=P(axis_name),
                   out_specs=P(axis_name))(x.astype(jnp.float32))
-    zf = dist_fft(z, mesh, axis_name)
+    zf = dist_fft(z, mesh, axis_name, rows_impl=rows_impl)
     post = shard_map(
         partial(_dist_rfft_post_block, axis_name=axis_name, m=m,
                 n_dev=n_dev),

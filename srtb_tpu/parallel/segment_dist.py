@@ -17,6 +17,7 @@ ppermute (Hermitian mirror) + 4 psum (means/counts) — all riding ICI.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import NamedTuple
 
@@ -143,8 +144,15 @@ class DistSegmentProcessor:
         self.nsamps_reserved = dd.nsamps_reserved(cfg)
         self.time_reserved_count = self.nsamps_reserved // self.channel_count
 
+        # who runs the local FFT legs under the a2a transposes: the env
+        # knob mirrors SRTB_STAGED_ROWS_IMPL; Pallas kernels need
+        # interpret mode off-TPU (CPU-mesh CI)
+        from srtb_tpu.parallel.dist_fft import resolve_rows_impl
+        rows_impl = resolve_rows_impl(
+            os.environ.get("SRTB_DIST_ROWS_IMPL", "xla"))
         body = partial(
             self._body,
+            rows_impl=rows_impl,
             variant=self.fmt.unpack_variant,
             nbits=cfg.baseband_input_bits,
             n=self.n, n_seq=self.n_seq, n_dm_dev=self.n_dm_devices,
@@ -174,13 +182,15 @@ class DistSegmentProcessor:
         self._step = jax.jit(shard_map(
             body, mesh=mesh,
             in_specs=tuple(in_specs),
-            out_specs=(P(), P(), P(), P("dm"))))
+            out_specs=(P(), P(), P(), P("dm")),
+            # Pallas legs can't annotate vma on their outputs
+            check_vma=rows_impl == "xla"))
 
     # ------------------------------------------------------------------
 
     @staticmethod
     def _body(raw_block, chirp_block, mask_block, *rest, variant, nbits, n,
-              n_seq, n_dm_dev, chirp_on_device, f_min, f_c, df,
+              rows_impl, n_seq, n_dm_dev, chirp_on_device, f_min, f_c, df,
               chirp_anchor_consts, n_spectrum, channel_count, norm_coeff,
               avg_threshold, sk_threshold, time_reserved_count,
               snr_threshold, max_boxcar_length,
@@ -205,7 +215,8 @@ class DistSegmentProcessor:
             # dim 2 -> 128 lanes on real TPU (64x HBM, ops/fft.py)
             z = F.pack_even_odd(xs[s])
             zf = DF._dist_fft_block(z, axis_name="seq", n1=n1, n2=n2,
-                                    n_dev=n_seq, inverse=False)
+                                    n_dev=n_seq, inverse=False,
+                                    rows_impl=rows_impl)
             spec = DF._dist_rfft_post_block(zf, axis_name="seq", m=m,
                                             n_dev=n_seq)   # [m/n_seq]
             # RFI stage 1: global mean power via psum, zap + normalize

@@ -148,7 +148,8 @@ class SegmentProcessor:
         self.time_reserved_count = self.nsamps_reserved // self.channel_count
 
         # Pallas kernels need interpret mode off-TPU (CPU CI)
-        self._pallas_interpret = jax.default_backend() not in ("tpu", "axon")
+        from srtb_tpu.utils.platform import on_accelerator
+        self._pallas_interpret = not on_accelerator()
         self._jit_process = jax.jit(self._process)
         self._jit_stage_a = jax.jit(self._stage_a)
         # the staged intermediates are consumed exactly once, so stages

@@ -24,3 +24,12 @@ def apply_platform_env() -> None:
         jax.config.update("jax_platforms", platforms)
     except Exception:  # backend already initialized: keep whatever is up
         pass
+
+
+def on_accelerator() -> bool:
+    """Whether the default JAX backend is real TPU hardware (directly or
+    via the axon relay) — the single home of the backend set that gates
+    Pallas interpret-mode downgrades."""
+    import jax
+
+    return jax.default_backend() in ("tpu", "axon")

@@ -224,3 +224,40 @@ def test_dist_rejects_non_dividing_channel_count():
     mesh = M.make_mesh(n_dm=2, n_seq=2, devices=jax.devices()[:4])
     with pytest.raises(ValueError, match="must divide"):
         DistSegmentProcessor(cfg, mesh, dm_list=[1.0, 2.0, 3.0, 4.0])
+
+
+def test_dist_rows_impl_knob(raw_segment, monkeypatch):
+    """SRTB_DIST_ROWS_IMPL=pallas must reach the distributed leg FFTs
+    (as pallas_interpret off-TPU), keep the step's outputs on-plan, and
+    reject typos loudly."""
+    from srtb_tpu.ops import fft as F
+
+    cfg = _cfg()
+    mesh = M.make_mesh(n_dm=2, n_seq=4)
+    monkeypatch.delenv("SRTB_DIST_ROWS_IMPL", raising=False)
+    base = DistSegmentProcessor(cfg, mesh, dm_list=[cfg.dm, 0.0])
+    res_base = base.process(raw_segment)
+
+    impls_seen = []
+    orig = F._fft_minor
+
+    def spy(x, inverse, rows_impl="xla"):
+        impls_seen.append(rows_impl)
+        return orig(x, inverse, rows_impl)
+
+    monkeypatch.setenv("SRTB_DIST_ROWS_IMPL", "pallas")
+    monkeypatch.setattr(F, "_fft_minor", spy)
+    try:
+        import srtb_tpu.parallel.dist_fft as DF
+        monkeypatch.setattr(DF, "_fft_minor", spy)
+        dist = DistSegmentProcessor(cfg, mesh, dm_list=[cfg.dm, 0.0])
+        res = dist.process(raw_segment)
+    finally:
+        monkeypatch.setattr(F, "_fft_minor", orig)
+    assert "pallas_interpret" in impls_seen, impls_seen
+    np.testing.assert_array_equal(np.asarray(res.signal_counts),
+                                  np.asarray(res_base.signal_counts))
+
+    monkeypatch.setenv("SRTB_DIST_ROWS_IMPL", "palas")
+    with pytest.raises(ValueError, match="SRTB_DIST_ROWS_IMPL"):
+        DistSegmentProcessor(cfg, mesh, dm_list=[cfg.dm, 0.0])

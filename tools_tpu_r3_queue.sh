@@ -141,6 +141,18 @@ echo "{\"ts\": \"$(stamp)\", \"variant\": \"staged_blocked_pallas_probe\", \"rc\
 run n2_30_pallas2 env SRTB_STAGED_ROWS_IMPL=pallas2 SRTB_BENCH_LOG2N=30 \
     SRTB_BENCH_LOG2CHAN=15 SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=1200 \
     python bench.py
+# one-program 2^30: no XLA FFT scratch with pallas2, so the fused plan
+# may fit in 16 GB where it used to OOM — would erase both 4 GB staged
+# boundary crossings (VERDICT #3's second half).  Bounded probe.
+echo "== one-program 2^30 probe, pallas2 fused =="
+( timeout 1200 env SRTB_BENCH_STAGED=0 SRTB_BENCH_FFT_STRATEGY=pallas2 \
+    SRTB_BENCH_USE_PALLAS=1 SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 \
+    SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=1100 \
+    python bench.py > /tmp/fused_2_30_pallas2.json 2>/dev/null )
+rc=$?
+line=$(grep '^{' /tmp/fused_2_30_pallas2.json 2>/dev/null | tail -1)
+echo "{\"ts\": \"$(stamp)\", \"variant\": \"fused_2_30_pallas2_probe\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$OUT"
+
 echo "== staged-blocked 2^30 probe, pallas2 legs =="
 ( timeout 1200 env SRTB_STAGED_BLOCKED=1 SRTB_STAGED_ROWS_IMPL=pallas2 \
     SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 SRTB_BENCH_REPS=3 \
