@@ -1,10 +1,17 @@
 """Live waterfall HTTP server.
 
-The reference shows a live Qt waterfall window per data stream
-(ref: gui/gui.hpp, spectrum_image_provider.hpp, src/main.qml).  The
-headless TPU equivalent: the WaterfallService writes PNG frames, and this
-tiny stdlib HTTP server exposes the latest frame per stream with an
-auto-refreshing index page — same live view, no GUI toolkit on the host.
+The reference shows live Qt/QML waterfall windows, one per data stream
+(ref: gui/gui.hpp:34-67, spectrum_image_provider.hpp, src/main.qml:14-28),
+with the event loop repainting as SpectrumImageProvider appends lines.
+The headless TPU equivalent: the WaterfallService writes PNG frames and
+this stdlib HTTP server serves an *interactive* live view — per-stream
+panes that poll ``/frames.json`` and swap the image in place (no page
+reload), pause/resume, a history scrubber over the retained frames,
+zoom, brightness/contrast (client-side CSS filters over the same
+pre-colormapped pixels the reference pushes to its QImage), and a live
+metrics bar fed from ``/metrics.json``.  Same interactivity surface as
+the QML window — pause, look back, lean in — with no GUI toolkit on
+the host.
 """
 
 from __future__ import annotations
@@ -20,10 +27,108 @@ from srtb_tpu.utils.logging import log
 
 _INDEX_TEMPLATE = """<!DOCTYPE html>
 <html><head><title>srtb_tpu waterfall</title>
-<meta http-equiv="refresh" content="2">
-<style>body{{background:#111;color:#eee;font-family:monospace}}
-img{{image-rendering:pixelated;border:1px solid #444}}</style></head>
-<body><h2>srtb_tpu spectrum waterfall</h2>{body}</body></html>
+<style>
+body{{background:#111;color:#eee;font-family:monospace;margin:12px}}
+img{{image-rendering:pixelated;border:1px solid #444;display:block}}
+.pane{{margin-bottom:14px}}
+.bar{{margin:4px 0}}
+button{{background:#222;color:#eee;border:1px solid #555;margin-right:4px}}
+input[type=range]{{vertical-align:middle}}
+#metrics{{color:#8c8;margin-bottom:10px}}
+</style></head>
+<body><h2>srtb_tpu spectrum waterfall</h2>
+<div id="metrics">metrics: …</div>
+{body}
+<script>
+"use strict";
+const panes = {{}};   // stream -> {{paused, pos, frames, img, slider, label}}
+function setFrame(s) {{
+  const p = panes[s];
+  if (!p.frames.length) return;
+  const i = Math.min(p.pos, p.frames.length - 1);
+  p.img.src = "/" + p.frames[i];
+  p.label.textContent = p.frames[i] +
+    (p.paused ? "  [paused]" : "  [live]");
+  p.slider.max = p.frames.length - 1;
+  p.slider.value = i;
+}}
+function wire(s) {{
+  const el = document.getElementById("pane" + s);
+  const p = panes[s] = {{
+    paused: false, pos: 0, frames: [],
+    img: el.querySelector("img"),
+    slider: el.querySelector("input[type=range]"),
+    label: el.querySelector(".fname"),
+  }};
+  el.querySelector(".pause").onclick = (e) => {{
+    p.paused = !p.paused;
+    e.target.textContent = p.paused ? "resume" : "pause";
+    if (!p.paused) p.pos = Math.max(0, p.frames.length - 1);
+    setFrame(s);
+  }};
+  p.slider.oninput = () => {{
+    p.paused = true;
+    el.querySelector(".pause").textContent = "resume";
+    p.pos = +p.slider.value;
+    setFrame(s);
+  }};
+  let zoom = 1;
+  el.querySelector(".zin").onclick = () => {{
+    zoom = Math.min(8, zoom * 2); p.img.style.width =
+      (p.img.naturalWidth * zoom) + "px";
+  }};
+  el.querySelector(".zout").onclick = () => {{
+    zoom = Math.max(0.25, zoom / 2); p.img.style.width =
+      (p.img.naturalWidth * zoom) + "px";
+  }};
+  const bright = el.querySelector(".bright"),
+        contrast = el.querySelector(".contrast");
+  const filt = () => {{
+    p.img.style.filter =
+      `brightness(${{bright.value}}%) contrast(${{contrast.value}}%)`;
+  }};
+  bright.oninput = filt; contrast.oninput = filt;
+}}
+async function tick() {{
+  try {{
+    const r = await fetch("/frames.json");
+    const data = await r.json();
+    for (const s in data.streams) {{
+      if (!(s in panes)) continue;
+      const p = panes[s];
+      p.frames = data.streams[s];
+      if (!p.paused) p.pos = Math.max(0, p.frames.length - 1);
+      setFrame(s);
+    }}
+  }} catch (e) {{}}
+  try {{
+    const m = await (await fetch("/metrics.json")).json();
+    const keys = ["segments", "samples", "segments_dropped",
+                  "udp_lost_packets", "elapsed_s"];
+    document.getElementById("metrics").textContent = "metrics: " +
+      keys.filter(k => k in m).map(k => `${{k}}=${{m[k]}}`).join("  ");
+  }} catch (e) {{}}
+}}
+document.querySelectorAll(".pane").forEach(
+  el => wire(+el.dataset.stream));
+tick(); setInterval(tick, 1000);
+</script>
+</body></html>
+"""
+
+_PANE_TEMPLATE = """<div class="pane" id="pane{s}" data-stream="{s}">
+<div>stream {s}: <span class="fname">{name}</span></div>
+<div class="bar">
+<button class="pause">pause</button>
+<button class="zin">zoom+</button>
+<button class="zout">zoom-</button>
+history <input type="range" min="0" max="0" value="0">
+bright <input class="bright" type="range" min="20" max="300"
+ value="100">
+contrast <input class="contrast" type="range" min="20" max="300"
+ value="100">
+</div>
+<img src="/{name}"></div>
 """
 
 
@@ -33,9 +138,11 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     def log_message(self, *args):  # quiet
         pass
 
-    def _latest_frames(self):
+    def _all_frames(self):
+        """stream -> frame names sorted by index (the retained history
+        the scrubber moves over)."""
         pat = re.compile(r"waterfall_s(\d+)_(\d+)\.png$")
-        latest: dict[int, tuple[int, str]] = {}
+        frames: dict[int, list[tuple[int, str]]] = {}
         try:
             names = os.listdir(self.directory)
         except OSError:
@@ -43,10 +150,13 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         for name in names:
             m = pat.match(name)
             if m:
-                stream, idx = int(m.group(1)), int(m.group(2))
-                if stream not in latest or idx > latest[stream][0]:
-                    latest[stream] = (idx, name)
-        return {s: name for s, (_, name) in latest.items()}
+                frames.setdefault(int(m.group(1)), []).append(
+                    (int(m.group(2)), name))
+        return {s: [name for _, name in sorted(v)]
+                for s, v in frames.items()}
+
+    def _latest_frames(self):
+        return {s: v[-1] for s, v in self._all_frames().items() if v}
 
     def do_GET(self):
         try:
@@ -78,15 +188,25 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(data)
             return
+        if self.path == "/frames.json":
+            data = (json.dumps(
+                {"streams": self._all_frames()}) + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
         if self.path in ("/", "/index.html"):
             frames = self._latest_frames()
             if frames:
                 body = "".join(
-                    f"<div>stream {s}: {html.escape(name)}<br>"
-                    f'<img src="/{name}"></div>'
+                    _PANE_TEMPLATE.format(s=s, name=html.escape(name))
                     for s, name in sorted(frames.items()))
             else:
-                body = "<p>no frames yet</p>"
+                body = ('<p>no frames yet (panes appear on first '
+                        'refresh with data)</p>'
+                        '<meta http-equiv="refresh" content="2">')
             data = _INDEX_TEMPLATE.format(body=body).encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/html")

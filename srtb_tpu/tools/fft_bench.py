@@ -7,7 +7,7 @@ timings; use it to tune ops.fft.LARGE_FFT_THRESHOLD / cfg.fft_strategy on
 new hardware.
 
 Usage: python -m srtb_tpu.tools.fft_bench [min_log2 [max_log2 [strategies]]]
-(strategies: comma list from monolithic,four_step,mxu,pallas)
+(strategies: comma list from monolithic,four_step,mxu,pallas,pallas2)
 """
 
 from __future__ import annotations
@@ -48,7 +48,8 @@ def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     lo = int(argv[0]) if len(argv) > 0 else 20
     hi = int(argv[1]) if len(argv) > 1 else 27
-    strategies = ("monolithic", "four_step", "mxu", "pallas")
+    strategies = ("monolithic", "four_step", "mxu", "pallas",
+                  "pallas2")
     if len(argv) > 2:
         strategies = tuple(argv[2].split(","))
     for log2n in range(lo, hi + 1):

@@ -90,3 +90,36 @@ def test_http_metrics_endpoint(tmp_path):
     finally:
         server.stop()
         metrics.reset()  # don't leak counter state into other tests
+
+
+def test_waterfall_server_interactive_surface(tmp_path):
+    """The interactive viewer's JSON frame feed and page controls: the
+    QML-window replacement (ref: gui.hpp:34-67, main.qml:14-28) must
+    expose the frame history for the scrubber and the control bar."""
+    import json
+    import urllib.request
+
+    from srtb_tpu.gui.server import WaterfallHTTPServer
+
+    for idx in range(3):
+        (tmp_path / f"waterfall_s0_{idx:06d}.png").write_bytes(
+            b"\x89PNG\r\n\x1a\nstub")
+    (tmp_path / "waterfall_s1_000000.png").write_bytes(
+        b"\x89PNG\r\n\x1a\nstub")
+    srv = WaterfallHTTPServer(str(tmp_path)).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        feed = json.loads(
+            urllib.request.urlopen(base + "/frames.json").read())
+        assert feed["streams"]["0"] == [
+            f"waterfall_s0_{i:06d}.png" for i in range(3)]
+        assert feed["streams"]["1"] == ["waterfall_s1_000000.png"]
+        page = urllib.request.urlopen(base + "/").read().decode()
+        # latest frame inlined per stream + the interactive controls
+        assert "waterfall_s0_000002.png" in page
+        assert 'id="pane1"' in page
+        for control in ("pause", "zin", "bright", "contrast",
+                        "frames.json"):
+            assert control in page, control
+    finally:
+        srv.stop()

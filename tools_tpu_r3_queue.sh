@@ -97,6 +97,17 @@ PYEOF
       case "$line" in {*) echo "{\"ts\": \"$(stamp)\", \"variant\": \"mxu_precision_probe\", \"result\": $line}" >> "$OUT"; echo "$line";; esac
     done
 
+# ---- 1d. segment-R2C isolation sweep: pallas2 vs the field ----
+echo "== fft isolation sweep =="
+timeout 2400 python -m srtb_tpu.tools.fft_bench 27 29 \
+    monolithic,pallas,pallas2 2>/dev/null \
+  | while read -r line; do
+      case "$line" in {*)
+        echo "{\"ts\": \"$(stamp)\", \"variant\": \"fft_bench\", \"result\": $line}" >> "$OUT"
+        echo "$line";;
+      esac
+    done
+
 # ---- 2. per-kernel rows incl. the anchored-vs-exact chirp A/B ----
 echo "== kernel bench (anchored chirp A/B) =="
 python -m srtb_tpu.tools.kernel_bench --log2n 28 --reps 5 2>/dev/null \
