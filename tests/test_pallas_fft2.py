@@ -103,3 +103,22 @@ def test_segment_rfft_pallas2_small_falls_back():
     got = np.asarray(F.segment_rfft(
         jnp.asarray(x), "pallas2_interpret" if INTERPRET else "pallas2"))
     assert np.abs(got - want).max() / np.abs(want).max() < 5e-6
+
+
+def test_fourstep_twiddle_precision_at_window_edge():
+    """The in-kernel hi/lo phase split must stay accurate at the top of
+    the window (m = 2^29, residues up to 2^29 — far beyond f32's 24-bit
+    mantissa), where an end-to-end CPU-interpret test is impractical.
+    Checked against float64 on the worst blocks: the highest j2 rows
+    (largest residues) and a mid-spectrum block."""
+    m = 1 << 29
+    n1, n2 = PF2._factor(m)
+    for j2_0 in (n2 - 8, n2 // 2):
+        wr, wi = jax.jit(
+            lambda j0: PF2._fourstep_twiddle(8, n1, m, -1.0, j0),
+            static_argnums=0)(j2_0)
+        d = np.arange(8)[:, None] + j2_0
+        k1 = np.arange(n1)[None, :]
+        want = np.exp(-2j * np.pi * (d * k1).astype(np.float64) / m)
+        err = np.abs((np.asarray(wr) + 1j * np.asarray(wi)) - want).max()
+        assert err < 2e-6, (j2_0, err)
