@@ -97,6 +97,16 @@ PYEOF
       case "$line" in {*) echo "{\"ts\": \"$(stamp)\", \"variant\": \"mxu_precision_probe\", \"result\": $line}" >> "$OUT"; echo "$line";; esac
     done
 
+# ---- 2. per-kernel rows incl. the anchored-vs-exact chirp A/B ----
+echo "== kernel bench (anchored chirp A/B) =="
+python -m srtb_tpu.tools.kernel_bench --log2n 28 --reps 5 2>/dev/null \
+  | while read -r line; do
+      echo "{\"ts\": \"$(stamp)\", \"variant\": \"kernel\", \"result\": $line}" >> "$OUT"
+      echo "$line"
+    done
+
+if [ "$QUICK" = "quick" ]; then exit 0; fi
+
 # ---- 1d. segment-R2C isolation sweep: pallas2 vs the field ----
 echo "== fft isolation sweep =="
 timeout 2400 python -m srtb_tpu.tools.fft_bench 27 29 \
@@ -108,15 +118,6 @@ timeout 2400 python -m srtb_tpu.tools.fft_bench 27 29 \
       esac
     done
 
-# ---- 2. per-kernel rows incl. the anchored-vs-exact chirp A/B ----
-echo "== kernel bench (anchored chirp A/B) =="
-python -m srtb_tpu.tools.kernel_bench --log2n 28 --reps 5 2>/dev/null \
-  | while read -r line; do
-      echo "{\"ts\": \"$(stamp)\", \"variant\": \"kernel\", \"result\": $line}" >> "$OUT"
-      echo "$line"
-    done
-
-if [ "$QUICK" = "quick" ]; then exit 0; fi
 
 # ---- 3. 2^30 production segment rebench (VERDICT #3) ----
 run n2_30       env SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 SRTB_BENCH_REPS=3 python bench.py
