@@ -35,7 +35,29 @@ run pallas_sk   env SRTB_BENCH_USE_PALLAS=1 SRTB_BENCH_USE_PALLAS_SK=1 python be
 run pallas_fs   env SRTB_BENCH_FFT_STRATEGY=pallas python bench.py
 # the fused two-pass four-step (ops/pallas_fft2): segment C2C in 2 HBM
 # round trips, no XLA FFT op — the round-3 roofline-gap candidate.
-# First hardware exposure: bound it so a Mosaic/VMEM failure can't eat
+# Acceptance first, in isolation: does Mosaic take the two kernels at
+# all (strided col blocks, in-VMEM transposes, in-kernel twiddle)?
+echo "== pallas2 kernel acceptance probe =="
+( timeout 600 python - <<'PYEOF'
+from srtb_tpu.utils.platform import apply_platform_env
+apply_platform_env()
+import numpy as np, jax.numpy as jnp
+from srtb_tpu.ops import pallas_fft2 as pf2
+m = 1 << 24
+rng = np.random.default_rng(0)
+x = (rng.standard_normal(m) + 1j * rng.standard_normal(m)).astype(np.complex64)
+got = pf2.fft2_c2c(jnp.asarray(x), interpret=False)
+gr, gi = np.asarray(jnp.real(got)), np.asarray(jnp.imag(got))
+want = np.fft.fft(x.astype(np.complex128))
+err = float(np.abs((gr + 1j * gi) - want).max() / np.abs(want).max())
+assert err < 2e-5, err
+print('{"probe": "pallas2_mosaic", "ok": true, "rel_err": %.3g}' % err)
+PYEOF
+) > /tmp/pallas2_probe.json 2>/dev/null
+rc=$?
+line=$(grep '^{' /tmp/pallas2_probe.json 2>/dev/null | tail -1)
+echo "{\"ts\": \"$(stamp)\", \"variant\": \"pallas2_mosaic_probe\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$OUT"
+# First pipeline exposure: bound it so a Mosaic/VMEM failure can't eat
 # the queue; if VMEM overflows, retry with smaller blocks.
 run pallas2     env SRTB_BENCH_FFT_STRATEGY=pallas2 SRTB_BENCH_DEADLINE=900 python bench.py
 run pallas2_small_blk env SRTB_BENCH_FFT_STRATEGY=pallas2 SRTB_PALLAS2_BB=64 \
