@@ -175,6 +175,12 @@ echo "{\"ts\": \"$(stamp)\", \"variant\": \"staged_blocked_pallas_probe\", \"rc\
 run n2_30_pallas2 env SRTB_STAGED_ROWS_IMPL=pallas2 SRTB_BENCH_LOG2N=30 \
     SRTB_BENCH_LOG2CHAN=15 SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=1200 \
     python bench.py
+# flagship everything-on 2^30: pallas2 staged legs + fused RFI/chirp +
+# fused waterfall/SK stats in stage (c)
+run n2_30_pallas2_full env SRTB_STAGED_ROWS_IMPL=pallas2 \
+    SRTB_BENCH_USE_PALLAS=1 SRTB_BENCH_USE_PALLAS_SK=1 \
+    SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 SRTB_BENCH_REPS=3 \
+    SRTB_BENCH_DEADLINE=1200 python bench.py
 # one-program 2^30: no XLA FFT scratch with pallas2, so the fused plan
 # may fit in 16 GB where it used to OOM — would erase both 4 GB staged
 # boundary crossings (VERDICT #3's second half).  Bounded probe.
