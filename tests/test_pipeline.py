@@ -478,3 +478,41 @@ def test_staged_pallas2_blocked_production_shape(monkeypatch):
     assert proc._staged_impl() == "pallas2_interpret"
     got = waterfall_to_numpy(proc.process(raw)[0])
     np.testing.assert_allclose(got, base, rtol=2e-3, atol=2e-4)
+
+
+def test_staged_pallas2_all_fusions_flagship(monkeypatch):
+    """The queue's n2_30_pallas2_full combination in miniature: classic
+    staged plan with fused two-pass legs PLUS the fused RFI/chirp front
+    half and the fused waterfall/SK-stats epilogue in stage (c).  Every
+    fusion on at once must stay on-plan against the plain staged run."""
+    import numpy as np
+
+    from srtb_tpu.config import Config
+    from srtb_tpu.pipeline.segment import SegmentProcessor, \
+        waterfall_to_numpy
+
+    cfg = Config(
+        baseband_input_count=1 << 25,
+        baseband_input_bits=4,
+        baseband_format_type="simple",
+        baseband_freq_low=1405.0,
+        baseband_bandwidth=64.0,
+        baseband_sample_rate=128e6,
+        dm=30.0,
+        spectrum_channel_count=1 << 9,
+        mitigate_rfi_average_method_threshold=1e9,
+        mitigate_rfi_spectral_kurtosis_threshold=1e9,
+        baseband_reserve_sample=False,
+    )
+    rng = np.random.default_rng(29)
+    raw = rng.integers(0, 256, cfg.segment_bytes(1), dtype=np.uint8)
+    monkeypatch.delenv("SRTB_STAGED_ROWS_IMPL", raising=False)
+    monkeypatch.delenv("SRTB_STAGED_BLOCKED", raising=False)
+    base = waterfall_to_numpy(
+        SegmentProcessor(cfg, staged=True).process(raw)[0])
+    monkeypatch.setenv("SRTB_STAGED_ROWS_IMPL", "pallas2")
+    proc = SegmentProcessor(
+        cfg.replace(use_pallas=True, use_pallas_sk=True), staged=True)
+    assert proc._staged_impl() == "pallas2_interpret"
+    got = waterfall_to_numpy(proc.process(raw)[0])
+    np.testing.assert_allclose(got, base, rtol=2e-3, atol=2e-4)
