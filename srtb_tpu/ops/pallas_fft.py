@@ -115,6 +115,45 @@ def vmem_fft_rows(xr, xi, war, wai, wbr, wbi, twr, twi, *, la, lb, rows):
     return yr, yi
 
 
+def dot_mid(a, b, dim):
+    """dot_general contracting ``a``'s axis ``dim`` with ``b``'s axis 0
+    under the module's DFT precision discipline — the single home of
+    that convention for the dense spellings here and in pallas_fft2."""
+    return jax.lax.dot_general(
+        a, b, (((dim,), (0,)), ((), ())),
+        precision=_PRECISION, preferred_element_type=jnp.float32)
+
+
+def vmem_fft_rows_dense(xr, xi, war, wai, wbr, wbi, twr, twi, *,
+                        la, lb, rows):
+    """dot_general spelling of :func:`vmem_fft_rows` — same contract,
+    different layout discipline: both DFT contractions run against the
+    *middle* axis of dense ``[rows, la, lb]`` views, so no intermediate
+    ever carries a sub-128 minor dim (the classic spelling's
+    ``[la, rows, lb]`` stages lane-pad lb -> 128, up to 4x VMEM), and
+    the only relayout is one final dense 3D transpose.  Kept alongside
+    the classic form so hardware can A/B the two lowerings
+    (SRTB_PALLAS2_ROWS in ops/pallas_fft2)."""
+    dg = dot_mid
+    x3r = xr.reshape(rows, la, lb)
+    x3i = xi.reshape(rows, la, lb)
+    # stage 1, contract j1: A[r, j2, k1] = sum_j1 x[r, j1, j2] Wa[j1, k1]
+    ar = dg(x3r, war, 1) - dg(x3i, wai, 1)      # [rows, lb, la]
+    ai = dg(x3r, wai, 1) + dg(x3i, war, 1)
+    # twiddle w[k1, j2] at [1, j2, k1] orientation, broadcast over rows
+    twr2 = twr.T.reshape(1, lb, la)
+    twi2 = twi.T.reshape(1, lb, la)
+    br = ar * twr2 - ai * twi2
+    bi = ar * twi2 + ai * twr2
+    # stage 2, contract j2: C[r, k1, k2] = sum_j2 B[r, j2, k1] Wb[j2, k2]
+    cr = dg(br, wbr, 1) - dg(bi, wbi, 1)        # [rows, la, lb]
+    ci = dg(br, wbi, 1) + dg(bi, wbr, 1)
+    # natural order k = k2*la + k1 -> [rows, k2, k1] -> [rows, L]
+    yr = jnp.transpose(cr, (0, 2, 1)).reshape(rows, la * lb)
+    yi = jnp.transpose(ci, (0, 2, 1)).reshape(rows, la * lb)
+    return yr, yi
+
+
 def _fft_rows_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref, wbi_ref,
                      twr_ref, twi_ref, out_re_ref, out_im_ref, *,
                      la, lb, rows):

@@ -9,12 +9,16 @@ trips for the C2C (measured 1481 vs monolithic's 1746 Msamples/s at
 layout work *into the leg's kernel* so the C2C is two passes total:
 
   pass 1 (grid over j2 column blocks of z viewed [n1, n2] row-major):
-    DMA a strided [n1, bb] column block into VMEM, transpose in-VMEM to
-    [bb, n1] rows, run the two-level DFT-matmul row FFT over j1
-    (ops/pallas_fft.vmem_fft_rows), apply the four-step twiddle
-    w[k1, j2] = exp(s*2*pi*i*k1*j2/m) computed *in-kernel* from iota
-    with the exact hi/lo phase split (no m-sized table exists anywhere),
-    transpose back and DMA out: intermediate B[k1, j2] laid out [n1, n2].
+    DMA a strided [n1, bb] column block into VMEM and run the two-level
+    DFT decimation over j1 *column-natively*: both contractions are
+    dot_generals against the j1 axes of the [la, lb, bb] view in place
+    (no 2D transpose, every intermediate lane-dense), then the
+    four-step twiddle w[k1, j2] = exp(s*2*pi*i*k1*j2/m) computed
+    *in-kernel* from iota with the exact hi/lo phase split (no m-sized
+    table exists anywhere), and DMA out: intermediate B[k1, j2] laid
+    out [n1, n2].  SRTB_PALLAS2_P1=row selects the alternate
+    transpose-to-rows + vmem_fft_rows spelling (an independent Mosaic
+    lowering of the same math, for hardware A/B).
 
   pass 2 (grid over k1 row blocks):
     DMA a contiguous [rb, n2] row block, run the row FFT over j2, store
@@ -97,14 +101,12 @@ def _block_rows(n2: int) -> int:
     return _rows_budget(n2)
 
 
-def _fourstep_twiddle(rows_j2, n1: int, m: int, sign: float, j2_0):
-    """w[d, k1] = exp(sign*2*pi*i*(j2_0 + d)*k1/m) for d < rows_j2,
-    k1 < n1, computed in-kernel from iota.  j2*k1 < m <= 2^29 is exact
-    in int32; the residue is split hi/lo so each cos/sin argument is
-    f32-exact (the ops.fft._phase_exp discipline, in-register)."""
-    d = jax.lax.broadcasted_iota(jnp.int32, (rows_j2, n1), 0) + j2_0
-    k1 = jax.lax.broadcasted_iota(jnp.int32, (rows_j2, n1), 1)
-    r = d * k1
+def _phase_cos_sin(r, m: int, sign: float):
+    """(cos, sin) of sign*2*pi*r/m for an int32 residue array r < m
+    <= 2^29, via the hi/lo split so each cos/sin argument is f32-exact
+    (the ops.fft._phase_exp discipline, in-register).  Single home of
+    the split for both twiddle orientations — the window-edge
+    precision test pins this one body."""
     half = 1 << 15
     scale = jnp.float32(sign * 2.0 * np.pi / m)
     a = (r // half).astype(jnp.float32) * (half * scale)
@@ -114,18 +116,73 @@ def _fourstep_twiddle(rows_j2, n1: int, m: int, sign: float, j2_0):
     return ca * cb - sa * sb, sa * cb + ca * sb
 
 
+def _fourstep_twiddle(rows_j2, n1: int, m: int, sign: float, j2_0):
+    """w[d, k1] = exp(sign*2*pi*i*(j2_0 + d)*k1/m) for d < rows_j2,
+    k1 < n1, computed in-kernel from iota (j2*k1 < m <= 2^29 is exact
+    in int32)."""
+    d = jax.lax.broadcasted_iota(jnp.int32, (rows_j2, n1), 0) + j2_0
+    k1 = jax.lax.broadcasted_iota(jnp.int32, (rows_j2, n1), 1)
+    return _phase_cos_sin(d * k1, m, sign)
+
+
+def _p1_spelling() -> str:
+    """Pass-1 kernel spelling: "col" (default — column-native
+    dot_general contractions, zero 2D input/output transposes, all
+    intermediates dense) or "row" (transpose to rows + the classic
+    two-level helper).  Two independent Mosaic lowerings of the same
+    math, A/B-able on hardware (SRTB_PALLAS2_P1)."""
+    return os.environ.get("SRTB_PALLAS2_P1", "col")
+
+
+def _rows_helper():
+    """Which in-VMEM row-FFT helper pass 2 (and the row spelling of
+    pass 1) uses: "dense" (default) or "classic" (SRTB_PALLAS2_ROWS)."""
+    if os.environ.get("SRTB_PALLAS2_ROWS", "dense") == "classic":
+        return PF.vmem_fft_rows
+    return PF.vmem_fft_rows_dense
+
+
 def _pass1_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref, wbi_ref,
                   twr_ref, twi_ref, out_re_ref, out_im_ref, *,
-                  n1, bb, la, lb, m, sign):
+                  n1, bb, la, lb, m, sign, spelling, rows_helper):
     from jax.experimental import pallas as pl
 
-    # strided [n1(j1), bb(j2)] column block -> [bb, n1] rows (j2-major)
+    j2_0 = pl.program_id(0) * bb
+    if spelling == "col":
+        # column-native: both DFT contractions run against the j1 axes
+        # of the [n1(j1), bb(j2)] block in place — no input transpose,
+        # no padded intermediate, one dense 3D relayout at the end
+        dg = PF.dot_mid
+        x3r = re_ref[:].reshape(la, lb, bb)
+        x3i = im_ref[:].reshape(la, lb, bb)
+        war, wai = war_ref[:], wai_ref[:]
+        # stage 1, contract j1a: A[j2, d, k1]
+        ar = dg(x3r, war, 0) - dg(x3i, wai, 0)      # [lb, bb, la]
+        ai = dg(x3r, wai, 0) + dg(x3i, war, 0)
+        # inner twiddle tw[k1, j2] at [j2, 1, k1] orientation
+        twr2 = twr_ref[:].T.reshape(lb, 1, la)
+        twi2 = twi_ref[:].T.reshape(lb, 1, la)
+        br = ar * twr2 - ai * twi2
+        bi = ar * twi2 + ai * twr2
+        # stage 2, contract j1b(lb): C[d, k1, k2]
+        wbr, wbi = wbr_ref[:], wbi_ref[:]
+        cr = dg(br, wbr, 0) - dg(bi, wbi, 0)        # [bb, la, lb]
+        ci = dg(br, wbi, 0) + dg(bi, wbr, 0)
+        # leg-natural index k = k2*la + k1 -> [k2, k1, d] -> [n1, bb]
+        yr = jnp.transpose(cr, (2, 1, 0)).reshape(n1, bb)
+        yi = jnp.transpose(ci, (2, 1, 0)).reshape(n1, bb)
+        # four-step twiddle at [k, d] orientation
+        wr, wi = _fourstep_twiddle_t(n1, bb, m, sign, j2_0)
+        out_re_ref[:] = yr * wr - yi * wi
+        out_im_ref[:] = yr * wi + yi * wr
+        return
+    # row spelling: strided [n1(j1), bb(j2)] block -> [bb, n1] rows
     xr = re_ref[:].T
     xi = im_ref[:].T
-    yr, yi = PF.vmem_fft_rows(xr, xi, war_ref[:], wai_ref[:], wbr_ref[:],
-                              wbi_ref[:], twr_ref[:], twi_ref[:],
-                              la=la, lb=lb, rows=bb)   # A[j2, k1]
-    wr, wi = _fourstep_twiddle(bb, n1, m, sign, pl.program_id(0) * bb)
+    yr, yi = rows_helper(xr, xi, war_ref[:], wai_ref[:], wbr_ref[:],
+                         wbi_ref[:], twr_ref[:], twi_ref[:],
+                         la=la, lb=lb, rows=bb)   # A[j2, k1]
+    wr, wi = _fourstep_twiddle(bb, n1, m, sign, j2_0)
     zr = yr * wr - yi * wi
     zi = yr * wi + yi * wr
     # back to [n1(k1), bb(j2)] for the strided column-block write
@@ -133,16 +190,25 @@ def _pass1_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref, wbi_ref,
     out_im_ref[:] = zi.T
 
 
+def _fourstep_twiddle_t(n1: int, cols_j2: int, m: int, sign: float, j2_0):
+    """Transposed orientation of :func:`_fourstep_twiddle`:
+    w[k1, d] = exp(sign*2*pi*i*k1*(j2_0 + d)/m) for k1 < n1,
+    d < cols_j2 — the [n1, bb] layout the column-native pass-1 writes."""
+    k1 = jax.lax.broadcasted_iota(jnp.int32, (n1, cols_j2), 0)
+    d = jax.lax.broadcasted_iota(jnp.int32, (n1, cols_j2), 1) + j2_0
+    return _phase_cos_sin(d * k1, m, sign)
+
+
 def _pass2_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref, wbi_ref,
                   twr_ref, twi_ref, out_re_ref, out_im_ref, *,
-                  n2, rb, la, lb):
+                  n2, rb, la, lb, rows_helper):
     # output stays [rb, n2] = C[k1, k2] k1-major blocked: a natural-order
     # [n2, rb] column block would lane-pad rb -> 128 in VMEM (8-32 MB per
     # plane at production n2) — callers restore order with unblock(), an
     # XLA transpose the next elementwise pass absorbs
-    yr, yi = PF.vmem_fft_rows(re_ref[:], im_ref[:], war_ref[:], wai_ref[:],
-                              wbr_ref[:], wbi_ref[:], twr_ref[:],
-                              twi_ref[:], la=la, lb=lb, rows=rb)
+    yr, yi = rows_helper(re_ref[:], im_ref[:], war_ref[:], wai_ref[:],
+                         wbr_ref[:], wbi_ref[:], twr_ref[:],
+                         twi_ref[:], la=la, lb=lb, rows=rb)
     out_re_ref[:] = yr
     out_im_ref[:] = yi
 
@@ -167,7 +233,8 @@ def pass1_2d(re2, im2, inverse: bool = False, interpret: bool = False):
     col_block = pl.BlockSpec((n1, bb), lambda i: (0, i),
                              memory_space=pltpu.VMEM)
     k1 = functools.partial(_pass1_kernel, n1=n1, bb=bb, la=la1, lb=lb1,
-                           m=m, sign=sign)
+                           m=m, sign=sign, spelling=_p1_spelling(),
+                           rows_helper=_rows_helper())
     mid_shape = jax.ShapeDtypeStruct((n1, n2), jnp.float32)
     return pl.pallas_call(
         k1,
@@ -195,7 +262,8 @@ def pass2_2d(br, bi, inverse: bool = False, interpret: bool = False):
     la2, lb2, consts2 = PF.leg_consts(n2, inverse)
     row_block = pl.BlockSpec((rb, n2), lambda i: (i, 0),
                              memory_space=pltpu.VMEM)
-    k2 = functools.partial(_pass2_kernel, n2=n2, rb=rb, la=la2, lb=lb2)
+    k2 = functools.partial(_pass2_kernel, n2=n2, rb=rb, la=la2, lb=lb2,
+                           rows_helper=_rows_helper())
     out_shape = jax.ShapeDtypeStruct((n1, n2), jnp.float32)
     return pl.pallas_call(
         k2,

@@ -48,6 +48,20 @@ def test_fft2_matches_numpy(inverse):
     assert np.abs(got - want).max() / scale < 2e-5
 
 
+@pytest.mark.parametrize("p1,rows", [("row", "dense"), ("row", "classic"),
+                                     ("col", "classic")])
+def test_fft2_alternate_spellings_match(monkeypatch, p1, rows):
+    """Every (pass-1 spelling x rows-helper) combination is the same
+    transform — the alternates exist as independent Mosaic lowerings
+    for the hardware A/B (SRTB_PALLAS2_P1 / SRTB_PALLAS2_ROWS)."""
+    x = _rand_c64(M, 31)
+    want = np.fft.fft(x.astype(np.complex128))
+    monkeypatch.setenv("SRTB_PALLAS2_P1", p1)
+    monkeypatch.setenv("SRTB_PALLAS2_ROWS", rows)
+    got = np.asarray(PF2.fft2_c2c(jnp.asarray(x), interpret=INTERPRET))
+    assert np.abs(got - want).max() / np.abs(want).max() < 2e-5
+
+
 def test_fft2_blocked_output_unblocks():
     x = _rand_c64(M, 3)
     want = np.fft.fft(x.astype(np.complex128))

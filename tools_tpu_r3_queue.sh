@@ -62,6 +62,12 @@ echo "{\"ts\": \"$(stamp)\", \"variant\": \"pallas2_mosaic_probe\", \"rc\": $rc,
 run pallas2     env SRTB_BENCH_FFT_STRATEGY=pallas2 SRTB_BENCH_DEADLINE=900 python bench.py
 run pallas2_small_blk env SRTB_BENCH_FFT_STRATEGY=pallas2 SRTB_PALLAS2_BB=64 \
     SRTB_PALLAS2_RB=8 SRTB_BENCH_DEADLINE=900 python bench.py
+# alternate Mosaic lowering of the same math (transpose-to-rows +
+# classic two-level helper) — the A/B partner / fallback if the
+# column-native dot_general spelling compiles or performs badly
+run pallas2_rowspell env SRTB_BENCH_FFT_STRATEGY=pallas2 \
+    SRTB_PALLAS2_P1=row SRTB_PALLAS2_ROWS=classic \
+    SRTB_BENCH_DEADLINE=900 python bench.py
 # everything-fused flagship: two-pass FFT + fused RFI/chirp + fused
 # waterfall/SK stats
 run pallas2_full env SRTB_BENCH_FFT_STRATEGY=pallas2 SRTB_BENCH_USE_PALLAS=1 \
