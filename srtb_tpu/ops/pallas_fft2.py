@@ -73,15 +73,18 @@ def supported(m: int) -> bool:
     return _factor(m) is not None
 
 
-def _rows_budget(length: int) -> int:
+def _rows_budget(length: int, dense: bool) -> int:
     """Rows per grid step for an in-VMEM leg FFT of this length, sized
-    from the *padded* dominant intermediate: vmem_fft_rows materializes
-    [la, rows, lb] stage arrays whose minor dim lane-pads to >= 128, so
-    the footprint is la*rows*max(lb, 128)*4 B per f32 plane — hold that
-    to ~1 MB (several such arrays + in/out blocks + consts must coexist
-    in ~16 MB of VMEM)."""
+    from the dominant stage intermediate at ~1 MB per f32 plane
+    (several such arrays + in/out blocks + consts must coexist in
+    ~16 MB of VMEM).  The dense dot_general spellings keep every
+    intermediate at la*rows*lb words exactly; the classic spelling's
+    [la, rows, lb] stages lane-pad lb -> 128 — a real VMEM cost that
+    shrinks the block, and with it the strided-DMA segment width
+    (rows*4 B), so dense earns its larger blocks twice over."""
     la, lb = PF._split_la_lb(length)
-    return max(8, min(128, (1 << 18) // (la * max(lb, 128))))
+    per_row = la * (lb if dense else max(lb, 128))
+    return max(8, min(128, (1 << 18) // per_row))
 
 
 def _block_cols(n1: int) -> int:
@@ -90,7 +93,8 @@ def _block_cols(n1: int) -> int:
     env = os.environ.get("SRTB_PALLAS2_BB")
     if env:
         return int(env)
-    return _rows_budget(n1)
+    dense = _p1_spelling() == "col" or _rows_helper() is not PF.vmem_fft_rows
+    return _rows_budget(n1, dense)
 
 
 def _block_rows(n2: int) -> int:
@@ -98,7 +102,7 @@ def _block_rows(n2: int) -> int:
     env = os.environ.get("SRTB_PALLAS2_RB")
     if env:
         return int(env)
-    return _rows_budget(n2)
+    return _rows_budget(n2, _rows_helper() is not PF.vmem_fft_rows)
 
 
 def _phase_cos_sin(r, m: int, sign: float):
