@@ -154,10 +154,23 @@ def vmem_fft_rows_dense(xr, xi, war, wai, wbr, wbi, twr, twi, *,
     return yr, yi
 
 
+def active_rows_helper():
+    """Helper selection for the row-FFT kernels in this module:
+    the proven classic spelling by default; SRTB_PALLAS_ROWS=dense
+    switches to the dense dot_general spelling (hardware A/B — same
+    contract, pinned to the same oracles)."""
+    import os
+
+    if os.environ.get("SRTB_PALLAS_ROWS", "classic") == "dense":
+        return vmem_fft_rows_dense
+    return vmem_fft_rows
+
+
 def _fft_rows_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref, wbi_ref,
                      twr_ref, twi_ref, out_re_ref, out_im_ref, *,
-                     la, lb, rows):
-    out_re_ref[:], out_im_ref[:] = vmem_fft_rows(
+                     la, lb, rows, rows_helper=None):
+    helper = rows_helper or vmem_fft_rows
+    out_re_ref[:], out_im_ref[:] = helper(
         re_ref[:], im_ref[:], war_ref[:], wai_ref[:], wbr_ref[:],
         wbi_ref[:], twr_ref[:], twi_ref[:], la=la, lb=lb, rows=rows)
 
@@ -165,7 +178,8 @@ def _fft_rows_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref, wbi_ref,
 def _fft_rows_stats_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref,
                            wbi_ref, twr_ref, twi_ref, dwr_ref,
                            out_re_ref, out_im_ref, s2_ref, s4_ref, *,
-                           la, lb, rows, apply_dewindow):
+                           la, lb, rows, apply_dewindow,
+                           rows_helper=None):
     """fft_rows kernel + fused epilogue: optional de-window multiply and
     per-row power moments (sum |x|^2, sum |x|^4 as 128-lane partials) —
     the spectral-kurtosis statistics collected while the waterfall rows
@@ -174,7 +188,7 @@ def _fft_rows_stats_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref,
     separate pass)."""
     _fft_rows_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref, wbi_ref,
                      twr_ref, twi_ref, out_re_ref, out_im_ref,
-                     la=la, lb=lb, rows=rows)
+                     la=la, lb=lb, rows=rows, rows_helper=rows_helper)
     yr = out_re_ref[:]
     yi = out_im_ref[:]
     if apply_dewindow:
@@ -291,7 +305,8 @@ def fft_rows_ri(re: jnp.ndarray, im: jnp.ndarray, inverse: bool = False,
 
     lc = _Launch(re, im, inverse)
     kernel = functools.partial(_fft_rows_kernel, la=lc.la, lb=lc.lb,
-                               rows=lc.rows)
+                               rows=lc.rows,
+                               rows_helper=active_rows_helper())
     out_re, out_im = pl.pallas_call(
         kernel,
         grid=lc.grid,
@@ -336,7 +351,8 @@ def fft_rows_stats_ri(re: jnp.ndarray, im: jnp.ndarray,
     stat_block = pl.BlockSpec((rows, 128), lambda i: (i, 0),
                               memory_space=pltpu.VMEM)
     kernel = functools.partial(_fft_rows_stats_kernel, la=lc.la, lb=lc.lb,
-                               rows=rows, apply_dewindow=apply_dewindow)
+                               rows=rows, apply_dewindow=apply_dewindow,
+                               rows_helper=active_rows_helper())
     out_re, out_im, s2, s4 = pl.pallas_call(
         kernel,
         grid=lc.grid,

@@ -157,3 +157,27 @@ def test_fft_rows_stats_matches_jnp():
                                rtol=1e-3)
     np.testing.assert_allclose(np.asarray(s4p).sum(-1), (p * p).sum(-1),
                                rtol=1e-3)
+
+
+def test_fft_rows_dense_helper_matches(monkeypatch):
+    """SRTB_PALLAS_ROWS=dense (the dot_general spelling) must be the
+    same transform as the classic helper, plain and stats variants."""
+    import numpy as np
+
+    rng = np.random.default_rng(77)
+    x = (rng.standard_normal((8, 1 << 13))
+         + 1j * rng.standard_normal((8, 1 << 13))).astype(np.complex64)
+    base = np.asarray(PF.fft_rows(jnp.asarray(x), interpret=INTERPRET))
+    monkeypatch.setenv("SRTB_PALLAS_ROWS", "dense")
+    got = np.asarray(PF.fft_rows(jnp.asarray(x), interpret=INTERPRET))
+    scale = np.abs(base).max()
+    assert np.abs(got - base).max() / scale < 2e-6
+    re, im, s2, s4 = PF.fft_rows_stats_ri(
+        jnp.real(jnp.asarray(x)), jnp.imag(jnp.asarray(x)),
+        inverse=True, interpret=INTERPRET)
+    want = np.asarray(jnp.fft.ifft(x, norm="forward"))
+    got2 = np.asarray(re) + 1j * np.asarray(im)
+    assert np.abs(got2 - want).max() / np.abs(want).max() < 5e-6
+    p = np.abs(got2) ** 2
+    np.testing.assert_allclose(np.asarray(s2).sum(-1), p.sum(-1),
+                               rtol=1e-4)

@@ -68,6 +68,9 @@ run pallas2_small_blk env SRTB_BENCH_FFT_STRATEGY=pallas2 SRTB_PALLAS2_BB=64 \
 run pallas2_rowspell env SRTB_BENCH_FFT_STRATEGY=pallas2 \
     SRTB_PALLAS2_P1=row SRTB_PALLAS2_ROWS=classic \
     SRTB_BENCH_DEADLINE=900 python bench.py
+# dense-helper A/B on the PROVEN waterfall/SK row kernels
+run pallas_dense env SRTB_BENCH_USE_PALLAS=1 SRTB_BENCH_USE_PALLAS_SK=1 \
+    SRTB_PALLAS_ROWS=dense SRTB_BENCH_DEADLINE=900 python bench.py
 # everything-fused flagship: two-pass FFT + fused RFI/chirp + fused
 # waterfall/SK stats
 run pallas2_full env SRTB_BENCH_FFT_STRATEGY=pallas2 SRTB_BENCH_USE_PALLAS=1 \
