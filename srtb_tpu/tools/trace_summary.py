@@ -1,0 +1,204 @@
+"""Summarize an xprof/XLA profiler trace into per-op and per-stage time.
+
+The reference's profiling story is ad-hoc timing logs
+(ref: SURVEY.md §5.1, e.g. udp_receiver_pipe.hpp:130-153 push-time
+measurement); on TPU the native tool is the jax profiler's xplane trace
+(`SRTB_BENCH_TRACE_DIR`), but the official converter
+(tensorboard_plugin_profile) is version-locked to its TensorFlow build
+and unusable in this image.  This tool reads the `.xplane.pb` wire
+format directly — XSpace > XPlane > XLine > XEvent plus the metadata
+maps are plain nested length-delimited messages, so a ~100-line stdlib
+varint parser is enough and can never rot against a protobuf runtime.
+
+Output: one JSON line per device plane with total time bucketed into
+pipeline stages (fft / unpack / rfi+chirp / waterfall+sk / detect /
+transpose+copy / other — matched on XLA fusion names), then the top-N
+ops.  This is the "profile per-stage, then attack the dominant pass"
+loop of PERF.md, automated.
+
+Usage: python -m srtb_tpu.tools.trace_summary TRACE_DIR_OR_PB [--top N]
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import re
+import sys
+
+# ---- minimal protobuf wire-format reader (varint + length-delimited) ----
+
+
+def _varint(buf: memoryview, i: int):
+    x = 0
+    shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        x |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return x, i
+        shift += 7
+
+
+def _fields(buf: memoryview):
+    """Yield (field_number, wire_type, value) over one message.  LEN
+    fields yield memoryviews; varints yield ints; 32/64-bit yield raw
+    bytes (unused here but must be skipped correctly)."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        tag, i = _varint(buf, i)
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _varint(buf, i)
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = buf[i:i + 4]
+            i += 4
+        elif wt == 1:
+            v = buf[i:i + 8]
+            i += 8
+        else:  # groups (3/4) never appear in xplane
+            raise ValueError(f"unsupported wire type {wt}")
+        yield field, wt, v
+
+
+def _map_entry(buf: memoryview):
+    """protobuf map<int64, Message> entry: key=1 varint, value=2 LEN."""
+    key, val = 0, b""
+    for f, _, v in _fields(buf):
+        if f == 1:
+            key = v
+        elif f == 2:
+            val = v
+    return key, val
+
+
+def _name_of(meta_buf: memoryview) -> str:
+    """XEventMetadata / XStatMetadata: name = field 2 (string)."""
+    for f, wt, v in _fields(meta_buf):
+        if f == 2 and wt == 2:
+            return bytes(v).decode("utf-8", "replace")
+    return ""
+
+
+def parse_xspace(path: str):
+    """-> [(plane_name, {op_name: total_duration_ps})]"""
+    raw = memoryview(pathlib.Path(path).read_bytes())
+    planes = []
+    for f, wt, plane in _fields(raw):
+        if f != 1 or wt != 2:   # XSpace.planes
+            continue
+        name = ""
+        meta: dict[int, str] = {}
+        lines = []
+        for pf, pwt, pv in _fields(plane):
+            if pf == 2 and pwt == 2:        # XPlane.name
+                name = bytes(pv).decode("utf-8", "replace")
+            elif pf == 3 and pwt == 2:      # XPlane.lines
+                lines.append(pv)
+            elif pf == 4 and pwt == 2:      # XPlane.event_metadata
+                k, v = _map_entry(pv)
+                meta[k] = _name_of(memoryview(v))
+        ops: dict[str, int] = {}
+        for line in lines:
+            for lf, lwt, lv in _fields(line):
+                if lf != 4 or lwt != 2:     # XLine.events
+                    continue
+                mid, dur = 0, 0
+                for ef, _, ev in _fields(lv):
+                    if ef == 1:             # XEvent.metadata_id
+                        mid = ev
+                    elif ef == 3:           # XEvent.duration_ps
+                        dur = ev
+                op = meta.get(mid, f"#{mid}")
+                ops[op] = ops.get(op, 0) + dur
+        planes.append((name, ops))
+    return planes
+
+
+# ---- stage bucketing (XLA fusion/op names -> pipeline stages) ----
+
+_BUCKETS = [
+    ("fft", re.compile(r"fft|dft", re.I)),
+    # the Pallas kernels carry their python function names
+    ("pallas_fft", re.compile(r"fft_rows|pass1|pass2|mxu", re.I)),
+    ("unpack+pack", re.compile(r"unpack|planes|pack|convert|bitcast", re.I)),
+    ("rfi+chirp", re.compile(r"rfi|chirp|dedisperse|zap", re.I)),
+    ("waterfall+sk", re.compile(r"waterfall|sk_|kurtosis|stats", re.I)),
+    ("detect", re.compile(r"detect|boxcar|time_series|cumsum|reduce-window",
+                          re.I)),
+    ("transpose/copy", re.compile(r"transpose|copy|reshape|concatenate|"
+                                  r"slice|gather|dynamic", re.I)),
+]
+
+
+def bucket(op: str) -> str:
+    for name, pat in _BUCKETS:
+        if pat.search(op):
+            return name
+    return "other"
+
+
+def summarize(path: str, top: int = 15):
+    """One summary dict per plane that carries events."""
+    out = []
+    for plane, ops in parse_xspace(path):
+        if not ops:
+            continue
+        total = sum(ops.values())
+        if total == 0:
+            continue
+        stages: dict[str, int] = {}
+        for op, dur in ops.items():
+            b = bucket(op)
+            stages[b] = stages.get(b, 0) + dur
+        top_ops = sorted(ops.items(), key=lambda kv: -kv[1])[:top]
+        out.append({
+            "plane": plane,
+            "total_ms": round(total / 1e9, 3),
+            "stages_ms": {k: round(v / 1e9, 3)
+                          for k, v in sorted(stages.items(),
+                                             key=lambda kv: -kv[1])},
+            "top_ops": [{"op": op[:120], "ms": round(d / 1e9, 3),
+                         "pct": round(100.0 * d / total, 1)}
+                        for op, d in top_ops],
+        })
+    return out
+
+
+def find_xplanes(root: str):
+    p = pathlib.Path(root)
+    if p.is_file():
+        return [p]
+    return sorted(p.rglob("*.xplane.pb"))
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    top = 15
+    if "--top" in argv:
+        i = argv.index("--top")
+        top = int(argv[i + 1])
+        del argv[i:i + 2]
+    if not argv:
+        print("usage: trace_summary TRACE_DIR_OR_PB [--top N]",
+              file=sys.stderr)
+        return 2
+    paths = find_xplanes(argv[0])
+    if not paths:
+        print(json.dumps({"error": f"no .xplane.pb under {argv[0]}"}))
+        return 1
+    for path in paths:
+        for summary in summarize(str(path), top):
+            summary["file"] = str(path)
+            print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -264,3 +264,47 @@ def test_e2e_live_harness_smoke(tmp_path):
     # deadline armed for real above (60 s >> per-segment time): reaching
     # the artifact line at all is the no-hit evidence
     assert rec["deadline_s"] == 60
+
+
+def test_trace_summary_wire_parser():
+    """The hand-rolled xplane wire parser against a hand-built message:
+    XSpace{planes=[XPlane{name, event_metadata{1: "fusion.1"},
+    lines=[XLine{events=[XEvent{metadata_id=1, duration_ps=...}]}]}]}."""
+    from srtb_tpu.tools import trace_summary as TS
+
+    def varint(x):
+        out = b""
+        while True:
+            b7 = x & 0x7F
+            x >>= 7
+            out += bytes([b7 | (0x80 if x else 0)])
+            if not x:
+                return out
+
+    def ld(field, payload):
+        return varint((field << 3) | 2) + varint(len(payload)) + payload
+
+    def vi(field, value):
+        return varint(field << 3) + varint(value)
+
+    meta = vi(1, 1) + ld(2, b"fusion.1")          # XEventMetadata
+    entry = vi(1, 1) + ld(2, meta)                # map entry key/value
+    ev1 = vi(1, 1) + vi(3, 5_000_000)             # XEvent 5 us
+    ev2 = vi(1, 1) + vi(3, 7_000_000)             # XEvent 7 us
+    line = ld(4, ev1) + ld(4, ev2)                # XLine.events
+    plane = ld(2, b"/device:TPU:0") + ld(3, line) + ld(4, entry)
+    space = ld(1, plane)
+
+    import pathlib
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        p = pathlib.Path(td) / "t.xplane.pb"
+        p.write_bytes(space)
+        planes = TS.parse_xspace(str(p))
+        assert planes == [("/device:TPU:0", {"fusion.1": 12_000_000})]
+        s = TS.summarize(str(p))
+        assert s[0]["plane"] == "/device:TPU:0"
+        assert s[0]["total_ms"] == 0.012
+    assert TS.bucket("fusion.fft.3") == "fft"
+    assert TS.bucket("rfi_s1_dedisperse_df64") == "rfi+chirp"
+    assert TS.bucket("loop_transpose_fusion") == "transpose/copy"

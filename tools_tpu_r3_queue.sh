@@ -76,6 +76,17 @@ run pallas_dense env SRTB_BENCH_USE_PALLAS=1 SRTB_BENCH_USE_PALLAS_SK=1 \
 run pallas2_full env SRTB_BENCH_FFT_STRATEGY=pallas2 SRTB_BENCH_USE_PALLAS=1 \
     SRTB_BENCH_USE_PALLAS_SK=1 SRTB_BENCH_DEADLINE=900 python bench.py
 
+# per-stage attribution of the baseline trace captured above
+echo "== trace summary (baseline) =="
+python -m srtb_tpu.tools.trace_summary /tmp/r3_trace_baseline --top 10 \
+    2>/dev/null \
+  | while read -r line; do
+      case "$line" in {*)
+        echo "{\"ts\": \"$(stamp)\", \"variant\": \"trace_summary\", \"result\": $line}" >> "$OUT"
+        echo "$line";;
+      esac
+    done
+
 # ---- 1b. blocked-plane Pallas unpack: Mosaic acceptance probe ----
 # (flip ops/pallas_kernels.PLANES_UNPACK_MOSAIC_OK to True if this
 # compiles and matches — the spelling avoids the sample-order kernel's
