@@ -86,8 +86,30 @@ def _name_of(meta_buf: memoryview) -> str:
     return ""
 
 
+def _event_str_stats(ev_buf: memoryview, stat_names: dict[int, str]):
+    """XEvent.stats (field 4): {stat_name: str_value} for string stats —
+    on TPU device planes xprof attaches e.g. hlo_category / hlo_op to
+    every event, which names opaque "fusion.N" events semantically."""
+    out = {}
+    for f, wt, v in _fields(ev_buf):
+        if f != 4 or wt != 2:
+            continue
+        sid, sval = 0, None
+        for sf, swt, sv in _fields(v):
+            if sf == 1 and swt == 0:        # XStat.metadata_id
+                sid = sv
+            elif sf == 5 and swt == 2:      # XStat.str_value
+                sval = bytes(sv).decode("utf-8", "replace")
+        if sval is not None and sid in stat_names:
+            out[stat_names[sid]] = sval
+    return out
+
+
 def parse_xspace(path: str):
-    """-> [(plane_name, {op_name: total_duration_ps})]"""
+    """-> [(plane_name, {(op_name, hlo_category): total_duration_ps})]
+
+    hlo_category is "" when the trace carries no per-event category
+    stat (host planes, CPU traces)."""
     raw = memoryview(pathlib.Path(path).read_bytes())
     planes = []
     for f, wt, plane in _fields(raw):
@@ -95,6 +117,7 @@ def parse_xspace(path: str):
             continue
         name = ""
         meta: dict[int, str] = {}
+        stat_names: dict[int, str] = {}
         lines = []
         for pf, pwt, pv in _fields(plane):
             if pf == 2 and pwt == 2:        # XPlane.name
@@ -104,7 +127,12 @@ def parse_xspace(path: str):
             elif pf == 4 and pwt == 2:      # XPlane.event_metadata
                 k, v = _map_entry(pv)
                 meta[k] = _name_of(memoryview(v))
-        ops: dict[str, int] = {}
+            elif pf == 5 and pwt == 2:      # XPlane.stat_metadata
+                k, v = _map_entry(pv)
+                stat_names[k] = _name_of(memoryview(v))
+        want_stats = {sid for sid, nm in stat_names.items()
+                      if nm in ("hlo_category", "hlo_op")}
+        ops: dict[tuple[str, str], int] = {}
         for line in lines:
             for lf, lwt, lv in _fields(line):
                 if lf != 4 or lwt != 2:     # XLine.events
@@ -115,8 +143,12 @@ def parse_xspace(path: str):
                         mid = ev
                     elif ef == 3:           # XEvent.duration_ps
                         dur = ev
-                op = meta.get(mid, f"#{mid}")
-                ops[op] = ops.get(op, 0) + dur
+                cat = ""
+                if want_stats:
+                    stats = _event_str_stats(lv, stat_names)
+                    cat = stats.get("hlo_category", "")
+                key = (meta.get(mid, f"#{mid}"), cat)
+                ops[key] = ops.get(key, 0) + dur
         planes.append((name, ops))
     return planes
 
@@ -137,10 +169,17 @@ _BUCKETS = [
 ]
 
 
-def bucket(op: str) -> str:
+def bucket(op: str, category: str = "") -> str:
+    """Prefer the per-event hlo_category stat (semantic even for opaque
+    "fusion.N" names on TPU device planes); fall back to name regexes."""
     for name, pat in _BUCKETS:
         if pat.search(op):
             return name
+    if category:
+        for name, pat in _BUCKETS:
+            if pat.search(category):
+                return name
+        return f"hlo:{category}"
     return "other"
 
 
@@ -154,8 +193,8 @@ def summarize(path: str, top: int = 15):
         if total == 0:
             continue
         stages: dict[str, int] = {}
-        for op, dur in ops.items():
-            b = bucket(op)
+        for (op, cat), dur in ops.items():
+            b = bucket(op, cat)
             stages[b] = stages.get(b, 0) + dur
         top_ops = sorted(ops.items(), key=lambda kv: -kv[1])[:top]
         out.append({
@@ -164,9 +203,11 @@ def summarize(path: str, top: int = 15):
             "stages_ms": {k: round(v / 1e9, 3)
                           for k, v in sorted(stages.items(),
                                              key=lambda kv: -kv[1])},
-            "top_ops": [{"op": op[:120], "ms": round(d / 1e9, 3),
+            "top_ops": [{"op": op[:120],
+                         **({"cat": cat} if cat else {}),
+                         "ms": round(d / 1e9, 3),
                          "pct": round(100.0 * d / total, 1)}
-                        for op, d in top_ops],
+                        for (op, cat), d in top_ops],
         })
     return out
 

@@ -289,10 +289,14 @@ def test_trace_summary_wire_parser():
 
     meta = vi(1, 1) + ld(2, b"fusion.1")          # XEventMetadata
     entry = vi(1, 1) + ld(2, meta)                # map entry key/value
-    ev1 = vi(1, 1) + vi(3, 5_000_000)             # XEvent 5 us
-    ev2 = vi(1, 1) + vi(3, 7_000_000)             # XEvent 7 us
+    smeta = vi(1, 9) + ld(2, b"hlo_category")     # XStatMetadata
+    sentry = vi(1, 9) + ld(2, smeta)
+    stat = vi(1, 9) + ld(5, b"convolution")       # XStat.str_value
+    ev1 = vi(1, 1) + vi(3, 5_000_000) + ld(4, stat)   # XEvent 5 us
+    ev2 = vi(1, 1) + vi(3, 7_000_000) + ld(4, stat)   # XEvent 7 us
     line = ld(4, ev1) + ld(4, ev2)                # XLine.events
-    plane = ld(2, b"/device:TPU:0") + ld(3, line) + ld(4, entry)
+    plane = (ld(2, b"/device:TPU:0") + ld(3, line) + ld(4, entry)
+             + ld(5, sentry))
     space = ld(1, plane)
 
     import pathlib
@@ -301,10 +305,15 @@ def test_trace_summary_wire_parser():
         p = pathlib.Path(td) / "t.xplane.pb"
         p.write_bytes(space)
         planes = TS.parse_xspace(str(p))
-        assert planes == [("/device:TPU:0", {"fusion.1": 12_000_000})]
+        assert planes == [("/device:TPU:0",
+                           {("fusion.1", "convolution"): 12_000_000})]
         s = TS.summarize(str(p))
         assert s[0]["plane"] == "/device:TPU:0"
         assert s[0]["total_ms"] == 0.012
+        assert s[0]["top_ops"][0]["cat"] == "convolution"
     assert TS.bucket("fusion.fft.3") == "fft"
     assert TS.bucket("rfi_s1_dedisperse_df64") == "rfi+chirp"
     assert TS.bucket("loop_transpose_fusion") == "transpose/copy"
+    # opaque fusion name + semantic category -> category decides
+    assert TS.bucket("fusion.42", "fft") == "fft"
+    assert TS.bucket("fusion.42", "elementwise") == "hlo:elementwise"
