@@ -59,7 +59,17 @@ line=$(grep '^{' /tmp/pallas2_probe.json 2>/dev/null | tail -1)
 echo "{\"ts\": \"$(stamp)\", \"variant\": \"pallas2_mosaic_probe\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$OUT"
 # First pipeline exposure: bound it so a Mosaic/VMEM failure can't eat
 # the queue; if VMEM overflows, retry with smaller blocks.
-run pallas2     env SRTB_BENCH_FFT_STRATEGY=pallas2 SRTB_BENCH_DEADLINE=900 python bench.py
+run pallas2     env SRTB_BENCH_FFT_STRATEGY=pallas2 SRTB_BENCH_DEADLINE=900 \
+    SRTB_BENCH_TRACE_DIR=/tmp/r3_trace_pallas2 python bench.py
+echo "== trace summary (pallas2) =="
+python -m srtb_tpu.tools.trace_summary /tmp/r3_trace_pallas2 --top 10 \
+    2>/dev/null \
+  | while read -r line; do
+      case "$line" in {*)
+        echo "{\"ts\": \"$(stamp)\", \"variant\": \"trace_summary_pallas2\", \"result\": $line}" >> "$OUT"
+        echo "$line";;
+      esac
+    done
 run pallas2_small_blk env SRTB_BENCH_FFT_STRATEGY=pallas2 SRTB_PALLAS2_BB=64 \
     SRTB_PALLAS2_RB=8 SRTB_BENCH_DEADLINE=900 python bench.py
 # alternate Mosaic lowering of the same math (transpose-to-rows +
