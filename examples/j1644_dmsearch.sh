@@ -1,0 +1,29 @@
+#!/bin/bash
+# DM-grid search over the synthetic J1644-4559 observation: the
+# companion to j1644_synthetic.sh for the repo's scale-out addition
+# (--dm_list trials sharded over the device mesh, SURVEY.md §2.9).
+# A pulse dispersed at DM -478.80 is searched over an 8-trial grid; the
+# S/N curve must peak at the injected DM (decoherence kills the
+# mismatched trials).  artifacts/j1644_dm_curve.png is exactly this run.
+set -eu
+DIR=${1:-/tmp/j1644dm}
+mkdir -p "$DIR"
+
+python -m srtb_tpu.tools.make_baseband --out "$DIR/bb.bin" \
+  --n "2**24" --freq_low "1405+32" --bandwidth " -64" --dm " -478.80" \
+  --pulses "2**23" --nbits 2 --pulse_amp 40 --seed 3
+
+python -m srtb_tpu.tools.main \
+  --input_file_path "$DIR/bb.bin" \
+  --baseband_input_count "2 ** 24" --baseband_input_bits 2 \
+  --baseband_format_type simple --baseband_freq_low "1405 + 32" \
+  --baseband_bandwidth " -64" --baseband_sample_rate 128e6 \
+  --dm_list " -380, -430, -465, -478.80, -495, -530, -580, -650" \
+  --spectrum_channel_count "2 ** 11" \
+  --baseband_output_file_prefix "$DIR/out_" \
+  --signal_detect_signal_noise_threshold 8 --baseband_reserve_sample 0 \
+  --mitigate_rfi_spectral_kurtosis_threshold 1.05
+
+python -m srtb_tpu.tools.plot_dm_curve "$DIR/out_dm_trials.jsonl" \
+  "$DIR/dm_curve.png"
+ls -la "$DIR"/dm_curve.png

@@ -317,3 +317,20 @@ def test_trace_summary_wire_parser():
     # opaque fusion name + semantic category -> category decides
     assert TS.bucket("fusion.42", "fft") == "fft"
     assert TS.bucket("fusion.42", "elementwise") == "hlo:elementwise"
+
+
+def test_plot_dm_curve(tmp_path):
+    """The DM-search acceptance plot renders from a trials record."""
+    import json
+
+    from srtb_tpu.tools import plot_dm_curve as PD
+
+    rec = {"segment": 0, "timestamp": 0, "best_dm": -478.8,
+           "best_snr": 60.0, "dm_list": [-400.0, -478.8, -550.0],
+           "peak_snr": [5.0, 60.0, 6.0], "signal_counts": [0, 9, 0],
+           "zero_counts": [0, 0, 0]}
+    trials = tmp_path / "out_dm_trials.jsonl"
+    trials.write_text(json.dumps(rec) + "\n")
+    out = PD.plot(str(trials))
+    data = open(out, "rb").read()
+    assert data[:8] == b"\x89PNG\r\n\x1a\n"
