@@ -136,3 +136,16 @@ def test_fourstep_twiddle_precision_at_window_edge():
         want = np.exp(-2j * np.pi * (d * k1).astype(np.float64) / m)
         err = np.abs((np.asarray(wr) + 1j * np.asarray(wi)) - want).max()
         assert err < 2e-6, (j2_0, err)
+
+
+def test_fft2_asymmetric_factorization():
+    """m = 2^25 factors 4096 x 8192 (n2 != n1, lb2=64) — the asymmetric
+    shape every production size [2^25, 2^29] uses; the symmetric
+    m = 2^24 tests alone would never exercise distinct leg lengths or
+    the rectangular four-step twiddle."""
+    m = 1 << 25
+    assert PF2._factor(m) == (4096, 8192)
+    x = _rand_c64(m, 41)
+    want = np.fft.fft(x.astype(np.complex128))
+    got = np.asarray(PF2.fft2_c2c(jnp.asarray(x), interpret=INTERPRET))
+    assert np.abs(got - want).max() / np.abs(want).max() < 2e-5
