@@ -30,5 +30,6 @@ python -m srtb_tpu.tools.main \
   --signal_detect_signal_noise_threshold 8 --baseband_reserve_sample 0 \
   --mitigate_rfi_spectral_kurtosis_threshold 1.05
 
-(cd "$DIR" && python -m srtb_tpu.tools.plot_spectrum "out_*.0.npy")
+# run from the repo root (srtb_tpu importable); glob handles the paths
+python -m srtb_tpu.tools.plot_spectrum "$DIR/out_*.0.npy"
 ls -la "$DIR"/*.png
