@@ -516,3 +516,41 @@ def test_staged_pallas2_all_fusions_flagship(monkeypatch):
     assert proc._staged_impl() == "pallas2_interpret"
     got = waterfall_to_numpy(proc.process(raw)[0])
     np.testing.assert_allclose(got, base, rtol=2e-3, atol=2e-4)
+
+
+def test_staged_pallas2_blocked_2bit_production_format(monkeypatch):
+    """The staged_blocked_pallas2 queue probe's exact composition in
+    miniature: 2-bit blocked planes (p = 2 packed plane pairs, the
+    J1644 production format) with fused two-pass legs across the staged
+    (a)/(b) boundary, at the smallest in-window leg (n = 2^26,
+    M = n/4 = 2^24 per plane)."""
+    import numpy as np
+
+    from srtb_tpu.config import Config
+    from srtb_tpu.pipeline.segment import SegmentProcessor, \
+        waterfall_to_numpy
+
+    cfg = Config(
+        baseband_input_count=1 << 26,
+        baseband_input_bits=2,
+        baseband_format_type="simple",
+        baseband_freq_low=1405.0,
+        baseband_bandwidth=64.0,
+        baseband_sample_rate=128e6,
+        dm=30.0,
+        spectrum_channel_count=1 << 10,
+        mitigate_rfi_average_method_threshold=1e9,
+        mitigate_rfi_spectral_kurtosis_threshold=1e9,
+        baseband_reserve_sample=False,
+    )
+    rng = np.random.default_rng(37)
+    raw = rng.integers(0, 256, cfg.segment_bytes(1), dtype=np.uint8)
+    monkeypatch.setenv("SRTB_STAGED_BLOCKED", "1")
+    monkeypatch.delenv("SRTB_STAGED_ROWS_IMPL", raising=False)
+    base = waterfall_to_numpy(
+        SegmentProcessor(cfg, staged=True).process(raw)[0])
+    monkeypatch.setenv("SRTB_STAGED_ROWS_IMPL", "pallas2")
+    proc = SegmentProcessor(cfg, staged=True)
+    assert proc._staged_impl() == "pallas2_interpret"
+    got = waterfall_to_numpy(proc.process(raw)[0])
+    np.testing.assert_allclose(got, base, rtol=2e-3, atol=2e-4)
