@@ -143,6 +143,14 @@ def roofline_model(n: int, channel_count: int, nbits: int):
     return flops, bytes_moved
 
 
+def baseline_pass(on_accel: bool, realtime_factor: float) -> bool:
+    """The BASELINE.md gate (>= 1x real-time on one accelerator chip) as
+    an explicit artifact field, so a perf regression cannot land looking
+    green.  A CPU fallback is a fail by definition — the target names
+    the chip."""
+    return bool(on_accel and realtime_factor >= 1.0)
+
+
 def run_bench(platform_error):
     import jax
 
@@ -260,6 +268,7 @@ def run_bench(platform_error):
         # fallback measurement has no v5e roofline to be a fraction of
         out["roofline_frac"] = round(bytes_moved / dt / 1e9
                                      / V5E_HBM_PEAK_GBPS, 3)
+    out["pass"] = baseline_pass(on_accel, realtime_factor)
     if platform_error:
         out["accelerator_error"] = platform_error
     emit(out)
@@ -283,6 +292,7 @@ def _arm_watchdog(platform, err):
             "value": 0.0,
             "unit": "Msamples/s/chip",
             "vs_baseline": 0.0,
+            "pass": False,
             "error": f"bench deadline exceeded ({deadline:.0f}s): "
                      "backend hang mid-run (wedged tunnel?)",
             "platform": platform,
@@ -312,6 +322,7 @@ def main():
             "value": 0.0,
             "unit": "Msamples/s/chip",
             "vs_baseline": 0.0,
+            "pass": False,
             "error": f"{type(e).__name__}: {e}"[:500],
             "platform": platform,
             "accelerator_error": err,

@@ -7,8 +7,22 @@
 # multichip dryrun smoke).
 #
 # Usage: ./ci.sh [--fast]   (--fast skips the slowest pytest cases)
+#        ./ci.sh --hardware (arm the TPU watcher: probes the tunnel and
+#                            fires the hardware queue on recovery — the
+#                            repo-tracked re-arm path, round-3 verdict)
 set -euo pipefail
 cd "$(dirname "$0")"
+
+if [ "${1:-}" = "--hardware" ]; then
+  [ -f tools_tpu_watcher.sh ] || { echo "tools_tpu_watcher.sh missing" >&2; exit 1; }
+  if [ -f /tmp/tpu_watcher.pid ] && kill -0 "$(cat /tmp/tpu_watcher.pid)" 2>/dev/null; then
+    echo "TPU watcher already running (pid $(cat /tmp/tpu_watcher.pid))"
+    exit 0
+  fi
+  nohup bash tools_tpu_watcher.sh >/dev/null 2>&1 &
+  echo "TPU watcher armed (pid $!, log ${SRTB_WATCH_LOG:-/tmp/tpu_watcher.log})"
+  exit 0
+fi
 
 echo "== [1/6] native build =="
 make -C srtb_tpu/native

@@ -38,10 +38,23 @@ input[type=range]{{vertical-align:middle}}
 </style></head>
 <body><h2>srtb_tpu spectrum waterfall</h2>
 <div id="metrics">metrics: …</div>
-{body}
+<div id="panes">{body}</div>
 <script>
 "use strict";
 const panes = {{}};   // stream -> {{paused, pos, frames, img, slider, label}}
+// server-rendered pane markup with __S__ placeholders, so a stream that
+// starts publishing only after page load still gets a pane (round-3
+// advisor catch: tick() used to skip unknown streams forever)
+const PANE_HTML = {pane_js};
+function addPane(s) {{
+  const host = document.createElement("div");
+  host.innerHTML = PANE_HTML.replaceAll("__S__", s);
+  // no frame name yet: drop the placeholder src (setFrame fills it on
+  // the same tick) rather than fetching "/" into the <img>
+  host.querySelector("img").removeAttribute("src");
+  document.getElementById("panes").appendChild(host.firstElementChild);
+  wire(s);
+}}
 function setFrame(s) {{
   const p = panes[s];
   if (!p.frames.length) return;
@@ -94,7 +107,7 @@ async function tick() {{
     const r = await fetch("/frames.json");
     const data = await r.json();
     for (const s in data.streams) {{
-      if (!(s in panes)) continue;
+      if (!(s in panes)) addPane(s);
       const p = panes[s];
       p.frames = data.streams[s];
       if (!p.paused) p.pos = Math.max(0, p.frames.length - 1);
@@ -207,7 +220,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 body = ('<p>no frames yet (panes appear on first '
                         'refresh with data)</p>'
                         '<meta http-equiv="refresh" content="2">')
-            data = _INDEX_TEMPLATE.format(body=body).encode()
+            pane_js = json.dumps(
+                _PANE_TEMPLATE.format(s="__S__", name=""))
+            data = _INDEX_TEMPLATE.format(body=body,
+                                          pane_js=pane_js).encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/html")
             self.send_header("Content-Length", str(len(data)))

@@ -73,36 +73,103 @@ def supported(m: int) -> bool:
     return _factor(m) is not None
 
 
-def _rows_budget(length: int, dense: bool) -> int:
-    """Rows per grid step for an in-VMEM leg FFT of this length, sized
-    from the dominant stage intermediate at ~1 MB per f32 plane
-    (several such arrays + in/out blocks + consts must coexist in
-    ~16 MB of VMEM).  The dense dot_general spellings keep every
-    intermediate at la*rows*lb words exactly; the classic spelling's
-    [la, rows, lb] stages lane-pad lb -> 128 — a real VMEM cost that
-    shrinks the block, and with it the strided-DMA segment width
-    (rows*4 B), so dense earns its larger blocks twice over."""
-    la, lb = PF._split_la_lb(length)
-    per_row = la * (lb if dense else max(lb, 128))
-    return max(8, min(128, (1 << 18) // per_row))
+def _vmem_budget() -> int:
+    """Total VMEM bytes each kernel's plan may assume.  The round-2
+    measurements ran on v5e, whose physical VMEM is 128 MiB/core;
+    Mosaic's *default* scoped-vmem limit is far lower, so both
+    pallas_calls pass an explicit ``vmem_limit_bytes`` alongside blocks
+    sized by the padded-footprint model below.  Default 80 MiB leaves
+    headroom for Mosaic internal scratch; SRTB_PALLAS2_VMEM_MB is the
+    hardware A/B knob (a 16 MiB-era budget cannot fit ANY pass-1 block:
+    the padded minimum 2*4*n1*128*4 B is 16 MiB at n1=4096 alone)."""
+    return int(os.environ.get("SRTB_PALLAS2_VMEM_MB", "80")) << 20
 
 
-def _block_cols(n1: int) -> int:
-    """Pass-1 column-block width (= rows of the in-kernel leg FFT);
-    overridable for hardware tuning."""
+def _leg_const_bytes(la: int, lb: int) -> int:
+    """Padded VMEM bytes of the six leg-FFT constant refs
+    (war/wai [la,la], wbr/wbi [lb,lb], twr/twi [la,lb]) — lb < 128
+    lane-pads its minor dim."""
+    plb = max(lb, 128)
+    return 4 * (2 * la * la + 2 * lb * plb + 2 * la * plb)
+
+
+def _pass1_bytes(n1: int, bb: int, spelling: str, dense: bool) -> int:
+    """Padded-VMEM footprint model for one pass-1 grid step: the four
+    [n1, bb] block refs are double-buffered by the Pallas pipeline and
+    lane-pad bb -> 128 (the round-3 review catch: logical-words sizing
+    undercounted small-bb blocks 4x at n1=8192), plus the peak live
+    kernel intermediates per spelling, plus the leg consts."""
+    la, lb = PF._split_la_lb(n1)
+    refs = 2 * 4 * n1 * max(bb, 128) * 4
+    if spelling == "col":
+        # dense [lb, bb, la]/[bb, la, lb] stages; stage-2 outputs carry
+        # minor dim lb (pads to 128), the final relayout minor dim bb
+        live = (4 * la * lb * bb * 4
+                + 2 * bb * la * max(lb, 128) * 4
+                + 2 * n1 * max(bb, 128) * 4)
+    elif dense:
+        # transposed [bb, n1] row pair + the dense helper's stages
+        live = 8 * bb * n1 * 4
+    else:
+        # classic helper: [la, rows, lb] stages lane-pad lb -> 128
+        live = 2 * bb * n1 * 4 + 6 * la * bb * max(lb, 128) * 4
+    return refs + live + _leg_const_bytes(la, lb)
+
+
+def _pass2_bytes(n2: int, rb: int, dense: bool) -> int:
+    """Same model for one pass-2 grid step: [rb, n2] blocks are already
+    lane-dense (rb is the sublane dim, min tile 8) — only the helper
+    stages with minor dim lb = n2/128 pad on the small-n2 end."""
+    la, lb = PF._split_la_lb(n2)
+    refs = 2 * 4 * max(rb, 8) * n2 * 4
+    if dense:
+        live = 6 * rb * n2 * 4 + 2 * rb * la * max(lb, 128) * 4
+    else:
+        live = 6 * la * rb * max(lb, 128) * 4
+    return refs + live + _leg_const_bytes(la, lb)
+
+
+def _pick_block(candidates, fits, floor: int) -> int:
+    """Largest candidate whose modeled footprint fits the budget; the
+    floor (the minimum meaningful block) when none does — shrinking
+    below it cannot reduce the padded refs, so a non-fitting floor is a
+    hardware question for vmem_limit_bytes, not a sizing one."""
+    for c in candidates:
+        if fits(c):
+            return c
+    return floor
+
+
+def _block_cols(n1: int, n2: int) -> int:
+    """Pass-1 column-block width (= rows of the in-kernel leg FFT):
+    largest power-of-two divisor of n2 in [128, 1024] that fits the
+    padded-footprint budget.  bb >= 128 always — below that the block's
+    lane padding keeps VMEM cost flat while throwing away strided-DMA
+    width.  SRTB_PALLAS2_BB overrides absolutely (hardware tuning)."""
     env = os.environ.get("SRTB_PALLAS2_BB")
     if env:
         return int(env)
-    dense = _p1_spelling() == "col" or _rows_helper() is not PF.vmem_fft_rows
-    return _rows_budget(n1, dense)
+    spelling = _p1_spelling()
+    dense = _rows_helper() is not PF.vmem_fft_rows
+    budget = _vmem_budget()
+    cands = [c for c in (1024, 512, 256, 128) if n2 % c == 0]
+    return _pick_block(
+        cands, lambda c: _pass1_bytes(n1, c, spelling, dense) <= budget,
+        128)
 
 
-def _block_rows(n2: int) -> int:
-    """Pass-2 row-block height, same budget."""
+def _block_rows(n2: int, n1: int) -> int:
+    """Pass-2 row-block height: largest power-of-two divisor of n1 in
+    [8, 256] that fits the budget (rb is the sublane dim — lane-dense
+    at any size, so small rb is cheap and correct here)."""
     env = os.environ.get("SRTB_PALLAS2_RB")
     if env:
         return int(env)
-    return _rows_budget(n2, _rows_helper() is not PF.vmem_fft_rows)
+    dense = _rows_helper() is not PF.vmem_fft_rows
+    budget = _vmem_budget()
+    cands = [c for c in (256, 128, 64, 32, 16, 8) if n1 % c == 0]
+    return _pick_block(
+        cands, lambda c: _pass2_bytes(n2, c, dense) <= budget, 8)
 
 
 def _phase_cos_sin(r, m: int, sign: float):
@@ -230,7 +297,7 @@ def pass1_2d(re2, im2, inverse: bool = False, interpret: bool = False):
     n1, n2 = re2.shape
     m = n1 * n2
     sign = 1.0 if inverse else -1.0
-    bb = _block_cols(n1)
+    bb = _block_cols(n1, n2)
     if n2 % bb:
         raise ValueError(f"pass-1 block {bb} must divide n2={n2}")
     la1, lb1, consts1 = PF.leg_consts(n1, inverse)
@@ -240,6 +307,10 @@ def pass1_2d(re2, im2, inverse: bool = False, interpret: bool = False):
                            m=m, sign=sign, spelling=_p1_spelling(),
                            rows_helper=_rows_helper())
     mid_shape = jax.ShapeDtypeStruct((n1, n2), jnp.float32)
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=_vmem_budget())
     return pl.pallas_call(
         k1,
         grid=(n2 // bb,),
@@ -247,6 +318,7 @@ def pass1_2d(re2, im2, inverse: bool = False, interpret: bool = False):
         out_specs=[col_block, col_block],
         out_shape=[mid_shape, mid_shape],
         interpret=interpret,
+        **kwargs,
     )(re2, im2, *consts1)
 
 
@@ -260,7 +332,7 @@ def pass2_2d(br, bi, inverse: bool = False, interpret: bool = False):
     from jax.experimental.pallas import tpu as pltpu
 
     n1, n2 = br.shape
-    rb = _block_rows(n2)
+    rb = _block_rows(n2, n1)
     if n1 % rb:
         raise ValueError(f"pass-2 block {rb} must divide n1={n1}")
     la2, lb2, consts2 = PF.leg_consts(n2, inverse)
@@ -269,6 +341,10 @@ def pass2_2d(br, bi, inverse: bool = False, interpret: bool = False):
     k2 = functools.partial(_pass2_kernel, n2=n2, rb=rb, la=la2, lb=lb2,
                            rows_helper=_rows_helper())
     out_shape = jax.ShapeDtypeStruct((n1, n2), jnp.float32)
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=_vmem_budget())
     return pl.pallas_call(
         k2,
         grid=(n1 // rb,),
@@ -276,6 +352,7 @@ def pass2_2d(br, bi, inverse: bool = False, interpret: bool = False):
         out_specs=[row_block, row_block],
         out_shape=[out_shape, out_shape],
         interpret=interpret,
+        **kwargs,
     )(br, bi, *consts2)
 
 

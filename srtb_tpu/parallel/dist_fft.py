@@ -113,8 +113,15 @@ def dist_fft(x, mesh: Mesh, axis_name: str = "seq",
     n2 = n // n1
     if n1 % n_dev or n2 % n_dev:
         raise ValueError(f"n1={n1}, n2={n2} must divide by {n_dev} devices")
-    # pallas_call inside shard_map can't annotate its outputs' varying
-    # mesh axes (vma), so the checker must be off for the Pallas legs
+    # With Pallas legs the vma checker is off for the WHOLE body — an
+    # accepted scope, not an oversight: jax 0.9 can annotate a
+    # pallas_call's outputs (ShapeDtypeStruct(vma=...)), but in
+    # interpret mode (all CPU CI) the kernel body is traced under
+    # shard_map, where unvarying kernel consts meet varying refs and
+    # the checker itself rejects the mul ("requires varying manual
+    # axes to match").  Every collective here is identical across
+    # rows_impls and covered with the checker ON by the default-xla
+    # tests (tests/test_dist_fft.py).
     fn = shard_map(
         partial(_dist_fft_block, axis_name=axis_name, n1=n1, n2=n2,
                 n_dev=n_dev, inverse=inverse, rows_impl=rows_impl),

@@ -183,7 +183,11 @@ class DistSegmentProcessor:
             body, mesh=mesh,
             in_specs=tuple(in_specs),
             out_specs=(P(), P(), P(), P("dm")),
-            # Pallas legs can't annotate vma on their outputs
+            # whole-body vma opt-out for Pallas legs: accepted scope
+            # (see parallel/dist_fft.py — interpret-mode kernels trace
+            # under shard_map and trip the checker on unvarying kernel
+            # consts); the same collectives run checker-ON in the
+            # default-xla tests
             check_vma=rows_impl == "xla"))
 
     # ------------------------------------------------------------------

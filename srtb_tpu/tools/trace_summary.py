@@ -171,14 +171,17 @@ _BUCKETS = [
 
 def bucket(op: str, category: str = "") -> str:
     """Prefer the per-event hlo_category stat (semantic even for opaque
-    "fusion.N" names on TPU device planes); fall back to name regexes."""
-    for name, pat in _BUCKETS:
-        if pat.search(op):
-            return name
+    "fusion.N" names on TPU device planes); an opaque category ("loop
+    fusion", "custom-call") or none falls through to the name regexes,
+    so a broad name pattern can never override a semantic category."""
     if category:
         for name, pat in _BUCKETS:
             if pat.search(category):
                 return name
+    for name, pat in _BUCKETS:
+        if pat.search(op):
+            return name
+    if category:
         return f"hlo:{category}"
     return "other"
 

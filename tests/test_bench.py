@@ -28,6 +28,8 @@ def test_bench_emits_one_json_line(tmp_path):
     # CPU measurement against)
     assert {"achieved_gbps", "model_gflops", "model_hbm_gb"} <= set(rec)
     assert rec["achieved_gbps"] > 0
+    # the BASELINE gate field: a CPU run can never pass the chip target
+    assert rec["pass"] is False
 
 
 def test_bench_survives_unreachable_accelerator(tmp_path):
@@ -54,6 +56,7 @@ def test_bench_survives_unreachable_accelerator(tmp_path):
     assert rec["value"] > 0  # CPU fallback still measured something
     assert rec["platform"] == "cpu"
     assert rec.get("accelerator_error"), rec  # fallback branch really ran
+    assert rec["pass"] is False
 
 
 def test_bench_probes_preset_platform(tmp_path):
@@ -112,3 +115,13 @@ def test_bench_knob_variants(tmp_path):
     rec = json.loads([ln for ln in out.stdout.strip().splitlines()
                       if ln.startswith("{")][0])
     assert rec["value"] > 0
+
+
+def test_baseline_pass_gate():
+    """VERDICT r3 #9: the >= 1x real-time gate, both branches — only an
+    accelerator platform at >= 1x may report pass."""
+    import bench
+    assert bench.baseline_pass(True, 1.0) is True
+    assert bench.baseline_pass(True, 13.6) is True
+    assert bench.baseline_pass(True, 0.99) is False
+    assert bench.baseline_pass(False, 5.0) is False

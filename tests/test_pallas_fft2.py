@@ -149,3 +149,31 @@ def test_fft2_asymmetric_factorization():
     want = np.fft.fft(x.astype(np.complex128))
     got = np.asarray(PF2.fft2_c2c(jnp.asarray(x), interpret=INTERPRET))
     assert np.abs(got - want).max() / np.abs(want).max() < 2e-5
+
+
+def test_block_sizing_budgets_padded_footprint(monkeypatch):
+    """Round-3 advisor catch: blocks must be sized from the PADDED VMEM
+    footprint (bb < 128 lane-pads to 128 across 2x-pipelined in/out
+    refs), not logical f32 words.  Pins: lane-dense pass-1 blocks at
+    every supported factorization, the modeled footprint staying inside
+    the budget, and the absolute env overrides surviving."""
+    monkeypatch.delenv("SRTB_PALLAS2_BB", raising=False)
+    monkeypatch.delenv("SRTB_PALLAS2_RB", raising=False)
+    monkeypatch.delenv("SRTB_PALLAS2_VMEM_MB", raising=False)
+    budget = PF2._vmem_budget()
+    for log2m in range(24, 30):
+        n1, n2 = PF2._factor(1 << log2m)
+        bb = PF2._block_cols(n1, n2)
+        rb = PF2._block_rows(n2, n1)
+        assert bb >= 128 and n2 % bb == 0, (log2m, bb)
+        assert rb >= 8 and n1 % rb == 0, (log2m, rb)
+        assert PF2._pass1_bytes(n1, bb, "col", True) <= budget, log2m
+        assert PF2._pass2_bytes(n2, rb, True) <= budget, log2m
+    # refs alone at the padded minimum exceed a 16 MiB-era budget: the
+    # floor is returned (a vmem_limit question, not a sizing one)
+    monkeypatch.setenv("SRTB_PALLAS2_VMEM_MB", "14")
+    assert PF2._block_cols(8192, 1 << 16) == 128
+    monkeypatch.setenv("SRTB_PALLAS2_BB", "64")
+    monkeypatch.setenv("SRTB_PALLAS2_RB", "16")
+    assert PF2._block_cols(4096, 4096) == 64
+    assert PF2._block_rows(4096, 4096) == 16
