@@ -11,8 +11,12 @@ DM-trial chirp -> waterfall FFT -> RFI s2 -> detection, with
   parallelism; the cleaned spectrum is computed once per seq-shard and
   reused by every local trial).
 
-Collective inventory per segment: 3 all_to_all (FFT transposes) + 2
-ppermute (Hermitian mirror) + 4 psum (means/counts) — all riding ICI.
+Collective inventory per segment: 3 all_to_all (FFT transposes, seq) +
+2 ppermute (Hermitian mirror, seq) + 3 psum over seq (mean power, zero
+count, time series) + 3 psum over dm (the replicated trial summaries) —
+all riding ICI.  Pinned by jaxpr inspection in
+tests/test_parallel.py::test_dist_step_collective_inventory so a
+silently-added collective fails CI.
 """
 
 from __future__ import annotations

@@ -261,3 +261,50 @@ def test_dist_rows_impl_knob(raw_segment, monkeypatch):
     monkeypatch.setenv("SRTB_DIST_ROWS_IMPL", "palas")
     with pytest.raises(ValueError, match="SRTB_DIST_ROWS_IMPL"):
         DistSegmentProcessor(cfg, mesh, dm_list=[cfg.dm, 0.0])
+
+
+def _collect_collectives(jaxpr, out):
+    """(primitive name, mesh axes) of every collective in a jaxpr tree."""
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in ("all_to_all", "ppermute", "all_gather",
+                    "reduce_scatter") or "psum" in name:
+            ax = eqn.params.get("axes") or eqn.params.get("axis_name")
+            ax = (ax,) if isinstance(ax, str) else tuple(ax)
+            out.append((name.replace("psum_invariant", "psum"), ax))
+        for v in eqn.params.values():
+            for item in (v if isinstance(v, (list, tuple)) else [v]):
+                if hasattr(item, "jaxpr"):
+                    _collect_collectives(item.jaxpr, out)
+                elif hasattr(item, "eqns"):
+                    _collect_collectives(item, out)
+    return out
+
+
+def test_dist_step_collective_inventory(raw_segment):
+    """The module docstring's collective inventory, enforced: 3 a2a(seq)
+    + 2 ppermute(seq) + 3 psum(seq) + 3 psum(dm) per segment.  A change
+    that silently adds a collective (an accidental replication, a
+    sharding-constraint round trip) must fail here, not surface as an
+    unexplained ICI regression on hardware (round-3 verdict #7)."""
+    from collections import Counter
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cfg = _cfg()
+    mesh = M.make_mesh(n_dm=2, n_seq=4)
+    dist = DistSegmentProcessor(cfg, mesh, dm_list=[cfg.dm, 0.0])
+    raw = jax.device_put(np.zeros(cfg.segment_bytes(1), np.uint8),
+                         NamedSharding(mesh, P("seq")))
+    args = [raw, dist.chirp_bank, dist.rfi_mask]
+    if dist.window is not None:
+        args.append(dist.window)
+    jaxpr = jax.make_jaxpr(dist._step)(*args)
+    got = Counter(_collect_collectives(jaxpr.jaxpr, []))
+    assert got == Counter({
+        ("all_to_all", ("seq",)): 3,
+        ("ppermute", ("seq",)): 2,
+        ("psum", ("seq",)): 3,
+        ("psum", ("dm",)): 3,
+    }), got

@@ -4,6 +4,11 @@
 # with a bounded subprocess every 4 min; on recovery fires the hardware
 # queue once, commits the artifact files, and exits.
 #
+# Durability caveat: this repo has no git remote, so the auto-commit is
+# host-local — it protects the results from session loss, not from a
+# host swap after recovery.  (If a remote ever exists, add a push with
+# a logged failure fallback after the commit.)
+#
 #   nohup bash tools_tpu_watcher.sh >/dev/null 2>&1 &   # arm
 #   bash ci.sh --hardware                                # same, via CI
 #
