@@ -59,12 +59,26 @@ def _factor(m: int):
     length the two-level kernel handles.  Both need la=128 splits with
     lb >= 32 to bound sublane padding, hence n1 in {4096, 8192} and
     n2 in [4096, 65536]: m in [2^24, 2^29] — exactly the segment sizes
-    where monolithic XLA falters (PERF.md)."""
+    where monolithic XLA falters (PERF.md).  SRTB_PALLAS2_N1 pins n1
+    for hardware A/B (a smaller n1 halves the padded pass-1 block refs
+    — the fallback axis if the default plan misses VMEM on chip)."""
     if m & (m - 1):
         return None
-    for n1 in (4096, 8192):
+    env = os.environ.get("SRTB_PALLAS2_N1")
+    if env:
+        try:
+            n1 = int(env)
+        except ValueError:
+            n1 = 0
+        if n1 <= 0 or n1 & (n1 - 1):
+            raise ValueError(
+                f"SRTB_PALLAS2_N1={env!r} must be a positive power of two")
+        cands = (n1,)
+    else:
+        cands = (4096, 8192)
+    for n1 in cands:
         n2 = m // n1
-        if m % n1 == 0 and 4096 <= n2 <= 65536:
+        if m % n1 == 0 and PF._split_la_lb(n1) and 4096 <= n2 <= 65536:
             return n1, n2
     return None
 

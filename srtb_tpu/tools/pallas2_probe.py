@@ -36,21 +36,23 @@ def main(argv=None) -> int:
     from srtb_tpu.ops import pallas_fft2 as pf2
 
     m = 1 << args.log2m
-    fac = pf2._factor(m)
-    if fac is None:
-        print(json.dumps({"probe": "pallas2_mosaic", "log2m": args.log2m,
-                          "ok": False, "error": "unsupported size"}))
-        return 1
-    n1, n2 = fac
-    bb, rb = pf2._block_cols(n1, n2), pf2._block_rows(n2, n1)
-    rng = np.random.default_rng(0)
-    x = (rng.standard_normal(m)
-         + 1j * rng.standard_normal(m)).astype(np.complex64)
-    xr = jnp.asarray(x.real.copy())
-    xi = jnp.asarray(x.imag.copy())
-    out = {"probe": "pallas2_mosaic", "log2m": args.log2m, "bb": bb,
-           "rb": rb, "vmem_mb": pf2._vmem_budget() >> 20}
+    out = {"probe": "pallas2_mosaic", "log2m": args.log2m}
     try:
+        # inside the try: a bad SRTB_PALLAS2_* env value must land as
+        # ok:false JSON (the queue's artifact contract), not a traceback
+        fac = pf2._factor(m)
+        if fac is None:
+            out.update(ok=False, error="unsupported size")
+            print(json.dumps(out))
+            return 1
+        n1, n2 = fac
+        bb, rb = pf2._block_cols(n1, n2), pf2._block_rows(n2, n1)
+        out.update(bb=bb, rb=rb, vmem_mb=pf2._vmem_budget() >> 20)
+        rng = np.random.default_rng(0)
+        x = (rng.standard_normal(m)
+             + 1j * rng.standard_normal(m)).astype(np.complex64)
+        xr = jnp.asarray(x.real.copy())
+        xi = jnp.asarray(x.imag.copy())
         import jax
 
         # jit the whole two-pass composition: the timing must rank block

@@ -317,6 +317,11 @@ def test_trace_summary_wire_parser():
     # opaque fusion name + semantic category -> category decides
     assert TS.bucket("fusion.42", "fft") == "fft"
     assert TS.bucket("fusion.42", "elementwise") == "hlo:elementwise"
+    # round-3 advisor: a semantic category OUTRANKS a broad name match
+    # (this fused op carries "slice" in its name but is categorially a
+    # convert); an opaque category still falls through to the name
+    assert TS.bucket("fusion.slice.7", "convert") == "unpack+pack"
+    assert TS.bucket("pass1_kernel.slice", "loop fusion") == "pallas_fft"
 
 
 def test_plot_dm_curve(tmp_path):

@@ -62,6 +62,11 @@ if [ "$sweep_failed" = 1 ]; then
       SRTB_PALLAS2_BB=128 SRTB_PALLAS2_RB=8 timeout 900 \
       python -m srtb_tpu.tools.pallas2_probe --log2m 29
 fi
+# factorization A/B at 2^27 (default n1=4096x32768 vs 8192x16384):
+# different block geometry, same math — the fallback axis if the
+# default plan misses VMEM or underperforms
+run pallas2_n1_8192_27 env SRTB_PALLAS2_N1=8192 timeout 900 \
+    python -m srtb_tpu.tools.pallas2_probe --log2m 27
 # First pipeline exposure: bound it so a Mosaic/VMEM failure can't eat
 # the queue; if VMEM overflows, retry with smaller blocks.
 run pallas2     env SRTB_BENCH_FFT_STRATEGY=pallas2 SRTB_BENCH_DEADLINE=900 \
