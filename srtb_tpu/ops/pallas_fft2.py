@@ -73,6 +73,12 @@ def _factor(m: int):
         if n1 <= 0 or n1 & (n1 - 1):
             raise ValueError(
                 f"SRTB_PALLAS2_N1={env!r} must be a positive power of two")
+        if PF._split_la_lb(n1) is None:
+            # as loud as the parse error: a pow2 outside the leg range
+            # must not masquerade as an "unsupported size" downstream
+            raise ValueError(
+                f"SRTB_PALLAS2_N1={n1} outside the leg-FFT range "
+                "[4096, 65536]")
         cands = (n1,)
     else:
         cands = (4096, 8192)

@@ -16,9 +16,20 @@ from srtb_tpu.utils.logging import log
 
 def enable_compile_cache(path: str = "") -> str | None:
     """Point JAX's persistent compilation cache at ``path`` (created if
-    missing).  Returns the directory used, or None if unavailable."""
+    missing).  Returns the directory used, or None if unavailable.
+
+    CPU backends are excluded: the cache exists for the TPU pipeline's
+    minutes-long compiles, while XLA:CPU caches AOT *machine code* keyed
+    without the host's CPU features — after a host swap a stale entry
+    loads with a SIGILL warning ("Machine type used for XLA:CPU
+    compilation doesn't match") and can crash mid-run (observed as a
+    transient bench value-0 failure, round 4).  CPU compiles are cheap;
+    correctness across host swaps is not."""
     import jax
 
+    if jax.default_backend() == "cpu":
+        log.debug("[compile_cache] skipped on CPU (host-fragile AOT)")
+        return None
     if not path:
         path = os.path.join(os.path.expanduser("~"), ".cache",
                             "srtb_tpu_xla_cache")

@@ -26,6 +26,11 @@ fi
 echo $$ > "$PIDFILE"
 echo "$(date -u +%FT%TZ) watcher start (queue: $QUEUE)" >> "$LOG"
 
+# Up to 3 firings: a tunnel that recovers and dies mid-queue leaves
+# mostly-error rows behind — keep watching and fire again (the queue is
+# idempotent; each block re-measures) instead of exiting after a
+# half-dead recovery.
+FIRES=0
 while true; do
   if timeout 150 python -c "
 import jax, jax.numpy as jnp
@@ -33,7 +38,8 @@ d = jax.devices()[0]
 assert d.platform == 'tpu', d.platform
 print(float(jax.jit(lambda x: (x*x).sum())(jnp.arange(8.0))))
 " >> "$LOG" 2>&1; then
-    echo "$(date -u +%FT%TZ) TPU BACK — firing $QUEUE" >> "$LOG"
+    FIRES=$((FIRES + 1))
+    echo "$(date -u +%FT%TZ) TPU BACK — firing $QUEUE (attempt $FIRES)" >> "$LOG"
     bash "$QUEUE" >> /tmp/tpu_queue.log 2>&1
     echo "$(date -u +%FT%TZ) queue done rc=$?" >> "$LOG"
     # pathspec form: commit ONLY the artifact files, never whatever else
@@ -41,8 +47,19 @@ print(float(jax.jit(lambda x: (x*x).sum())(jnp.arange(8.0))))
     git commit -q -m "Record TPU hardware A/B results (auto-captured on tunnel recovery)" \
         -- PERF_TPU.jsonl E2E_LIVE.jsonl >> "$LOG" 2>&1
     echo "$(date -u +%FT%TZ) artifacts committed" >> "$LOG"
-    rm -f "$PIDFILE"
-    exit 0
+    # Distinguish "tunnel died mid-queue" (re-arm and re-measure) from
+    # "tunnel healthy, some variants deterministically failed" (done —
+    # re-running would burn hardware hours on the same rejections): the
+    # discriminator is whether the tunnel answers NOW, after the queue.
+    if timeout 150 python -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform == 'tpu'
+print(float(jax.jit(lambda x: (x*x).sum())(jnp.arange(4.0))))
+" >> "$LOG" 2>&1 || [ "$FIRES" -ge 3 ]; then
+      rm -f "$PIDFILE"
+      exit 0
+    fi
+    echo "$(date -u +%FT%TZ) tunnel dead after queue — re-arming" >> "$LOG"
   fi
   echo "$(date -u +%FT%TZ) still down" >> "$LOG"
   sleep 240
