@@ -1,0 +1,186 @@
+"""Evaluate the hardware queue's decision tree against PERF_TPU.jsonl.
+
+The r4 queue (tools_tpu_r4_queue.sh) ends with a decision tree written
+as comments; if the tunnel recovers while no session is attached, the
+watcher fires the queue and commits raw rows — but nobody reads them
+until the next session.  This tool turns the latest rows into the
+decisions the tree prescribes, so the recovery commit carries its own
+conclusions:
+
+    python -m srtb_tpu.tools.queue_decisions [--perf PERF_TPU.jsonl]
+        [--out DECISIONS_r4.md]
+
+It only REPORTS (markdown + one JSON line); applying a flip stays a
+reviewed edit.  Decisions covered: pallas2 as auto strategy, best 2^30
+plan vs the 1.4 s target, blocked-planes Mosaic flag, MXU precision
+default, dense rows helper default, warm-compile target.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_rows(path: str) -> dict:
+    """variant -> latest row (parsed)."""
+    rows = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                v = rec.get("variant")
+                if v:
+                    rows[v] = rec  # later lines win
+    except OSError:
+        pass
+    return rows
+
+
+def _result(row):
+    if not row:
+        return None
+    r = row.get("result")
+    return r if isinstance(r, dict) else None
+
+
+def _value(row):
+    r = _result(row)
+    return r.get("value") if r else None
+
+
+def evaluate(rows: dict) -> list[dict]:
+    """One dict per decision: {decision, verdict, evidence, action}."""
+    out = []
+
+    def add(decision, verdict, evidence, action=""):
+        out.append({"decision": decision, "verdict": verdict,
+                    "evidence": evidence, "action": action})
+
+    # ---- pallas2 as the auto strategy for n in [2^25, 2^30) ----
+    probes = {k: _result(rows[k]) for k in rows
+              if k.startswith("pallas2_mosaic_probe_")}
+    probe_ok = {k: bool(r and r.get("ok")) for k, r in probes.items()}
+    base = _value(rows.get("baseline"))
+    p2 = _value(rows.get("pallas2"))
+    if probes and base and p2:
+        all_ok = all(probe_ok.values())
+        if all_ok and p2 >= 1.2 * base:
+            add("pallas2 auto-default", "FLIP",
+                f"sweep all ok; pipeline {p2:.0f} vs baseline {base:.0f} "
+                f"Msamples/s (>= 1.2x)",
+                "make ops/fft.resolve_strategy 'auto' pick pallas2 for "
+                "n in [2^25, 2^30); rerun default bench")
+        else:
+            add("pallas2 auto-default", "KEEP monolithic",
+                f"sweep ok: {probe_ok}; pipeline {p2} vs baseline {base}")
+    elif probes:
+        add("pallas2 auto-default", "INCOMPLETE",
+            f"probe sweep: {probe_ok}; pipeline rows missing")
+
+    # ---- best 2^30 plan vs the <= 1.4 s/segment target ----
+    plans = {}
+    for k in ("n2_30", "n2_30_pallas_legs", "n2_30_pallas2",
+              "n2_30_pallas2_full", "staged_blocked_pallas2_probe",
+              "fused_2_30_pallas2_probe"):
+        r = _result(rows.get(k))
+        if r and r.get("segment_time_s"):
+            plans[k] = r["segment_time_s"]
+    if plans:
+        best = min(plans, key=plans.get)
+        if plans[best] <= 1.4:
+            add("2^30 default plan", "FLIP",
+                f"{best} at {plans[best]:.2f} s/segment (<= 1.4 target)",
+                f"make the {best} plan the n >= 2^30 default "
+                "(pipeline/segment.py plan selection)")
+        else:
+            add("2^30 default plan", "KEEP",
+                f"best {best} at {plans[best]:.2f} s (> 1.4 target); "
+                f"all: {plans}")
+
+    # ---- blocked-planes unpack Mosaic flag ----
+    r = _result(rows.get("planes_unpack_mosaic_probe"))
+    rc = rows.get("planes_unpack_mosaic_probe", {}).get("rc")
+    if r and r.get("ok") and rc == 0:
+        add("PLANES_UNPACK_MOSAIC_OK", "FLIP", "probe compiled + matched",
+            "set ops/pallas_kernels.PLANES_UNPACK_MOSAIC_OK = True")
+    elif rc is not None:
+        add("PLANES_UNPACK_MOSAIC_OK", "KEEP False", f"probe rc={rc}")
+
+    # ---- MXU precision default (one queue variant per precision) ----
+    prec = {}
+    for k in ("mxu_precision_probe_high", "mxu_precision_probe_highest"):
+        r = _result(rows.get(k))
+        if r:
+            prec[r.get("prec")] = r
+    if "high" in prec and "highest" in prec:
+        hi = prec["high"]
+        if hi.get("rel_err", 1) <= 2e-6:
+            add("SRTB_MXU_PRECISION default", "FLIP to high",
+                f"high: rel_err {hi['rel_err']:.2e}, {hi.get('ms')} ms vs "
+                f"highest {prec['highest'].get('ms')} ms",
+                "flip the default in ops/mxu_fft")
+        else:
+            add("SRTB_MXU_PRECISION default", "KEEP highest",
+                f"high rel_err {hi.get('rel_err')}")
+
+    # ---- dense rows helper on the proven kernels ----
+    dense = _value(rows.get("pallas_dense"))
+    sk = _value(rows.get("pallas_sk"))
+    if dense and sk:
+        if dense >= sk:
+            add("pallas rows helper default", "FLIP to dense",
+                f"dense {dense:.0f} >= classic {sk:.0f} Msamples/s",
+                "flip ops/pallas_fft.active_rows_helper default")
+        else:
+            add("pallas rows helper default", "KEEP classic",
+                f"dense {dense:.0f} < classic {sk:.0f}")
+
+    # ---- warm-compile restart target ----
+    warm = _result(rows.get("cache_warm"))
+    if warm and warm.get("compile_s") is not None:
+        if warm["compile_s"] <= 10:
+            add("warm restart", "MET",
+                f"cache_warm compile_s {warm['compile_s']} <= 10 s")
+        else:
+            add("warm restart", "NOT MET — document remote-compile cache "
+                "bypass", f"cache_warm compile_s {warm['compile_s']}")
+
+    if not out:
+        add("(no decisions)", "NO DATA",
+            "no recognized variant rows in the perf log")
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--perf", default="PERF_TPU.jsonl")
+    p.add_argument("--out", default="")
+    args = p.parse_args(argv)
+    decisions = evaluate(load_rows(args.perf))
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write("# Hardware-queue decisions (auto-generated)\n\n")
+            f.write("Generated by `srtb_tpu.tools.queue_decisions` from "
+                    f"`{args.perf}`.\n\n")
+            f.write("| decision | verdict | evidence | action |\n")
+            f.write("|---|---|---|---|\n")
+            for d in decisions:
+                f.write(f"| {d['decision']} | {d['verdict']} | "
+                        f"{d['evidence']} | {d['action']} |\n")
+    print(json.dumps({"probe": "queue_decisions",
+                      "flips": [d["decision"] for d in decisions
+                                if d["verdict"].startswith("FLIP")],
+                      "decisions": decisions}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

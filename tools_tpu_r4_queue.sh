@@ -156,7 +156,14 @@ for prec in ("highest", "high"):
                       "rel_err": float(err), "ms": round(dt * 1e3, 2)}))
 PYEOF
 ) | while read -r line; do
-      case "$line" in {*) echo "{\"ts\": \"$(stamp)\", \"variant\": \"mxu_precision_probe\", \"result\": $line}" >> "$OUT"; echo "$line";; esac
+      # one variant per precision: load-latest-row-per-variant consumers
+      # (queue_decisions) must see BOTH rows
+      case "$line" in
+        *'"prec": "highest"'*) v=mxu_precision_probe_highest;;
+        *'"prec": "high"'*) v=mxu_precision_probe_high;;
+        *) v=mxu_precision_probe;;
+      esac
+      case "$line" in {*) echo "{\"ts\": \"$(stamp)\", \"variant\": \"$v\", \"result\": $line}" >> "$OUT"; echo "$line";; esac
     done
 
 # ---- 2. per-kernel rows incl. the anchored-vs-exact chirp A/B ----
@@ -255,6 +262,17 @@ run cache_cold  env SRTB_BENCH_LOG2N=27 SRTB_BENCH_REPS=3 python bench.py
 run cache_warm  env SRTB_BENCH_LOG2N=27 SRTB_BENCH_REPS=3 python bench.py
 
 note "r4 queue done"
+
+# turn the rows into the decision tree's conclusions (report only;
+# applying a flip stays a reviewed edit) — the recovery commit then
+# carries its own analysis even if nobody is attached
+python -m srtb_tpu.tools.queue_decisions --perf "$OUT" \
+    --out DECISIONS_r4.md 2>/dev/null | tail -1 \
+  | while read -r line; do
+      case "$line" in {*)
+        echo "{\"ts\": \"$(stamp)\", \"variant\": \"decisions\", \"result\": $line}" >> "$OUT";;
+      esac
+    done
 
 # ---- decision tree for the results (acted on in-session or next round) ----
 # pallas2_mosaic_probe ok AND pallas2 >= 1.2x baseline

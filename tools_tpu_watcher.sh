@@ -19,6 +19,17 @@ QUEUE=${SRTB_TPU_QUEUE:-tools_tpu_r4_queue.sh}
 LOG=${SRTB_WATCH_LOG:-/tmp/tpu_watcher.log}
 PIDFILE=/tmp/tpu_watcher.pid
 
+# single probe body for both the arming check and the post-queue
+# re-arm discriminator — two copies would drift
+tpu_alive() {
+  timeout 150 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()[0]
+assert d.platform == 'tpu', d.platform
+print(float(jax.jit(lambda x: (x*x).sum())(jnp.arange(8.0))))
+" >> "$LOG" 2>&1
+}
+
 if [ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE")" 2>/dev/null; then
   echo "watcher already running (pid $(cat "$PIDFILE"))" >&2
   exit 0
@@ -32,30 +43,31 @@ echo "$(date -u +%FT%TZ) watcher start (queue: $QUEUE)" >> "$LOG"
 # half-dead recovery.
 FIRES=0
 while true; do
-  if timeout 150 python -c "
-import jax, jax.numpy as jnp
-d = jax.devices()[0]
-assert d.platform == 'tpu', d.platform
-print(float(jax.jit(lambda x: (x*x).sum())(jnp.arange(8.0))))
-" >> "$LOG" 2>&1; then
+  if tpu_alive; then
     FIRES=$((FIRES + 1))
     echo "$(date -u +%FT%TZ) TPU BACK — firing $QUEUE (attempt $FIRES)" >> "$LOG"
     bash "$QUEUE" >> /tmp/tpu_queue.log 2>&1
     echo "$(date -u +%FT%TZ) queue done rc=$?" >> "$LOG"
     # pathspec form: commit ONLY the artifact files, never whatever else
-    # happens to be staged when the watcher fires hours later
-    git commit -q -m "Record TPU hardware A/B results (auto-captured on tunnel recovery)" \
-        -- PERF_TPU.jsonl E2E_LIVE.jsonl >> "$LOG" 2>&1
-    echo "$(date -u +%FT%TZ) artifacts committed" >> "$LOG"
+    # happens to be staged when the watcher fires hours later.  Only
+    # name files that exist — one missing pathspec fails the WHOLE
+    # commit and would lose the hardware rows.
+    ARTS=""
+    for f in PERF_TPU.jsonl E2E_LIVE.jsonl DECISIONS_r4.md; do
+      [ -f "$f" ] && ARTS="$ARTS $f"
+    done
+    if [ -n "$ARTS" ]; then
+      # shellcheck disable=SC2086 # word-splitting is the point
+      git add $ARTS 2>/dev/null
+      git commit -q -m "Record TPU hardware A/B results (auto-captured on tunnel recovery)" \
+          -- $ARTS >> "$LOG" 2>&1
+      echo "$(date -u +%FT%TZ) artifacts committed:$ARTS" >> "$LOG"
+    fi
     # Distinguish "tunnel died mid-queue" (re-arm and re-measure) from
     # "tunnel healthy, some variants deterministically failed" (done —
     # re-running would burn hardware hours on the same rejections): the
     # discriminator is whether the tunnel answers NOW, after the queue.
-    if timeout 150 python -c "
-import jax, jax.numpy as jnp
-assert jax.devices()[0].platform == 'tpu'
-print(float(jax.jit(lambda x: (x*x).sum())(jnp.arange(4.0))))
-" >> "$LOG" 2>&1 || [ "$FIRES" -ge 3 ]; then
+    if tpu_alive || [ "$FIRES" -ge 3 ]; then
       rm -f "$PIDFILE"
       exit 0
     fi
