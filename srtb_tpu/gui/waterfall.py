@@ -163,6 +163,19 @@ class ScrollingWaterfall:
             jnp.asarray(self._img * np.float32(coeff))))
 
 
+def _stream_slice(wf: np.ndarray, stream: int) -> np.ndarray:
+    """[2, S, F, T] -> this stream's [2, F, T].  data_stream_id indexes
+    S only for interleaved formats (several streams in ONE segment
+    array); per-receiver sources carry S=1 segments whose id names the
+    PANE, not an index (found live: MultiUdpSource receiver 1 crashed
+    the tap on wf[:, 1] of an S=1 array).  Single home for all three
+    render paths (plain, summed, scrolling)."""
+    if wf.ndim == 4:
+        return wf[:, stream if wf.shape[1] > 1 else 0]
+    return wf
+
+
+
 class WaterfallService:
     """Per-stream waterfall file sink with lossy-frame semantics: only the
     most recent segment is rendered; older frames are dropped if rendering
@@ -206,9 +219,7 @@ class WaterfallService:
         return self._scrollers[stream]
 
     def _push_scroll(self, wf_ri, stream: int) -> None:
-        wf = np.asarray(wf_ri)
-        if wf.ndim == 4:
-            wf = wf[:, stream]
+        wf = _stream_slice(np.asarray(wf_ri), stream)
         power = wf[0] ** 2 + wf[1] ** 2          # [F, T]
         k = min(self.scroll_lines, power.shape[-1])
         chunks = np.array_split(power, k, axis=-1)
@@ -222,9 +233,7 @@ class WaterfallService:
             self._push_scroll(wf_ri, data_stream_id)
             return
         if self.sum_count > 1:
-            wf = np.asarray(wf_ri)
-            if wf.ndim == 4:
-                wf = wf[:, data_stream_id]
+            wf = _stream_slice(np.asarray(wf_ri), data_stream_id)
             power = wf[0] ** 2 + wf[1] ** 2
             n, acc = self._accum.get(data_stream_id, (0, 0.0))
             n, acc = n + 1, acc + power
@@ -256,9 +265,7 @@ class WaterfallService:
             return None
         wf_ri, stream = self._pending
         self._pending = None
-        wf = np.asarray(wf_ri)
-        if wf.ndim == 4:  # [2, S, F, T] -> this stream
-            wf = wf[:, stream]
+        wf = _stream_slice(np.asarray(wf_ri), stream)
         if wf.ndim == 2:  # pre-summed power frame
             pix = self.renderer.render_power(wf)
         else:

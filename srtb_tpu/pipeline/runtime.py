@@ -201,10 +201,6 @@ class Pipeline:
 
         def _drain_body(item):
             seg, wf, det_res, offset_after = item
-            result = SegmentResultWork(
-                segment=seg,
-                waterfall=wf if self.keep_waterfall else None,
-                detect=det_res)
             positive = has_signal(
                 cfg, det_res,
                 frequency_bin_count=(wf.shape[-2] if wf is not None
@@ -213,8 +209,7 @@ class Pipeline:
                 self.stats.signals += 1
                 log.info("[pipeline] signal detected in segment "
                          f"{self.stats.segments}")
-            for sink in self.sinks:
-                sink.push(result, positive)
+            self._push_sinks(seg, wf, det_res, positive)
             # file mode: sinks never retain segments (no piggybank deque),
             # so the host buffer can go back to the pool for the reader
             pool = getattr(self.source, "pool", None)
@@ -255,6 +250,22 @@ class Pipeline:
     # overridable for tests; the default aborts through the installed
     # signal/termination handlers for a loud stacktrace (the reference's
     # fail-fast philosophy, ref: util/termination_handler.hpp:38-113)
+    def _push_sinks(self, seg, wf, det_res, positive) -> None:
+        """Push to every sink, handing the waterfall only to sinks
+        entitled to it: all of them under ``keep_waterfall``, else only
+        sinks declaring ``wants_waterfall`` (a lossy GUI tap must not
+        make every OTHER sink — e.g. the candidate writer, which dumps
+        a multi-GB .npy per positive segment — start seeing
+        waterfalls the plan chose not to keep)."""
+        full = SegmentResultWork(segment=seg, waterfall=wf,
+                                 detect=det_res)
+        light = full if self.keep_waterfall else SegmentResultWork(
+            segment=seg, waterfall=None, detect=det_res)
+        for sink in self.sinks:
+            give = self.keep_waterfall or getattr(
+                sink, "wants_waterfall", False)
+            sink.push(full if give else light, positive)
+
     def _on_segment_deadline(self) -> None:  # pragma: no cover - aborts
         _abort_on_deadline(self.cfg.segment_deadline_s)
 
@@ -432,18 +443,13 @@ class ThreadedPipeline(Pipeline):
 
         def _drain_body(stop_token, item):
             seg, wf, det_res, offset_after = item
-            result = SegmentResultWork(
-                segment=seg,
-                waterfall=wf if self.keep_waterfall else None,
-                detect=det_res)
             positive = has_signal(
                 cfg, det_res,
                 frequency_bin_count=(wf.shape[-2] if wf is not None
                                      else None))
             if positive:
                 self.stats.signals += 1
-            for sink in self.sinks:
-                sink.push(result, positive)
+            self._push_sinks(seg, wf, det_res, positive)
             pool = getattr(self.source, "pool", None)
             if pool is not None and cfg.input_file_path:
                 pool.release(seg.data)

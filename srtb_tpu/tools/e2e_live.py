@@ -145,7 +145,43 @@ def run(args) -> dict:
         src = MultiUdpSource(cfg)
     else:
         src = UdpReceiverSource(cfg)
+    # lossy waterfall tap (the reference streams its QML waterfall from
+    # the same live pipeline, ref: main.cpp + spectrum_image_provider):
+    # keep the device handle, but fetch + render at most every
+    # --gui_min_interval_s so a slow render can never backpressure the
+    # wire-rate drain — frames in between are simply dropped
+    waterfall_service = None
+    gui_frames = [0]
+    if args.gui:
+        import os
+
+        from srtb_tpu.gui.waterfall import WaterfallService
+        n_spec = n // 2
+        nchan = min(cfg.spectrum_channel_count, n_spec)
+        waterfall_service = WaterfallService(
+            cfg, in_freq=nchan, in_time=n_spec // nchan,
+            out_dir=os.path.dirname(args.prefix) or ".")
+    # keep_waterfall stays False: only the tap (wants_waterfall) sees
+    # the handle — the candidate writer must NOT start dumping a
+    # full waterfall .npy per positive segment during a rate benchmark
     pipe = ThreadedPipeline(cfg, source=src, keep_waterfall=False)
+    if waterfall_service is not None:
+        last_render = [0.0]
+
+        class _LossyTap:
+            wants_waterfall = True
+
+            def push(self, work, has_signal):
+                now = time.perf_counter()
+                if (work.waterfall is None
+                        or now - last_render[0] < args.gui_min_interval_s):
+                    return
+                last_render[0] = now
+                waterfall_service.push(work.waterfall,
+                                       work.segment.data_stream_id)
+                waterfall_service.render_pending()
+                gui_frames[0] += 1
+        pipe.sinks.append(_LossyTap())
     try:
         # compile BEFORE offering load: the first jit of the segment
         # program takes seconds (CPU) to minutes (TPU tunnel), during
@@ -194,6 +230,7 @@ def run(args) -> dict:
         "signals": stats.signals,
         "deadline_s": args.deadline_s,
         "deadline_hits": 0,  # a hit aborts before this line is reached
+        "gui_frames": gui_frames[0] if waterfall_service else None,
         "metrics_http": metrics_http,
     }
     try:
@@ -224,6 +261,9 @@ def main(argv=None) -> int:
                             "asyncio"])
     p.add_argument("--deadline_s", type=float, default=0.0)
     p.add_argument("--fft_strategy", default="auto")
+    p.add_argument("--gui", action="store_true",
+                   help="lossy waterfall tap + renderer during the run")
+    p.add_argument("--gui_min_interval_s", type=float, default=0.5)
     p.add_argument("--prefix", default="/tmp/e2e_live/out_")
     p.add_argument("--out", default="",
                    help="append the JSON line to this file too")

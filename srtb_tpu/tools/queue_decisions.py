@@ -70,9 +70,11 @@ def evaluate(rows: dict) -> list[dict]:
     probe_ok = {k: bool(r and r.get("ok")) for k, r in probes.items()}
     base = _value(rows.get("baseline"))
     p2 = _value(rows.get("pallas2"))
-    if probes and base and p2:
+    # "is not None": a failed bench's 0.0 row is PRESENT data (a KEEP
+    # verdict with evidence), not a missing row
+    if probes and base is not None and p2 is not None:
         all_ok = all(probe_ok.values())
-        if all_ok and p2 >= 1.2 * base:
+        if all_ok and base > 0 and p2 >= 1.2 * base:
             add("pallas2 auto-default", "FLIP",
                 f"sweep all ok; pipeline {p2:.0f} vs baseline {base:.0f} "
                 f"Msamples/s (>= 1.2x)",
@@ -91,7 +93,7 @@ def evaluate(rows: dict) -> list[dict]:
               "n2_30_pallas2_full", "staged_blocked_pallas2_probe",
               "fused_2_30_pallas2_probe"):
         r = _result(rows.get(k))
-        if r and r.get("segment_time_s"):
+        if r and r.get("segment_time_s") is not None:
             plans[k] = r["segment_time_s"]
     if plans:
         best = min(plans, key=plans.get)

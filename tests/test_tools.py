@@ -392,3 +392,22 @@ def test_queue_decisions(tmp_path):
     empty = tmp_path / "empty.jsonl"
     empty.write_text("")
     assert QD.evaluate(QD.load_rows(str(empty)))[0]["verdict"] == "NO DATA"
+
+
+def test_waterfall_service_per_receiver_stream_id(tmp_path):
+    """data_stream_id names the PANE for per-receiver (S=1) segments —
+    it must not be used as an S index (found live: MultiUdpSource
+    receiver 1 crashed the GUI tap on an S=1 waterfall)."""
+    cfg = Config(gui_pixmap_width=16, gui_pixmap_height=8)
+    svc = WaterfallService(cfg, in_freq=32, in_time=32,
+                           out_dir=str(tmp_path))
+    wf = np.random.default_rng(3).standard_normal(
+        (2, 1, 32, 32)).astype(np.float32)   # [2, S=1, F, T]
+    svc.push(wf, data_stream_id=1)           # receiver 1's segment
+    path = svc.render_pending()
+    assert path is not None and path.endswith("waterfall_s1_000000.png")
+    # interleaved formats (S>1) still index by stream
+    wf2 = np.random.default_rng(4).standard_normal(
+        (2, 2, 32, 32)).astype(np.float32)
+    svc.push(wf2, data_stream_id=1)
+    assert svc.render_pending().endswith("waterfall_s1_000001.png")

@@ -252,7 +252,8 @@ echo "{\"ts\": \"$(stamp)\", \"variant\": \"staged_blocked_pallas2_probe\", \"rc
 # ---- 4. live UDP -> TPU end-to-end, 60 s at 2x wire rate (VERDICT #6),
 #         two receivers = the reference's per-polarization deployment ----
 python -m srtb_tpu.tools.e2e_live --seconds 60 --rate_x 2.0 --log2n 27 \
-  --receivers 2 --deadline_s 120 --out E2E_LIVE.jsonl \
+  --receivers 2 --deadline_s 120 --gui --gui_min_interval_s 1 \
+  --out E2E_LIVE.jsonl \
   || note "e2e_live failed"
 
 # ---- 5. compile-cache cold/warm proof across process restarts (VERDICT #7) ----
@@ -265,14 +266,16 @@ note "r4 queue done"
 
 # turn the rows into the decision tree's conclusions (report only;
 # applying a flip stays a reviewed edit) — the recovery commit then
-# carries its own analysis even if nobody is attached
-python -m srtb_tpu.tools.queue_decisions --perf "$OUT" \
-    --out DECISIONS_r4.md 2>/dev/null | tail -1 \
-  | while read -r line; do
-      case "$line" in {*)
-        echo "{\"ts\": \"$(stamp)\", \"variant\": \"decisions\", \"result\": $line}" >> "$OUT";;
-      esac
-    done
+# carries its own analysis even if nobody is attached.  A crash here
+# must leave a trace like every other block (stderr goes to the queue
+# log, failure lands as a note row).
+line=$(python -m srtb_tpu.tools.queue_decisions --perf "$OUT" \
+       --out DECISIONS_r4.md | grep '^{' | tail -1)
+if [ -n "$line" ]; then
+  echo "{\"ts\": \"$(stamp)\", \"variant\": \"decisions\", \"result\": $line}" >> "$OUT"
+else
+  note "queue_decisions failed (no JSON line; see queue log stderr)"
+fi
 
 # ---- decision tree for the results (acted on in-session or next round) ----
 # pallas2_mosaic_probe ok AND pallas2 >= 1.2x baseline
