@@ -22,7 +22,7 @@ def test_bench_emits_one_json_line(tmp_path):
     assert len(lines) == 1, out.stdout
     rec = json.loads(lines[0])
     assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
-    assert rec["value"] > 0 and rec["vs_baseline"] > 0
+    assert rec["value"] > 0 and rec["vs_baseline"] > 0, rec
     # roofline fields (PERF.md): fast must be falsifiable.  roofline_frac
     # itself only appears on accelerator runs (no v5e peak to compare a
     # CPU measurement against)
@@ -53,7 +53,7 @@ def test_bench_survives_unreachable_accelerator(tmp_path):
              if ln.startswith("{")]
     assert len(lines) == 1, out.stdout
     rec = json.loads(lines[0])
-    assert rec["value"] > 0  # CPU fallback still measured something
+    assert rec["value"] > 0, rec  # CPU fallback still measured something
     assert rec["platform"] == "cpu"
     assert rec.get("accelerator_error"), rec  # fallback branch really ran
     assert rec["pass"] is False
