@@ -203,9 +203,75 @@ def _fft_rows_stats_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref,
     s4_ref[:] = jnp.sum(p3 * p3, axis=1)
 
 
-@functools.lru_cache(maxsize=None)
+def _vmem_mb() -> int | None:
+    """Single parse + validation of SRTB_PALLAS_VMEM_MB (None = the
+    proven default plan).  Both readers — the block sizing and the
+    Mosaic vmem limit — branch on this one value, so a degenerate
+    setting cannot make the two halves of the plan disagree."""
+    import os
+
+    env = os.environ.get("SRTB_PALLAS_VMEM_MB")
+    if not env:
+        return None
+    try:
+        mb = int(env)
+    except ValueError:
+        mb = 0
+    if mb <= 0:
+        raise ValueError(
+            f"SRTB_PALLAS_VMEM_MB={env!r} must be a positive integer "
+            "(MiB of VMEM the row-FFT plan may assume)")
+    return mb
+
+
+def _rows_budget_padded(length: int, budget_bytes: int,
+                        dense: bool) -> int:
+    """Largest rows whose PADDED footprint fits the budget, using the
+    ops/pallas_fft2 accounting discipline: 2x-pipelined in/out block
+    refs at rows*length f32 each, plus the helper's live stages — the
+    classic spelling's [la, rows, lb] stages lane-pad lb -> 128 (up to
+    4x on the small-length end), which a flat per-plane divisor would
+    undercount exactly where it hurts."""
+    la, lb = _split_la_lb(length)
+    per_row_refs = 2 * 4 * length * 4
+    if dense:
+        per_row_live = 6 * length * 4 + 2 * la * max(lb, 128) * 4
+    else:
+        per_row_live = 6 * la * max(lb, 128) * 4
+    consts = 4 * (2 * la * la + 2 * lb * max(lb, 128)
+                  + 2 * la * max(lb, 128))
+    per_row = per_row_refs + per_row_live
+    return max(1, (budget_bytes - consts) // per_row)
+
+
 def _row_block(length: int, batch: int) -> int:
-    target = max(1, _VMEM_BLOCK_ELEMS // length)
+    mb = _vmem_mb()
+    if mb is None:
+        elems = _VMEM_BLOCK_ELEMS
+    else:
+        dense = active_rows_helper() is vmem_fft_rows_dense
+        rows = _rows_budget_padded(length, mb << 20, dense)
+        elems = rows * length
+    return _row_block_for(length, batch, elems)
+
+
+def _call_kwargs(interpret: bool) -> dict:
+    """Extra pallas_call kwargs: when SRTB_PALLAS_VMEM_MB enlarges the
+    plan, Mosaic's default scoped-vmem limit must be raised to match;
+    the proven default plan passes no params at all (bit-identical to
+    the measured round-2 path)."""
+    mb = None if interpret else _vmem_mb()
+    if mb is None:
+        return {}
+    from jax.experimental.pallas import tpu as pltpu
+
+    return {"compiler_params": pltpu.CompilerParams(
+        vmem_limit_bytes=mb << 20)}
+
+
+@functools.lru_cache(maxsize=None)
+def _row_block_for(length: int, batch: int, elems: int) -> int:
+    target = max(1, elems // length)
     rows = target
     while batch % rows:
         rows -= 1
@@ -314,6 +380,7 @@ def fft_rows_ri(re: jnp.ndarray, im: jnp.ndarray, inverse: bool = False,
         out_specs=[lc.block, lc.block],
         out_shape=[lc.out_shape()] * 2,
         interpret=interpret,
+        **_call_kwargs(interpret),
     )(lc.re2, lc.im2, *lc.consts)
     return out_re.reshape(lc.shape), out_im.reshape(lc.shape)
 
@@ -363,6 +430,7 @@ def fft_rows_stats_ri(re: jnp.ndarray, im: jnp.ndarray,
                    jax.ShapeDtypeStruct((batch, 128), jnp.float32),
                    jax.ShapeDtypeStruct((batch, 128), jnp.float32)],
         interpret=interpret,
+        **_call_kwargs(interpret),
     )(lc.re2, lc.im2, *lc.consts, dwr)
     return (out_re.reshape(shape), out_im.reshape(shape),
             s2.reshape(*shape[:-1], 128), s4.reshape(*shape[:-1], 128))

@@ -181,3 +181,36 @@ def test_fft_rows_dense_helper_matches(monkeypatch):
     p = np.abs(got2) ** 2
     np.testing.assert_allclose(np.asarray(s2).sum(-1), p.sum(-1),
                                rtol=1e-4)
+
+
+def test_row_block_vmem_budget_knob(monkeypatch):
+    """SRTB_PALLAS_VMEM_MB scales the row-block plan for hardware A/B;
+    unset keeps the proven 1 MB-plane default bit-identical."""
+    from srtb_tpu.ops import pallas_fft as PF
+
+    monkeypatch.delenv("SRTB_PALLAS_VMEM_MB", raising=False)
+    base = PF._row_block(1 << 14, 1 << 11)      # 2^18/2^14 = 16 rows
+    assert base == 16
+    assert PF._call_kwargs(interpret=False) == {}
+    monkeypatch.setenv("SRTB_PALLAS_VMEM_MB", "56")
+    big = PF._row_block(1 << 14, 1 << 11)
+    assert big > base and (1 << 11) % big == 0
+    kw = PF._call_kwargs(interpret=False)
+    assert kw["compiler_params"].vmem_limit_bytes == 56 << 20
+    assert PF._call_kwargs(interpret=True) == {}
+    # padded accounting: the classic helper's lb<128 stage padding must
+    # shrink the block on the small-length end (lb=32 pads 4x)
+    for length in (1 << 12, 1 << 13, 1 << 16):
+        for dense in (False, True):
+            rows = PF._rows_budget_padded(length, 56 << 20, dense)
+            la, lb = PF._split_la_lb(length)
+            refs = 2 * 4 * rows * length * 4
+            live = (6 * rows * length * 4 + 2 * rows * la * max(lb, 128) * 4
+                    if dense else 6 * la * rows * max(lb, 128) * 4)
+            assert refs + live <= 56 << 20, (length, dense, rows)
+    # degenerate values fail loudly and identically for both readers
+    monkeypatch.setenv("SRTB_PALLAS_VMEM_MB", "0")
+    with pytest.raises(ValueError):
+        PF._row_block(1 << 14, 1 << 11)
+    with pytest.raises(ValueError):
+        PF._call_kwargs(interpret=False)

@@ -91,6 +91,10 @@ run pallas2_rowspell env SRTB_BENCH_FFT_STRATEGY=pallas2 \
 # dense-helper A/B on the PROVEN waterfall/SK row kernels
 run pallas_dense env SRTB_BENCH_USE_PALLAS=1 SRTB_BENCH_USE_PALLAS_SK=1 \
     SRTB_PALLAS_ROWS=dense SRTB_BENCH_DEADLINE=900 python bench.py
+# big-block A/B on the same proven kernels: 56 MiB plan vs the 1 MB-plane
+# default (v5e has 128 MiB VMEM; fewer grid steps, longer DMA bursts)
+run pallas_bigblk env SRTB_BENCH_USE_PALLAS=1 SRTB_BENCH_USE_PALLAS_SK=1 \
+    SRTB_PALLAS_VMEM_MB=56 SRTB_BENCH_DEADLINE=900 python bench.py
 # everything-fused flagship: two-pass FFT + fused RFI/chirp + fused
 # waterfall/SK stats
 run pallas2_full env SRTB_BENCH_FFT_STRATEGY=pallas2 SRTB_BENCH_USE_PALLAS=1 \
