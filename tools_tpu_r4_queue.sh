@@ -281,18 +281,25 @@ else
   note "queue_decisions failed (no JSON line; see queue log stderr)"
 fi
 
-# ---- decision tree for the results (acted on in-session or next round) ----
-# pallas2_mosaic_probe ok AND pallas2 >= 1.2x baseline
+# ---- decision tree for the results ----
+# (srtb_tpu.tools.queue_decisions evaluates this tree automatically at
+#  the end of every queue run into DECISIONS_r4.md; applying a flip
+#  stays a reviewed edit, in-session or next round)
+# pallas2_mosaic_probe_24..29 all ok AND pallas2 >= 1.2x baseline
 #     -> make resolve_strategy "auto" pick pallas2 for n in [2^25, 2^30)
 #        and rerun the default bench so BENCH_r0N reflects it.
 # pallas2 VMEM/compile failure
-#     -> pallas2_small_blk / pallas2_rowspell are the retries; if all
-#        fail, monolithic stays default and the probe rc documents why.
+#     -> pallas2_lowvmem_* / pallas2_small_blk / pallas2_rowspell /
+#        pallas2_n1_8192_27 are the retries (budget, blocks, spelling,
+#        factorization); if all fail, monolithic stays default and the
+#        probe rc/error rows document why.
 # best(n2_30_pallas2, n2_30_pallas2_full, staged_blocked_pallas2,
 #      fused_2_30_pallas2) <= 1.4 s/segment
 #     -> VERDICT #3 target met; make that plan the n >= 2^30 default.
 # planes_unpack_mosaic_probe ok -> flip pallas_kernels.PLANES_UNPACK_MOSAIC_OK.
-# mxu_precision 'high' rel_err <= ~2e-6 -> flip SRTB_MXU_PRECISION default.
+# mxu_precision_probe_high rel_err <= ~2e-6 -> flip SRTB_MXU_PRECISION default.
 # pallas_dense >= pallas_sk -> flip pallas_fft.active_rows_helper default.
+# pallas_bigblk >= pallas_sk -> adopt SRTB_PALLAS_VMEM_MB=56 as the
+#     accelerator default row-block plan (ops/pallas_fft._row_block).
 # cache_warm compile_s <= 10 s -> VERDICT #7 done; else the axon remote
 #     compile service bypasses the local disk cache — document and file.
