@@ -49,7 +49,17 @@ def main(argv=None) -> int:
     p.add_argument("--log2chan", type=int, default=15)
     p.add_argument("--out", default="artifacts/production_oracle.json")
     p.add_argument("--pulse_amp", type=float, default=30.0)
+    p.add_argument("--progress", action="store_true",
+                   help="timestamped per-phase progress on stderr (a "
+                        "2^30 run takes hours on a small host; without "
+                        "this the process is a black box)")
     args = p.parse_args(argv)
+
+    def mark(msg):
+        if args.progress:
+            print(f"[production_oracle +{time.monotonic() - t_start:.0f}s]"
+                  f" {msg}", file=sys.stderr, flush=True)
+    t_start = time.monotonic()
 
     from srtb_tpu.utils.platform import apply_platform_env
     apply_platform_env()
@@ -81,20 +91,29 @@ def main(argv=None) -> int:
         baseband_reserve_sample=False,
     )
 
+    if args.progress:
+        import jax
+        jax.config.update("jax_log_compiles", True)
+
     t0 = time.perf_counter()
+    mark("synth start")
     raw = make_dispersed_baseband(
         n, cfg.baseband_freq_low, cfg.baseband_bandwidth, cfg.dm,
         pulse_positions=n // 2, pulse_amp=args.pulse_amp, nbits=2)
     synth_s = time.perf_counter() - t0
+    mark(f"synth done ({synth_s:.0f}s); building SegmentProcessor")
 
     # ---- device chain (the staged plan is the n >= 2^30 default) ----
     t0 = time.perf_counter()
     proc = SegmentProcessor(cfg)
+    mark(f"processor built (staged={proc.staged}); running device chain")
     wf_ri, res = proc.process(raw)
+    mark("device programs dispatched; fetching results")
     wf_dev = waterfall_to_numpy(wf_ri)[0]   # stream 0: [F, T] complex64
     ts_dev = np.asarray(res.time_series)[0]
     counts_dev = np.asarray(res.signal_counts)[0]
     device_s = time.perf_counter() - t0
+    mark(f"device done ({device_s:.0f}s); starting float64 oracle")
 
     # ---- float64 oracle over the identical bytes ----
     t0 = time.perf_counter()
@@ -103,6 +122,7 @@ def main(argv=None) -> int:
     wf_o, ts_o, nzap_o = ou.oracle_stream_chain(x, cfg)
     del x
     oracle_s = time.perf_counter() - t0
+    mark(f"oracle done ({oracle_s:.0f}s); comparing")
 
     wf_scale = float(np.abs(wf_o).max())
     ts_scale = float(np.abs(ts_o).max())
