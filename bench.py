@@ -196,23 +196,39 @@ def run_bench(platform_error):
         use_pallas=bool(int(os.environ.get("SRTB_BENCH_USE_PALLAS", "0"))),
         use_pallas_sk=bool(int(os.environ.get("SRTB_BENCH_USE_PALLAS_SK",
                                               "0"))),
+        # AOT executable cache A/B (utils/aot_cache): run the same
+        # config twice with this set — the second run's compile_s is
+        # the AOT warm-restart number
+        aot_plan_path=os.environ.get("SRTB_BENCH_AOT_DIR", ""),
     )
     # "" = auto (staged at n >= 2^30); "0"/"1" force the plan — the
     # one-program 2^30 experiment (pallas2 has no XLA FFT scratch, so
     # the fused plan may fit where it used to OOM) needs the override
     staged_env = os.environ.get("SRTB_BENCH_STAGED", "")
+    # With SRTB_BENCH_AOT_DIR the compile (or the AOT load that replaces
+    # it) happens inside SegmentProcessor.__init__, so compile_s must
+    # start BEFORE construction for the aot_cold/aot_warm A/B to mean
+    # anything.  Without it, keep the historical timer position (first
+    # step only) so compile_s rows stay comparable with rounds 2-4 and
+    # host-side constant building (chirp banks) isn't miscounted as
+    # compile.
+    t0 = time.perf_counter()
     proc = SegmentProcessor(
         cfg, staged=None if staged_env == "" else bool(int(staged_env)))
 
     rng = np.random.default_rng(0)
     raw = rng.integers(0, 256, size=cfg.segment_bytes(1), dtype=np.uint8)
     raw_dev = jax.device_put(raw)
+    # key the timer semantics on AOT actually ENGAGING, not merely being
+    # requested: a silently-inactive cache (CPU without the opt-in) must
+    # not produce AOT-protocol compile_s rows
+    if not getattr(proc, "aot_active", False):
+        t0 = time.perf_counter()
 
     # warmup / compile.  Sync via a host fetch of the (tiny) counts:
     # on some TPU tunnels block_until_ready returns silently on an
     # errored async execution — the error only surfaces at value fetch,
     # and a bench that never fetches would time failures as ~0 s.
-    t0 = time.perf_counter()
     wf, res = proc.run_device(raw_dev)
     np.asarray(res.signal_counts)
     compile_s = time.perf_counter() - t0
@@ -263,6 +279,10 @@ def run_bench(platform_error):
         "model_hbm_gb": round(bytes_moved / 1e9, 3),
         "achieved_gbps": round(bytes_moved / dt / 1e9, 1),
     }
+    if cfg.aot_plan_path:
+        # whether the AOT executable cache actually engaged — the
+        # queue's aot_cold/aot_warm verdicts require this to be true
+        out["aot_active"] = bool(getattr(proc, "aot_active", False))
     if on_accel:
         # only meaningful against the accelerator's HBM peak — a CPU
         # fallback measurement has no v5e roofline to be a fraction of

@@ -64,6 +64,12 @@ class Config:
     udp_packet_provider: str = "recvmmsg"
     # interface the packet_ring provider captures on
     udp_packet_ring_interface: str = "lo"
+    # SO_RCVBUF request for the receiver sockets (the reference hardcodes
+    # its SO_RCVBUF, recvmmsg_packet_provider.hpp:79; a knob because the
+    # right size is deployment-specific: big enough to ride out a
+    # compile-time stall, small enough that overload surfaces as prompt
+    # accounted loss instead of seconds of silent latency)
+    udp_receiver_rcvbuf_bytes: int = 1 << 28
 
     input_file_path: str = ""
     input_file_offset_bytes: int = 0
@@ -104,9 +110,22 @@ class Config:
     # persistent XLA compile cache dir; the FFTW-wisdom analog
     # ("" = default ~/.cache location, "off" = disabled)
     fft_fftw_wisdom_path: str = ""
+    # AOT executable cache dir ("" = disabled): persists the segment
+    # plan's *compiled executables* across process restarts
+    # (utils/aot_cache.py) — the warm-restart fallback for deployments
+    # where the XLA compile cache is bypassed by a remote-compile
+    # service.  Off on CPU backends unless SRTB_AOT_ALLOW_CPU=1.
+    aot_plan_path: str = ""
     # segment R2C strategy:
     # auto | monolithic | four_step | mxu | pallas | pallas2
     fft_strategy: str = "auto"
+    # longest 1-D row length handed to XLA's FFT directly; longer rows
+    # recurse into the four-step decomposition (0 = the library default,
+    # ops/fft._XLA_FFT_LEN_CAP = 2^16 measured on v5e).  Lowering it
+    # forces the recursion at tiny shapes — how the multichip dryrun
+    # exercises the production 2^30 in-shard code path without 2^30
+    # samples
+    fft_len_cap: int = 0
     # use Pallas fused kernels where available (fused RFI-s1 + df64
     # chirp-multiply, VMEM row-FFT waterfall C2C)
     use_pallas: bool = False

@@ -564,22 +564,27 @@ class UdpReceiverSource:
         if use_native is None:
             use_native = (_NATIVE is not None and mode == "block"
                           and provider not in ("recvfrom", "asyncio"))
+        rcvbuf = int(getattr(cfg, "udp_receiver_rcvbuf_bytes", 1 << 28))
         if mode == "continuous":
             # the continuous worker is sequential by construction; the
             # native recvmmsg path currently implements only the block
             # worker (its recvmmsg batching conflicts with strict
             # in-order straddling delivery)
-            self.receiver = PythonContinuousReceiver(addr, port, self.fmt)
+            self.receiver = PythonContinuousReceiver(
+                addr, port, self.fmt, rcvbuf_bytes=rcvbuf)
         elif use_native and provider == "packet_ring":
             self.receiver = PacketRingReceiver(
                 addr, port, self.fmt,
                 interface=getattr(cfg, "udp_packet_ring_interface", "lo"))
         elif use_native:
-            self.receiver = NativeBlockReceiver(addr, port, self.fmt)
+            self.receiver = NativeBlockReceiver(addr, port, self.fmt,
+                                                rcvbuf_bytes=rcvbuf)
         elif provider == "asyncio":
-            self.receiver = AsyncioBlockReceiver(addr, port, self.fmt)
+            self.receiver = AsyncioBlockReceiver(addr, port, self.fmt,
+                                                 rcvbuf_bytes=rcvbuf)
         else:
-            self.receiver = PythonBlockReceiver(addr, port, self.fmt)
+            self.receiver = PythonBlockReceiver(addr, port, self.fmt,
+                                                rcvbuf_bytes=rcvbuf)
         self.data_stream_id = receiver_id
         self.segment_bytes = cfg.segment_bytes(self.fmt.data_stream_count)
         payload = self.fmt.payload_bytes

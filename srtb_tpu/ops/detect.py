@@ -32,6 +32,50 @@ def _norm(c):
     return jnp.real(c) ** 2 + jnp.imag(c) ** 2
 
 
+def tree_sum_freq(power: jnp.ndarray) -> jnp.ndarray:
+    """Sum ``power [..., K, T]`` over the frequency axis (-2) with an
+    explicit pairwise (binary-tree) reduction: K -> K/2 -> ... -> 1.
+
+    Why not ``jnp.sum``: XLA's reduction order is implementation-defined
+    — on XLA:CPU the 2^15-channel production sum accumulates mostly
+    sequentially, and the measured time-series error at the flagship
+    geometry was 3.1e-4 (artifacts/production_oracle.json, round 4),
+    ~600x the waterfall error feeding it.  The reference does the same
+    sum naively in f32 (ref: signal_detect_pipe.hpp:305-316) and
+    inherits the same growth; this beats it instead of matching it.
+
+    The pairwise tree makes the rounding bound deterministic and
+    backend-independent: ceil(log2 K) + 1 levels, each contributing at
+    most one ulp of the running partial per element, so for nonnegative
+    summands
+
+        |err[t]| <= (ceil(log2 K) + 1) * eps * sum_k power[k, t]
+
+    (eps = 2^-24); at K = 2^15 that is ~1e-6 relative to the raw series
+    — vs the O(K * eps) = 2e-3 worst case of a sequential sum.  Cost:
+    the level arrays form a geometric series, ~2x the HBM traffic of a
+    single fused reduce — noise next to the segment FFTs.  Asserted
+    against a float64 oracle in tests/test_reference_crosscheck.py.
+    """
+    k = power.shape[-2]
+    t = power.shape[-1]
+    lead = power.shape[:-2]
+    carry = None
+    while k > 1:
+        if k % 2:
+            last = power[..., -1:, :]
+            carry = last if carry is None else carry + last
+            power = power[..., :-1, :]
+            k -= 1
+        power = power.reshape(*lead, k // 2, 2, t)
+        power = power[..., 0, :] + power[..., 1, :]
+        k //= 2
+    out = power[..., 0, :]
+    if carry is not None:
+        out = out + carry[..., 0, :]
+    return out
+
+
 class DetectResult(NamedTuple):
     """Static-shape detection result for one segment / one data stream."""
     zero_count: jnp.ndarray          # [] int32: zapped frequency channels
@@ -40,6 +84,42 @@ class DetectResult(NamedTuple):
     signal_counts: jnp.ndarray       # [n_boxcars] int32: samples over threshold
     boxcar_series: jnp.ndarray       # [n_boxcars, T] f32 (rows zero-padded at tail)
     snr_peaks: jnp.ndarray           # [n_boxcars] f32: max SNR per boxcar
+
+
+def time_series_error_gates(k_ch: int, t_len: int, ts_raw_max: float,
+                            wf_err_abs: float) -> tuple:
+    """Derived absolute error bounds for the detection time series vs a
+    float64 oracle, decomposed by cause (single home of the formulas:
+    tools/production_oracle.py gates the flagship geometry with these
+    and tests/test_reference_crosscheck.py pins them in CI).
+
+    Returns ``(ts_sum_gate, ts_prop_gate)``:
+
+    - ``ts_sum_gate`` bounds the f32 summation error of
+      :func:`tree_sum_freq` + the tree mean-subtract vs exact f64 on
+      the *same* f32 waterfall: (ceil(lg K) + ceil(lg T) + 5) pairwise
+      levels, each <= eps of the running nonnegative partial, times the
+      raw (un-mean-subtracted) series max; factor 2 for the mean's few
+      extra ulps.  Deterministic and backend-independent — measured
+      4.2e-5 relative at K = 2^15 vs 1.8e-3 for a sequential f32 sum
+      (round-5 A/B).
+    - ``ts_prop_gate`` bounds the waterfall's own f32 error
+      ``wf_err_abs`` propagated through |.|^2 and the channel sum:
+      per time sample |sum_k(|x+d|^2 - |x|^2)| <= 2*wf_err*sum_k|x| +
+      K*wf_err^2 <= 2*wf_err*sqrt(K*ts_raw_max) + K*wf_err^2 —
+      worst-case coherent alignment, no statistical assumption.  The
+      comparison happens on *mean-subtracted* series, and subtracting
+      the (equally perturbed) mean can double the per-sample
+      difference, hence the outer factor 2.
+    """
+    eps = 2.0 ** -24
+    levels = (int(np.ceil(np.log2(max(k_ch, 2))))
+              + int(np.ceil(np.log2(max(t_len, 2)))) + 5)
+    ts_sum_gate = 2.0 * levels * eps * ts_raw_max
+    ts_prop_gate = 2.0 * (
+        2.0 * wf_err_abs * float(np.sqrt(k_ch * ts_raw_max))
+        + k_ch * wf_err_abs ** 2)
+    return ts_sum_gate, ts_prop_gate
 
 
 def boxcar_lengths(max_boxcar_length: int, time_series_count: int) -> tuple:
@@ -82,8 +162,9 @@ def detect(waterfall: jnp.ndarray, time_reserved_count: int,
     zero_count = jnp.sum(
         (_norm(waterfall[..., 0]) == 0).astype(jnp.int32), axis=-1)
 
-    # time series: sum power over frequency for the first t samples (ref: 305-316)
-    ts = jnp.sum(_norm(waterfall[..., :t]), axis=-2)
+    # time series: sum power over frequency for the first t samples
+    # (ref: 305-316) — pairwise tree, not jnp.sum: see tree_sum_freq
+    ts = tree_sum_freq(_norm(waterfall[..., :t]))
     return detect_from_time_series(ts, zero_count, snr_threshold,
                                    max_boxcar_length)
 
@@ -96,7 +177,12 @@ def detect_from_time_series(ts: jnp.ndarray, zero_count: jnp.ndarray,
     kernels that already produced the time series (Pallas SK+sum pass) can
     reuse it."""
     t = ts.shape[-1]
-    ts = ts - jnp.mean(ts, axis=-1, keepdims=True)  # ref: 321-334
+    # mean subtraction (ref: 321-334) with the same pairwise-tree
+    # discipline as the frequency sum: the series sits at K*mean_power
+    # scale, so an order-unspecified sum over T = 2^14 samples could
+    # contribute more error than the whole frequency reduction
+    mean = tree_sum_freq(ts[..., :, None])[..., 0:1] / t
+    ts = ts - mean
 
     lengths = boxcar_lengths(max_boxcar_length, t)
     n_box = len(lengths)

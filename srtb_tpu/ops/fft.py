@@ -44,7 +44,8 @@ def c2c_backward(x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
 
 
 def waterfall_c2c(spectrum: jnp.ndarray, channel_count: int,
-                  dewindow: jnp.ndarray | None = None) -> jnp.ndarray:
+                  dewindow: jnp.ndarray | None = None,
+                  len_cap: int | None = None) -> jnp.ndarray:
     """Dedispersed spectrum (n/2 complex) -> dynamic spectrum
     ``[channel_count, watfft_len]`` via per-row unnormalized backward C2C
     (ref: fft_pipe.hpp:285-372).  Rows are coarse frequency channels; columns
@@ -62,7 +63,7 @@ def waterfall_c2c(spectrum: jnp.ndarray, channel_count: int,
     x = x.reshape(*spectrum.shape[:-1], channel_count, watfft_len)
     # row lengths beyond the XLA cap (coarse channelizations of long
     # segments, e.g. [2048, 2^17]) go through the four-step path
-    wf = _fft_minor(x, inverse=True)
+    wf = _fft_minor(x, inverse=True, len_cap=len_cap)
     if dewindow is not None:
         wf = wf / dewindow
     return wf
@@ -70,7 +71,8 @@ def waterfall_c2c(spectrum: jnp.ndarray, channel_count: int,
 
 def ifft_refft_waterfall(spectrum: jnp.ndarray, channel_count: int,
                          nsamps_reserved_complex: int = 0,
-                         window: jnp.ndarray | None = None) -> jnp.ndarray:
+                         window: jnp.ndarray | None = None,
+                         len_cap: int | None = None) -> jnp.ndarray:
     """The reference's alternate channelization path (currently disabled in
     its main(), ref: main.cpp:182-186): full unnormalized inverse C2C back
     to the (dedispersed) complex time domain, trim the reserved tail, then
@@ -80,7 +82,7 @@ def ifft_refft_waterfall(spectrum: jnp.ndarray, channel_count: int,
     Output is time-major: [n_chunks(time), channel_count(freq)] — the
     orientation consumed by signal_detect_pipe variant 1.
     """
-    td = _fft_minor(spectrum, inverse=True)
+    td = _fft_minor(spectrum, inverse=True, len_cap=len_cap)
     n = td.shape[-1]
     if 0 < nsamps_reserved_complex < n:
         td = td[..., : n - nsamps_reserved_complex]
@@ -185,11 +187,16 @@ def _split_factor(n: int) -> int:
 # [..., 128, 128, 8] form whose minor dim pads 8 -> 128 lanes, a 16x HBM
 # blowup that OOMs the chip at pipeline sizes (e.g. waterfall
 # [2048, 2^17] wants 2x16 GB of scratch); 2^16 and below tile cleanly.
+# Default for the ``len_cap`` parameter below — a constant, never
+# mutated: callers that need a different cap (tiny-shape multichip
+# dryruns forcing the in-shard recursion; future hardware A/Bs) pass it
+# explicitly / via Config.fft_len_cap.
 _XLA_FFT_LEN_CAP = 1 << 16
 
 
 def _fft_minor(x: jnp.ndarray, inverse: bool,
-               rows_impl: str = "xla") -> jnp.ndarray:
+               rows_impl: str = "xla",
+               len_cap: int | None = None) -> jnp.ndarray:
     """FFT along the minor (last) axis, recursing into the four-step
     decomposition for lengths XLA's TPU FFT handles badly.
 
@@ -197,10 +204,13 @@ def _fft_minor(x: jnp.ndarray, inverse: bool,
     the batched row transforms.  "pallas" runs rows that fit VMEM through
     ops/pallas_fft (one HBM read+write per point, MXU DFT-matmul stages);
     out-of-range rows fall back to XLA.
+
+    ``len_cap``: longest row length handed to XLA's FFT directly
+    (default _XLA_FFT_LEN_CAP); longer rows recurse into four_step_fft.
     """
     length = x.shape[-1]
-    if length > _XLA_FFT_LEN_CAP:
-        return four_step_fft(x, inverse, rows_impl)
+    if length > (len_cap or _XLA_FFT_LEN_CAP):
+        return four_step_fft(x, inverse, rows_impl, len_cap)
     batch = 1
     for s in x.shape[:-1]:
         batch *= s
@@ -222,7 +232,8 @@ def _fft_minor(x: jnp.ndarray, inverse: bool,
 
 
 def four_step_stage1(x: jnp.ndarray, inverse: bool = False,
-                     rows_impl: str = "xla") -> jnp.ndarray:
+                     rows_impl: str = "xla",
+                     len_cap: int | None = None) -> jnp.ndarray:
     """First half of the four-step FFT: [..., n] -> A[..., n2, k1].
 
     Splitting the decomposition in two lets very large segments run the
@@ -240,11 +251,12 @@ def four_step_stage1(x: jnp.ndarray, inverse: bool = False,
     a = x.reshape(*x.shape[:-1], n1, n2)
     # step 1: FFT_n1 over j1 for each j2 — transpose so n1 is minor
     a = jnp.swapaxes(a, -1, -2)            # [j2, j1]
-    return _fft_minor(a, inverse, rows_impl)   # A[j2, k1]
+    return _fft_minor(a, inverse, rows_impl, len_cap)   # A[j2, k1]
 
 
 def four_step_stage2(a: jnp.ndarray, inverse: bool = False,
-                     rows_impl: str = "xla") -> jnp.ndarray:
+                     rows_impl: str = "xla",
+                     len_cap: int | None = None) -> jnp.ndarray:
     """Second half of the four-step FFT: A[..., n2, k1] -> X[..., n]."""
     n2, n1 = a.shape[-2], a.shape[-1]
     n = n1 * n2
@@ -253,14 +265,15 @@ def four_step_stage2(a: jnp.ndarray, inverse: bool = False,
     a = a * _twiddle(n2, n1, inverse)
     # step 3: FFT_n2 over j2 for each k1 — transpose so n2 is minor
     a = jnp.swapaxes(a, -1, -2)            # [k1, j2]
-    a = _fft_minor(a, inverse, rows_impl)      # C[k1, k2]
+    a = _fft_minor(a, inverse, rows_impl, len_cap)      # C[k1, k2]
     # result index k = k2*n1 + k1 -> [k2, k1] then flatten
     a = jnp.swapaxes(a, -1, -2)
     return a.reshape(*a.shape[:-2], n)
 
 
 def four_step_fft(x: jnp.ndarray, inverse: bool = False,
-                  rows_impl: str = "xla") -> jnp.ndarray:
+                  rows_impl: str = "xla",
+                  len_cap: int | None = None) -> jnp.ndarray:
     """1-D C2C FFT of power-of-two length via the four-step algorithm.
     Unnormalized in both directions (matching c2c_forward / c2c_backward).
     Leading dims batch.
@@ -272,12 +285,14 @@ def four_step_fft(x: jnp.ndarray, inverse: bool = False,
     keeps the layout work visible: transpose -> batched FFT -> twiddle ->
     transpose -> batched FFT -> transpose, all row lengths <= 2^16.
     """
-    return four_step_stage2(four_step_stage1(x, inverse, rows_impl),
-                            inverse, rows_impl)
+    return four_step_stage2(four_step_stage1(x, inverse, rows_impl,
+                                             len_cap),
+                            inverse, rows_impl, len_cap)
 
 
 def rfft_via_c2c(x: jnp.ndarray, use_four_step: bool = False,
-                 drop_nyquist: bool = False) -> jnp.ndarray:
+                 drop_nyquist: bool = False,
+                 len_cap: int | None = None) -> jnp.ndarray:
     """R2C FFT of 2m reals via one m-point C2C plus Hermitian post-process,
     returning m+1 bins (like rfft), or exactly m bins with
     ``drop_nyquist`` (the pipeline convention, ref: fft_pipe.hpp:75-77).
@@ -293,7 +308,8 @@ def rfft_via_c2c(x: jnp.ndarray, use_four_step: bool = False,
     length m: F[(m-k) mod m] is a flip + roll that XLA fuses into the
     elementwise Hermitian combine."""
     z = pack_even_odd(x)
-    zf = four_step_fft(z) if use_four_step else jnp.fft.fft(z)
+    zf = four_step_fft(z, len_cap=len_cap) if use_four_step \
+        else jnp.fft.fft(z)
     return hermitian_rfft_post(zf, drop_nyquist)
 
 
@@ -356,7 +372,8 @@ def subbyte_window_planes(window: np.ndarray, nbits: int) -> np.ndarray:
 def rfft_subbyte(data: jnp.ndarray, nbits: int, strategy: str = "four_step",
                  window_planes: jnp.ndarray | None = None,
                  drop_nyquist: bool = True,
-                 planes: jnp.ndarray | None = None) -> jnp.ndarray:
+                 planes: jnp.ndarray | None = None,
+                 len_cap: int | None = None) -> jnp.ndarray:
     """Fused unpack + even/odd pack + R2C for 1/2/4-bit baseband bytes,
     with every intermediate lane-dense.
 
@@ -402,15 +419,17 @@ def rfft_subbyte(data: jnp.ndarray, nbits: int, strategy: str = "four_step",
     elif strategy == "monolithic":
         a = jnp.fft.fft(z, axis=-1)  # one batched XLA FFT over the planes
     elif strategy in ("pallas", "pallas_interpret"):
-        a = _fft_minor(z, inverse=False, rows_impl=strategy)
+        a = _fft_minor(z, inverse=False, rows_impl=strategy,
+                       len_cap=len_cap)
     elif strategy in ("pallas2", "pallas2_interpret"):
-        a = _pallas2_or_fallback(z, strategy)
+        a = _pallas2_or_fallback(z, strategy, len_cap)
     else:
-        a = _fft_minor(z, inverse=False)
+        a = _fft_minor(z, inverse=False, len_cap=len_cap)
     return finish_rfft_subbyte(a, drop_nyquist)
 
 
-def _pallas2_or_fallback(z: jnp.ndarray, strategy: str) -> jnp.ndarray:
+def _pallas2_or_fallback(z: jnp.ndarray, strategy: str,
+                         len_cap: int | None = None) -> jnp.ndarray:
     """The fused two-pass Pallas C2C (ops/pallas_fft2) on [..., L] complex
     z, falling back to the four-step-with-Pallas-legs form for lengths
     outside its [2^24, 2^29] window (tiny test configs)."""
@@ -418,8 +437,12 @@ def _pallas2_or_fallback(z: jnp.ndarray, strategy: str) -> jnp.ndarray:
     interp = strategy.endswith("interpret")
     if pf2.supported(z.shape[-1]):
         return pf2.fft2_c2c(z, inverse=False, interpret=interp)
+    # loud when an explicit SRTB_PALLAS2_N1 pin is why we're falling
+    # back — the A/B knob must not silently measure the wrong path
+    pf2.require_pin_fit(z.shape[-1])
     return _fft_minor(z, inverse=False,
-                      rows_impl="pallas_interpret" if interp else "pallas")
+                      rows_impl="pallas_interpret" if interp else "pallas",
+                      len_cap=len_cap)
 
 
 def subbyte_planes_to_packed(planes: jnp.ndarray) -> jnp.ndarray:
@@ -469,7 +492,8 @@ def resolve_strategy(n: int, strategy: str) -> str:
     return strategy
 
 
-def segment_rfft(x: jnp.ndarray, strategy: str = "auto") -> jnp.ndarray:
+def segment_rfft(x: jnp.ndarray, strategy: str = "auto",
+                 len_cap: int | None = None) -> jnp.ndarray:
     """The segment-sized R2C with the drop-Nyquist convention.
 
     strategy:
@@ -492,14 +516,15 @@ def segment_rfft(x: jnp.ndarray, strategy: str = "auto") -> jnp.ndarray:
     """
     strategy = resolve_strategy(x.shape[-1], strategy)
     if strategy in ("pallas2", "pallas2_interpret"):
-        zf = _pallas2_or_fallback(pack_even_odd(x), strategy)
+        zf = _pallas2_or_fallback(pack_even_odd(x), strategy, len_cap)
         return hermitian_rfft_post(zf, drop_nyquist=True)
     if strategy in ("pallas", "pallas_interpret"):
         z = pack_even_odd(x)
-        zf = four_step_fft(z, rows_impl=strategy)
+        zf = four_step_fft(z, rows_impl=strategy, len_cap=len_cap)
         return hermitian_rfft_post(zf, drop_nyquist=True)
     if strategy == "four_step":
-        return rfft_via_c2c(x, use_four_step=True, drop_nyquist=True)
+        return rfft_via_c2c(x, use_four_step=True, drop_nyquist=True,
+                            len_cap=len_cap)
     if strategy == "mxu":
         from srtb_tpu.ops.mxu_fft import mxu_fft
         z = pack_even_odd(x)

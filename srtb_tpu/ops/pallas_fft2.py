@@ -53,7 +53,7 @@ from srtb_tpu.ops import fft as F
 from srtb_tpu.ops import pallas_fft as PF
 
 
-def _factor(m: int):
+def _factor(m: int, strict: bool = True):
     """m = n1 * n2 with n1 the resident-column length (the whole n1 axis
     of a [n1, bb] block must fit VMEM, so n1 stays small) and n2 a row
     length the two-level kernel handles.  Both need la=128 splits with
@@ -86,11 +86,37 @@ def _factor(m: int):
         n2 = m // n1
         if m % n1 == 0 and PF._split_la_lb(n1) and 4096 <= n2 <= 65536:
             return n1, n2
+    if env and strict:
+        # the pin passed the pow2/leg-range checks above but fails for
+        # THIS m — at kernel-build time an explicit knob must not
+        # silently degrade to "unsupported size" (and thence the xla
+        # fallback).  Boolean probes (``supported``) pass strict=False:
+        # dispatchers ask about many sizes and a pin that doesn't fit a
+        # probed size just means "not this path for this size".
+        n1 = cands[0]
+        if m % n1:
+            raise ValueError(
+                f"SRTB_PALLAS2_N1={n1} does not divide m={m}")
+        raise ValueError(
+            f"SRTB_PALLAS2_N1={n1} leaves n2={m // n1} outside the "
+            "row-FFT range [4096, 65536] "
+            f"for m={m}")
     return None
 
 
 def supported(m: int) -> bool:
-    return _factor(m) is not None
+    return _factor(m, strict=False) is not None
+
+
+def require_pin_fit(m: int) -> None:
+    """Dispatchers call this in their not-supported fallback branch:
+    when SRTB_PALLAS2_N1 is set and is the *reason* ``m`` is
+    unsupported, raise the strict pin error instead of letting the
+    operator's explicit A/B knob silently measure the fallback path.
+    No-op when the pin is unset (the documented tiny-config fallback)
+    or when m is unsupported for pin-independent reasons (non-pow2)."""
+    if os.environ.get("SRTB_PALLAS2_N1"):
+        _factor(m, strict=True)
 
 
 def _vmem_budget() -> int:
@@ -101,8 +127,20 @@ def _vmem_budget() -> int:
     sized by the padded-footprint model below.  Default 80 MiB leaves
     headroom for Mosaic internal scratch; SRTB_PALLAS2_VMEM_MB is the
     hardware A/B knob (a 16 MiB-era budget cannot fit ANY pass-1 block:
-    the padded minimum 2*4*n1*128*4 B is 16 MiB at n1=4096 alone)."""
-    return int(os.environ.get("SRTB_PALLAS2_VMEM_MB", "80")) << 20
+    the padded minimum 2*4*n1*128*4 B is 16 MiB at n1=4096 alone).
+    Parsed + validated once, like pallas_fft._vmem_mb: a degenerate
+    setting must fail loudly here, not as floor-zero blocks plus a
+    nonpositive vmem_limit_bytes handed to Mosaic."""
+    env = os.environ.get("SRTB_PALLAS2_VMEM_MB", "80")
+    try:
+        mb = int(env)
+    except ValueError:
+        mb = 0
+    if mb <= 0:
+        raise ValueError(
+            f"SRTB_PALLAS2_VMEM_MB={env!r} must be a positive integer "
+            "(MiB of VMEM the two-pass plan may assume)")
+    return mb << 20
 
 
 def _leg_const_bytes(la: int, lb: int) -> int:

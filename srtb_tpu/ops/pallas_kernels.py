@@ -23,6 +23,8 @@ unavailable (pure-CPU CI), and are validated against them in tests via
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import functools
 
 import jax
@@ -61,15 +63,32 @@ def _pallas_available() -> bool:
 #     the float64 chirp oracle at |k| ~ 1e9 turns, which would be off by
 #     whole turns if any lo component were simplified away
 #     (tests/test_pallas_kernels.py "mosaic" cases).
-# The switch is set by each pallas_call wrapper around kernel tracing
-# (tracing happens inside pl.pallas_call, so set/restore is exact).
+# The switch is a kernel-build argument: each pallas_call wrapper scopes
+# it with ``_ob_mode(interpret)`` around kernel tracing (tracing happens
+# inside pl.pallas_call, so the scope is exact).  It is a ContextVar,
+# not a module global, so two threads building kernels concurrently
+# (e.g. two SegmentProcessors) cannot see each other's setting.
 # ----------------------------------------------------------------
 
-_USE_OB = True
+_USE_OB: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "srtb_pallas_use_ob", default=True)
+
+
+@contextlib.contextmanager
+def _ob_mode(interpret: bool):
+    """Scope the EFT-barrier decision for one kernel build: barriers on
+    under interpret (XLA simplifier would rewrite the EFTs away), off
+    under Mosaic (unimplemented there, and unneeded — see block comment
+    above)."""
+    token = _USE_OB.set(bool(interpret))
+    try:
+        yield
+    finally:
+        _USE_OB.reset(token)
 
 
 def _ob(x):
-    return jax.lax.optimization_barrier(x) if _USE_OB else x
+    return jax.lax.optimization_barrier(x) if _USE_OB.get() else x
 
 
 def _two_sum(a, b):
@@ -359,9 +378,7 @@ def rfi_s1_dedisperse_df64(spec_ri: jnp.ndarray, threshold: float,
                                norm=float(norm), has_mask=has_mask,
                                consts=_chirp_consts(
                                    n, f_min, df, f_c, dm, i0))
-    global _USE_OB
-    saved, _USE_OB = _USE_OB, bool(interpret)
-    try:
+    with _ob_mode(interpret):
         out_re, out_im = pl.pallas_call(
             kernel,
             grid=grid,
@@ -373,8 +390,6 @@ def rfi_s1_dedisperse_df64(spec_ri: jnp.ndarray, threshold: float,
                                             jnp.float32)] * 2,
             interpret=interpret,
         )(re, im, thr, mask2d)
-    finally:
-        _USE_OB = saved
     return jnp.stack([out_re.reshape(n), out_im.reshape(n)])
 
 
@@ -400,9 +415,7 @@ def dedisperse_df64(spec_ri: jnp.ndarray, f_min: float, df: float,
                                    n, f_min, df, f_c, dm, i0))
     block = pl.BlockSpec((rows, _LANES), lambda i: (i, 0),
                          memory_space=pltpu.VMEM)
-    global _USE_OB
-    saved, _USE_OB = _USE_OB, bool(interpret)
-    try:
+    with _ob_mode(interpret):
         out_re, out_im = pl.pallas_call(
             kernel,
             grid=grid,
@@ -414,8 +427,6 @@ def dedisperse_df64(spec_ri: jnp.ndarray, f_min: float, df: float,
                                             jnp.float32)],
             interpret=interpret,
         )(re, im)
-    finally:
-        _USE_OB = saved
     return jnp.stack([out_re.reshape(n), out_im.reshape(n)])
 
 

@@ -49,17 +49,20 @@ def _local_transpose_a2a(x_block, axis_name, n_dev):
 
 
 def _dist_fft_block(x_block, *, axis_name, n1, n2, n_dev, inverse,
-                    rows_impl="xla"):
+                    rows_impl="xla", len_cap=None):
     """shard_map body: x_block [n_local] = this device's j1-block rows,
     viewed as [n1/D, n2].  ``rows_impl`` selects who runs the local leg
     FFTs (ops.fft._fft_minor dispatch): "xla", or "pallas"/
     "pallas_interpret" for the VMEM row kernel — the same per-chip
-    kernels the single-chip plans use, now under the a2a transposes."""
+    kernels the single-chip plans use, now under the a2a transposes.
+    ``len_cap`` threads ops.fft._fft_minor's XLA length cap through the
+    in-shard legs (tiny-shape dryruns force the four-step recursion a
+    production 2^30 shard takes by lowering it)."""
     a = x_block.reshape(n1 // n_dev, n2)
 
     # transpose so columns (j1 axis) become local rows
     at = _local_transpose_a2a(a, axis_name, n_dev)          # [n2/D, n1]
-    bt = _fft_minor(at, inverse, rows_impl)
+    bt = _fft_minor(at, inverse, rows_impl, len_cap)
     # twiddle: row j2 (global), column k1: exp(sign*2*pi*i*k1*j2/n).
     # The residue k1*j2 < n1*n2 = n fits int32 exactly for n <= 2^30, and
     # _phase_exp splits it hi/lo so the f32 phase stays exact at large n
@@ -75,7 +78,7 @@ def _dist_fft_block(x_block, *, axis_name, n1, n2, n_dev, inverse,
 
     # transpose back: rows k1 local again
     b = _local_transpose_a2a(bt, axis_name, n_dev)          # [n1/D, n2]
-    c = _fft_minor(b, inverse, rows_impl)
+    c = _fft_minor(b, inverse, rows_impl, len_cap)
     # natural order: X[k2*n1 + k1] = C[k1, k2] -> global transpose
     ct = _local_transpose_a2a(c, axis_name, n_dev)          # [n2/D, n1]
     return ct.reshape(-1)
@@ -96,7 +99,8 @@ def resolve_rows_impl(impl: str) -> str:
 
 
 def dist_fft(x, mesh: Mesh, axis_name: str = "seq",
-             inverse: bool = False, rows_impl: str = "xla"):
+             inverse: bool = False, rows_impl: str = "xla",
+             len_cap: int | None = None):
     """Distributed unnormalized C2C FFT of a 1-D power-of-two array sharded
     (or shardable) over ``axis_name``.  Returns the spectrum in natural
     order with the same sharding."""
@@ -124,7 +128,8 @@ def dist_fft(x, mesh: Mesh, axis_name: str = "seq",
     # tests (tests/test_dist_fft.py).
     fn = shard_map(
         partial(_dist_fft_block, axis_name=axis_name, n1=n1, n2=n2,
-                n_dev=n_dev, inverse=inverse, rows_impl=rows_impl),
+                n_dev=n_dev, inverse=inverse, rows_impl=rows_impl,
+                len_cap=len_cap),
         mesh=mesh, in_specs=P(axis_name), out_specs=P(axis_name),
         check_vma=rows_impl == "xla")
     return fn(x.astype(jnp.complex64))
@@ -166,7 +171,8 @@ def _dist_rfft_post_block(zf_block, *, axis_name, m, n_dev):
 
 
 def dist_rfft_drop_nyquist(x, mesh: Mesh, axis_name: str = "seq",
-                           rows_impl: str = "xla"):
+                           rows_impl: str = "xla",
+                           len_cap: int | None = None):
     """Distributed R2C of 2m reals -> m complex bins (drop-Nyquist
     convention of the segment FFT, ref: fft_pipe.hpp:75-77)."""
     n = x.shape[-1]
@@ -188,7 +194,8 @@ def dist_rfft_drop_nyquist(x, mesh: Mesh, axis_name: str = "seq",
 
     z = shard_map(pack, mesh=mesh, in_specs=P(axis_name),
                   out_specs=P(axis_name))(x.astype(jnp.float32))
-    zf = dist_fft(z, mesh, axis_name, rows_impl=rows_impl)
+    zf = dist_fft(z, mesh, axis_name, rows_impl=rows_impl,
+                  len_cap=len_cap)
     post = shard_map(
         partial(_dist_rfft_post_block, axis_name=axis_name, m=m,
                 n_dev=n_dev),

@@ -157,6 +157,7 @@ class DistSegmentProcessor:
         body = partial(
             self._body,
             rows_impl=rows_impl,
+            len_cap=cfg.fft_len_cap or None,
             variant=self.fmt.unpack_variant,
             nbits=cfg.baseband_input_bits,
             n=self.n, n_seq=self.n_seq, n_dm_dev=self.n_dm_devices,
@@ -198,7 +199,8 @@ class DistSegmentProcessor:
 
     @staticmethod
     def _body(raw_block, chirp_block, mask_block, *rest, variant, nbits, n,
-              rows_impl, n_seq, n_dm_dev, chirp_on_device, f_min, f_c, df,
+              rows_impl, len_cap, n_seq, n_dm_dev, chirp_on_device,
+              f_min, f_c, df,
               chirp_anchor_consts, n_spectrum, channel_count, norm_coeff,
               avg_threshold, sk_threshold, time_reserved_count,
               snr_threshold, max_boxcar_length,
@@ -224,7 +226,7 @@ class DistSegmentProcessor:
             z = F.pack_even_odd(xs[s])
             zf = DF._dist_fft_block(z, axis_name="seq", n1=n1, n2=n2,
                                     n_dev=n_seq, inverse=False,
-                                    rows_impl=rows_impl)
+                                    rows_impl=rows_impl, len_cap=len_cap)
             spec = DF._dist_rfft_post_block(zf, axis_name="seq", m=m,
                                             n_dev=n_seq)   # [m/n_seq]
             # RFI stage 1: global mean power via psum, zap + normalize
@@ -265,12 +267,18 @@ class DistSegmentProcessor:
             zero_count = jax.lax.psum(
                 jnp.sum((jnp.abs(wf[:, :, 0]) == 0).astype(jnp.int32),
                         axis=-1), "seq")               # [S]
-            # global time series: sum power over all channels
+            # global time series: sum power over all channels — local
+            # pairwise tree (det.tree_sum_freq: deterministic O(log K)
+            # rounding) + psum's own log2(n_seq)-level tree across shards
             ts = jax.lax.psum(
-                jnp.sum(jnp.real(wf[:, :, :t]) ** 2
-                        + jnp.imag(wf[:, :, :t]) ** 2, axis=1),
+                det.tree_sum_freq(
+                    jnp.real(wf[:, :, :t]) ** 2
+                    + jnp.imag(wf[:, :, :t]) ** 2),
                 "seq")                                  # [S, t]
-            ts = ts - jnp.mean(ts, axis=-1, keepdims=True)
+            # tree-sum the time mean too (same discipline as the local
+            # channel sum above; det.detect_from_time_series does the
+            # same on the single-chip path)
+            ts = ts - det.tree_sum_freq(ts[..., :, None]) / ts.shape[-1]
             # boxcar cascade on the (replicated) time series
             lengths = det.boxcar_lengths(max_boxcar_length, t)
             acc = jnp.cumsum(ts, axis=-1)

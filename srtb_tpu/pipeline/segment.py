@@ -88,6 +88,8 @@ class SegmentProcessor:
         self.watfft_len = self.n_spectrum // self.channel_count
 
         # ---- precomputed constants ----
+        self._window_name = window_name  # enters plan_signature: the
+        # window is a captured constant of the traced programs
         win = W.window_coefficients(window_name, n)
         self.window = None if win is None else jnp.asarray(win)
         # Simple-format sub-byte segments take the fused blocked-plane
@@ -150,6 +152,9 @@ class SegmentProcessor:
         # Pallas kernels need interpret mode off-TPU (CPU CI)
         from srtb_tpu.utils.platform import on_accelerator
         self._pallas_interpret = not on_accelerator()
+        # XLA FFT row-length cap override (Config.fft_len_cap; None =
+        # the ops/fft default), threaded through every FFT entry point
+        self._len_cap = cfg.fft_len_cap or None
         self._jit_process = jax.jit(self._process)
         self._jit_stage_a = jax.jit(self._stage_a)
         # the staged intermediates are consumed exactly once, so stages
@@ -159,6 +164,15 @@ class SegmentProcessor:
         # each program compiled within budget
         self._jit_stage_b = jax.jit(self._stage_b, donate_argnums=(0,))
         self._jit_stage_c = jax.jit(self._stage_c, donate_argnums=(0,))
+        self.aot_active = False
+        if cfg.aot_plan_path:
+            if not self.enable_aot(cfg.aot_plan_path):
+                # visible, not debug: the config requested warm-restart
+                # protection and it did NOT activate
+                log.warning(
+                    "[segment] aot_plan_path set but the AOT cache is "
+                    "inactive (CPU backend without SRTB_AOT_ALLOW_CPU=1)"
+                    " — restarts will recompile")
         log.debug(f"[segment] n={n} spectrum={self.n_spectrum} "
                   f"channels={self.channel_count} watfft={self.watfft_len} "
                   f"reserved={self.nsamps_reserved} staged={self.staged}")
@@ -213,10 +227,12 @@ class SegmentProcessor:
                     self.window_planes, interpret=interp)
             spec = F.rfft_subbyte(raw, self.cfg.baseband_input_bits,
                                   strategy, self.window_planes,
-                                  planes=planes)[None, :]
+                                  planes=planes,
+                                  len_cap=self._len_cap)[None, :]
         else:
             x = self._unpack(raw)
-            spec = F.segment_rfft(x, strategy)             # [S, n/2]
+            spec = F.segment_rfft(x, strategy,
+                                  len_cap=self._len_cap)   # [S, n/2]
         return self._spectrum_tail(spec, chirp_ri)
 
     # ---- staged plan: three programs with (re, im) f32 boundaries ----
@@ -254,6 +270,8 @@ class SegmentProcessor:
             count = (8 // self.cfg.baseband_input_bits
                      if self._staged_blocked else 2)
             if not pf2.supported(self.n // count):
+                # loud if an explicit SRTB_PALLAS2_N1 pin caused this
+                pf2.require_pin_fit(self.n // count)
                 return ("pallas_interpret" if impl.endswith("interpret")
                         else "pallas")
         return impl
@@ -281,7 +299,8 @@ class SegmentProcessor:
             br, bi = pf2.pass1_ri(jnp.real(z), jnp.imag(z),
                                   interpret=impl.endswith("interpret"))
             return jnp.stack([br, bi])
-        a = F.four_step_stage1(z, rows_impl=impl)  # [..., n2, n1]
+        a = F.four_step_stage1(z, rows_impl=impl,
+                               len_cap=self._len_cap)  # [..., n2, n1]
         return jnp.stack([jnp.real(a), jnp.imag(a)])
 
     def _stage_b(self, a_ri: jnp.ndarray):
@@ -294,7 +313,8 @@ class SegmentProcessor:
             zf = jax.lax.complex(yr, yi)
         else:
             zf = F.four_step_stage2(jax.lax.complex(a_ri[0], a_ri[1]),
-                                    rows_impl=impl)
+                                    rows_impl=impl,
+                                    len_cap=self._len_cap)
         if self._staged_blocked:
             spec = F.finish_rfft_subbyte(zf[0])[None, :]
         else:
@@ -389,7 +409,8 @@ class SegmentProcessor:
                 ts_rows.append(ts)
         elif pallas_sk:
             wf = F.waterfall_c2c(spec, self.channel_count,
-                                 self.watfft_dewindow)  # [S, F, T]
+                                 self.watfft_dewindow,
+                                 len_cap=self._len_cap)  # [S, F, T]
             zapped, zero_counts, ts_rows = [], [], []
             for s in range(n_streams):
                 wf_ri1 = jnp.stack([jnp.real(wf[s]), jnp.imag(wf[s])])
@@ -420,7 +441,8 @@ class SegmentProcessor:
                     wf = wf / self.watfft_dewindow
             else:
                 wf = F.waterfall_c2c(spec, self.channel_count,
-                                     self.watfft_dewindow)  # [S, F, T]
+                                     self.watfft_dewindow,
+                                     len_cap=self._len_cap)  # [S, F, T]
             wf = rfi.mitigate_rfi_spectral_kurtosis(
                 wf, cfg.mitigate_rfi_spectral_kurtosis_threshold)
             result = det.detect(wf, self.time_reserved_count,
@@ -431,6 +453,81 @@ class SegmentProcessor:
         return wf_ri, result
 
     # ------------------------------------------------------------------
+    # AOT warm restart (utils/aot_cache.py): replace the jit wrappers
+    # with persisted compiled executables so a restarted observation
+    # skips the (minutes-long at 2^30) XLA compile entirely.
+
+    # Config fields that enter the traced programs.  An ALLOWLIST, not a
+    # denylist: IO/GUI/paths knobs added later can't silently start
+    # keying the AOT cache and turning a deployment-local tweak (e.g.
+    # udp_receiver_rcvbuf_bytes) into an 11-minute 2^30 recompile.
+    _TRACE_CFG_KEYS = (
+        "baseband_input_count", "baseband_input_bits",
+        "baseband_format_type", "baseband_freq_low",
+        "baseband_bandwidth", "baseband_sample_rate", "dm", "dm_list",
+        "spectrum_channel_count", "signal_detect_signal_noise_threshold",
+        "signal_detect_max_boxcar_length", "signal_detect_channel_threshold",
+        "mitigate_rfi_average_method_threshold",
+        "mitigate_rfi_spectral_kurtosis_threshold",
+        "mitigate_rfi_freq_list", "baseband_reserve_sample",
+        "fft_strategy", "fft_len_cap", "use_pallas", "use_pallas_sk",
+        "use_emulated_fp64",
+    )
+
+    def plan_signature(self) -> str:
+        """Stable string identifying everything that shapes the compiled
+        programs: the trace-relevant config fields, the trace-shaping
+        SRTB_* env knobs, and the plan flags.  Any drift misses the AOT
+        cache cleanly and recompiles."""
+        import json
+
+        cfg_d = {k: getattr(self.cfg, k) for k in self._TRACE_CFG_KEYS
+                 if hasattr(self.cfg, k)}
+        # only knobs that shape the traced programs: sweeping all
+        # SRTB_* would key the cache on run-local paths (SRTB_BENCH_*,
+        # SRTB_WATCH_LOG, the cache dir itself) and silently miss on
+        # every deployment-environment difference — the exact outage
+        # this cache exists to prevent
+        trace_prefixes = ("SRTB_STAGED", "SRTB_PALLAS", "SRTB_DIST",
+                          "SRTB_MXU")
+        knobs = {k: v for k, v in os.environ.items()
+                 if k.startswith(trace_prefixes)}
+        return json.dumps(
+            {"cfg": cfg_d, "env": knobs, "staged": self.staged,
+             "interp": self._pallas_interpret,
+             "window": self._window_name,
+             "has_chirp": self.chirp is not None},
+            sort_keys=True, default=str)
+
+    def enable_aot(self, path: str, allow_cpu: bool = False) -> bool:
+        """Swap the jitted plan programs for cached compiled executables
+        (compiling + persisting on miss).  Returns False when the cache
+        is unavailable (CPU backend without the opt-in) — the jit
+        wrappers stay in place and behavior is unchanged."""
+        from srtb_tpu.utils.aot_cache import AotPlanCache
+
+        cache = AotPlanCache(path, allow_cpu=allow_cpu)
+        if not cache.enabled():
+            return False
+        sig = self.plan_signature()
+        expected = self.cfg.segment_bytes(self.fmt.data_stream_count)
+        raw_s = jax.ShapeDtypeStruct((expected,), jnp.uint8)
+        if not self.staged:
+            self._jit_process = cache.get_or_compile(
+                "fused", sig, self._jit_process, raw_s, self.chirp)
+        else:
+            # chain the boundary avals by abstract evaluation (free:
+            # trace only, no compile)
+            a_out = jax.eval_shape(self._stage_a, raw_s)
+            b_out = jax.eval_shape(self._stage_b, a_out)
+            self._jit_stage_a = cache.get_or_compile(
+                "stage_a", sig, self._jit_stage_a, raw_s)
+            self._jit_stage_b = cache.get_or_compile(
+                "stage_b", sig, self._jit_stage_b, a_out)
+            self._jit_stage_c = cache.get_or_compile(
+                "stage_c", sig, self._jit_stage_c, b_out)
+        self.aot_active = True
+        return True
 
     def process(self, raw) -> tuple[jnp.ndarray, det.DetectResult]:
         """Run one segment. ``raw`` is the uint8 byte array of the segment

@@ -127,14 +127,40 @@ def main(argv=None) -> int:
     wf_scale = float(np.abs(wf_o).max())
     ts_scale = float(np.abs(ts_o).max())
     # stream the waterfall comparison row-block-wise: a whole-array
-    # |wf_dev - wf_o| would add another 8 GiB complex128 temporary
+    # |wf_dev - wf_o| would add another 8 GiB complex128 temporary.
+    # The same pass accumulates the f64 frequency-sum of the *device*
+    # (f32) waterfall: the pivot that decomposes the time-series error
+    # into its two causes (see ts gates below).
     wf_err = 0.0
     blk = 1 << 11
+    ts_f64_of_f32 = np.zeros(wf_o.shape[1], dtype=np.float64)
     for i in range(0, wf_o.shape[0], blk):
-        d = np.abs(wf_dev[i:i + blk].astype(np.complex128)
-                   - wf_o[i:i + blk])
+        w32 = wf_dev[i:i + blk]
+        d = np.abs(w32.astype(np.complex128) - wf_o[i:i + blk])
         wf_err = max(wf_err, float(d.max()))
+        ts_f64_of_f32 += (w32.real.astype(np.float64) ** 2
+                          + w32.imag.astype(np.float64) ** 2).sum(axis=0)
+    ts_raw_max = float(ts_f64_of_f32.max())
+    ts_f64_of_f32 -= ts_f64_of_f32.mean()
     ts_err = float(np.abs(ts_dev.astype(np.float64) - ts_o).max())
+
+    # ---- per-quantity gates (round-4 verdict weak #2) ----
+    # wf: f32 FFT-chain rounding; measured 5.1e-7 relative at the
+    # flagship shape (round 4) -> 1e-5 keeps 20x headroom while being
+    # 800x tighter than the old shared 8e-3.
+    wf_gate = 1e-5 * wf_scale
+    # ts splits into two separately-gated causes — summation-ordering
+    # error (deterministic pairwise-tree bound) and the waterfall's own
+    # f32 error propagated through |.|^2.  The formulas live in ONE
+    # place, ops.detect.time_series_error_gates, shared with the CI
+    # assertion in tests/test_reference_crosscheck.py.
+    from srtb_tpu.ops.detect import time_series_error_gates
+    k_ch, t_len = wf_o.shape
+    ts_sum_err = float(np.abs(ts_dev.astype(np.float64)
+                              - ts_f64_of_f32).max())
+    ts_prop_err = float(np.abs(ts_f64_of_f32 - ts_o).max())
+    ts_sum_gate, ts_prop_gate = time_series_error_gates(
+        k_ch, t_len, ts_raw_max, wf_err)
 
     out = {
         "probe": "production_oracle",
@@ -144,17 +170,23 @@ def main(argv=None) -> int:
         "staged": bool(getattr(proc, "staged", True)),
         "wf_max_rel_err": wf_err / wf_scale if wf_scale else 0.0,
         "ts_max_rel_err": ts_err / ts_scale if ts_scale else 0.0,
+        "ts_sum_rel_err": ts_sum_err / ts_scale if ts_scale else 0.0,
+        "ts_prop_rel_err": ts_prop_err / ts_scale if ts_scale else 0.0,
+        "ts_raw_max": ts_raw_max,
+        "gates": {
+            "wf": wf_gate / wf_scale if wf_scale else 0.0,
+            "ts_sum": ts_sum_gate / ts_scale if ts_scale else 0.0,
+            "ts_prop": ts_prop_gate / ts_scale if ts_scale else 0.0,
+        },
         "signal_counts": [int(c) for c in np.ravel(counts_dev)],
         "oracle_sk_zapped_rows": int(nzap_o),
         "synth_s": round(synth_s, 1),
         "device_s": round(device_s, 1),
         "oracle_s": round(oracle_s, 1),
         "platform": os.environ.get("JAX_PLATFORMS", ""),
-        # the crosscheck tier at 2^16 holds 2e-3 relative; the flagship
-        # shape passes at an order of magnitude of headroom over the
-        # f32 FFT's ~sqrt(log n) error growth
-        "ok": bool(wf_err <= 8e-3 * wf_scale
-                   and ts_err <= 8e-3 * ts_scale),
+        "ok": bool(wf_err <= wf_gate
+                   and ts_sum_err <= ts_sum_gate
+                   and ts_prop_err <= ts_prop_gate),
     }
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out, "w") as f:

@@ -136,14 +136,22 @@ def evaluate(rows: dict) -> list[dict]:
     # ---- dense rows helper on the proven kernels ----
     dense = _value(rows.get("pallas_dense"))
     sk = _value(rows.get("pallas_sk"))
-    if dense and sk:
-        if dense >= sk:
+    # "is not None", matching the pallas2 decision's convention: a failed
+    # bench's 0.0 value row is present evidence (a KEEP verdict), not
+    # missing data.  A flip needs BOTH benches healthy — "dense 1200 >=
+    # classic 0" is a comparison against a failure, not a win.
+    if dense is not None and sk is not None:
+        if dense > 0 and sk > 0 and dense >= sk:
             add("pallas rows helper default", "FLIP to dense",
                 f"dense {dense:.0f} >= classic {sk:.0f} Msamples/s",
                 "flip ops/pallas_fft.active_rows_helper default")
+        elif dense > 0 and sk > 0:
+            add("pallas rows helper default", "KEEP classic",
+                f"dense {dense:.0f} < classic {sk:.0f} Msamples/s")
         else:
             add("pallas rows helper default", "KEEP classic",
-                f"dense {dense:.0f} < classic {sk:.0f}")
+                f"failed bench row(s): dense {dense}, classic {sk} — "
+                "no flip on failed evidence")
 
     # ---- warm-compile restart target ----
     warm = _result(rows.get("cache_warm"))
@@ -154,6 +162,25 @@ def evaluate(rows: dict) -> list[dict]:
         else:
             add("warm restart", "NOT MET — document remote-compile cache "
                 "bypass", f"cache_warm compile_s {warm['compile_s']}")
+
+    # ---- AOT executable-cache warm restart (round 5) ----
+    for key, label in (("aot_warm", "AOT warm restart (2^27)"),
+                       ("aot_warm_30", "AOT warm restart (2^30 staged)")):
+        r = _result(rows.get(key))
+        if r and r.get("compile_s") is not None:
+            if not r.get("aot_active", False):
+                add(label, "INVALID — AOT cache never engaged",
+                    f"{key} row lacks aot_active=true (cache inactive "
+                    "on this backend?); compile_s is non-AOT evidence")
+            elif r["compile_s"] <= 10:
+                add(label, "MET",
+                    f"{key} compile_s {r['compile_s']} <= 10 s",
+                    "recommend aot_plan_path in the production config; "
+                    "record the warm number in PERF.md")
+            else:
+                add(label, "NOT MET",
+                    f"{key} compile_s {r['compile_s']} > 10 s — "
+                    "profile deserialize_and_load vs executable load")
 
     if not out:
         add("(no decisions)", "NO DATA",

@@ -113,19 +113,18 @@ def test_dist_rfft_pallas_legs_matches_xla_legs(seq_mesh8):
     assert np.abs(got - base).max() / scale < 2e-5
 
 
-def test_dist_fft_in_shard_four_step_recursion(seq_mesh8, monkeypatch):
+def test_dist_fft_in_shard_four_step_recursion(seq_mesh8):
     """The 2^30+ production shapes make each in-shard leg longer than
-    _XLA_FFT_LEN_CAP, so the legs recurse into four_step_fft *inside*
-    the shard_map body.  Force that branch at test scale by lowering
-    the cap (round-3 verdict #7): results must still match numpy."""
-    from srtb_tpu.ops import fft as F
-
-    monkeypatch.setattr(F, "_XLA_FFT_LEN_CAP", 1 << 8)
+    the XLA length cap, so the legs recurse into four_step_fft *inside*
+    the shard_map body.  Force that branch at test scale by passing a
+    low ``len_cap`` explicitly (round-4 verdict #7 de-globalized the
+    cap): results must still match numpy."""
     n = 1 << 18   # legs 512 x 512, cap 256 -> every in-shard leg recurses
     rng = np.random.default_rng(5)
     x = (rng.standard_normal(n)
          + 1j * rng.standard_normal(n)).astype(np.complex64)
-    got = np.asarray(DF.dist_fft(jnp.asarray(x), seq_mesh8))
+    got = np.asarray(DF.dist_fft(jnp.asarray(x), seq_mesh8,
+                                 len_cap=1 << 8))
     want = np.fft.fft(x.astype(np.complex128))
     scale = np.abs(want).max()
     assert np.abs(got - want).max() / scale < 2e-5

@@ -241,9 +241,9 @@ def test_dist_rows_impl_knob(raw_segment, monkeypatch):
     impls_seen = []
     orig = F._fft_minor
 
-    def spy(x, inverse, rows_impl="xla"):
+    def spy(x, inverse, rows_impl="xla", len_cap=None):
         impls_seen.append(rows_impl)
-        return orig(x, inverse, rows_impl)
+        return orig(x, inverse, rows_impl, len_cap)
 
     monkeypatch.setenv("SRTB_DIST_ROWS_IMPL", "pallas")
     monkeypatch.setattr(F, "_fft_minor", spy)

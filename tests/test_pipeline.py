@@ -382,9 +382,9 @@ def test_staged_pallas_rows_impl_matches_default(monkeypatch):
     impls_seen = []
     orig = F._fft_minor
 
-    def spy(x, inverse, rows_impl="xla"):
+    def spy(x, inverse, rows_impl="xla", len_cap=None):
         impls_seen.append(rows_impl)
-        return orig(x, inverse, rows_impl)
+        return orig(x, inverse, rows_impl, len_cap)
 
     for blocked in ("0", "1"):
         monkeypatch.setenv("SRTB_STAGED_BLOCKED", blocked)

@@ -93,6 +93,43 @@ def test_tim_file_matches_oracle(crosscheck_run):
                                atol=2e-4 * scale, rtol=2e-3)
 
 
+def test_time_series_error_bound(crosscheck_run):
+    """VERDICT r4 #4: the time-series error must decompose into its two
+    causes, each under its derived bound — (a) the pairwise-tree f32
+    summation (ops.detect.tree_sum_freq: <= (lg K + lg T + 5) * eps *
+    max raw series, deterministic, backend-independent) and (b) the
+    waterfall's own f32 error propagated through |.|^2 (worst-case
+    coherent Cauchy-Schwarz, no statistical assumption).  The same
+    gates run at the flagship 2^30/2^15 geometry in
+    tools/production_oracle.py; this pins them in CI at test scale."""
+    cfg, pipe, stats, wf_o, ts_o = crosscheck_run
+    wf = np.load(pipe.sinks[0].written[0].npy_paths[0])   # f32 device wf
+    tim_paths = [p for p in pipe.sinks[0].written[0].tim_paths
+                 if ".1.tim" in p]
+    ts = np.fromfile(tim_paths[0], dtype="<f4").astype(np.float64)
+
+    # exact f64 freq-sum of the device's f32 waterfall: the pivot
+    p64 = wf.real.astype(np.float64) ** 2 + wf.imag.astype(np.float64) ** 2
+    ts_pivot = p64.sum(axis=0)
+    ts_raw_max = float(ts_pivot.max())
+    ts_pivot -= ts_pivot.mean()
+
+    from srtb_tpu.ops.detect import time_series_error_gates
+    k_ch, t_len = wf.shape
+    wf_err = np.abs(wf.astype(np.complex128) - wf_o).max()
+    ts_sum_gate, ts_prop_gate = time_series_error_gates(
+        k_ch, t_len, ts_raw_max, wf_err)
+    ts_sum_err = np.abs(ts - ts_pivot).max()
+    assert ts_sum_err <= ts_sum_gate, (ts_sum_err, ts_sum_gate)
+    ts_prop_err = np.abs(ts_pivot - ts_o).max()
+    assert ts_prop_err <= ts_prop_gate, (ts_prop_err, ts_prop_gate)
+
+    # and the total is explained by the two causes together
+    total = np.abs(ts - ts_o).max()
+    assert total <= ts_sum_gate + ts_prop_gate, \
+        (total, ts_sum_gate, ts_prop_gate)
+
+
 def test_rfi_decision_parity_with_injected_tone():
     """Decision-parity tier: a strong injected CW tone must produce the
     SAME stage-1 zap set and SK row-zap count in the pipeline as in the

@@ -261,9 +261,46 @@ def test_e2e_live_harness_smoke(tmp_path):
     assert rec["segments"] >= 1
     assert rec["packets_total"] > 0
     assert rec["metrics_http"]["segments"] == rec["segments"]
+    # both throughput denominators present and labeled (VERDICT r4 #5)
+    assert rec["msamples_per_s_window"] > 0
+    assert rec["lifetime_seconds"] >= rec["seconds"]
     # deadline armed for real above (60 s >> per-segment time): reaching
     # the artifact line at all is the no-hit evidence
     assert rec["deadline_s"] == 60
+
+
+def test_e2e_live_overload_degrades_gracefully(tmp_path):
+    """Overload mode (VERDICT r4 #5): offer wire-rate load far above the
+    CPU compute rate and require the reference's never-stall-on-loss
+    property (ref: io/udp/udp_receiver.hpp:129-164): the pipeline keeps
+    draining segments, excess packets fall off the kernel socket buffer
+    and surface as *accounted* counter-gap loss, and the run terminates
+    cleanly instead of stalling or crashing."""
+    import json
+
+    from srtb_tpu.tools import e2e_live
+
+    out = tmp_path / "e2e_overload.jsonl"
+    rc = e2e_live.main([
+        # rate_x 2.0 = twice the 128 MSa/s wire pace; single-core CPU
+        # compute at 2^18 is far slower, so overload is structural, and
+        # the 32 KB rcvbuf (= half of one 16-packet block) makes the
+        # overflow deterministic even when the OS scheduler starves the
+        # sender (observed flaky at 256 KB on a 1-core host).
+        # --seconds only paces the sender; --max_segments bounds the run.
+        "--seconds", "120", "--rate_x", "2.0", "--log2n", "18",
+        "--log2chan", "7", "--port", "42161", "--deadline_s", "120",
+        "--max_segments", "6", "--rcvbuf_bytes", str(1 << 15),
+        "--prefix", str(tmp_path) + "/out_", "--out", str(out)])
+    assert rc == 0
+    rec = json.loads(out.read_text().splitlines()[-1])
+    assert rec["segments"] == 6
+    # the offered load genuinely exceeded what was drained...
+    assert rec["vs_realtime_window"] < rec["rate_x"]
+    # ...and the excess is visible as accounted loss, not a stall
+    assert rec["packets_lost"] > 0
+    assert 0 < rec["loss_rate"] < 1
+    assert rec["packets_total"] > rec["packets_lost"]
 
 
 def test_trace_summary_wire_parser():

@@ -1,0 +1,325 @@
+#!/bin/bash
+# Round-5 TPU hardware backlog: run everything the round's CPU-side work
+# queued up, in priority order, appending artifacts as it goes.  Safe to
+# re-run; each block is independent.  Run from the repo root with the
+# TPU visible.
+#
+#   bash tools_tpu_r5_queue.sh [quick]
+#
+# "quick" skips the long blocks (2^30, e2e 60s, compile-cache proof).
+set -u
+OUT=${SRTB_PERF_OUT:-PERF_TPU.jsonl}
+stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
+note() { echo "{\"ts\": \"$(stamp)\", \"variant\": \"note\", \"note\": \"$1\"}" >> "$OUT"; }
+run() {
+  local tag="$1"; shift
+  echo "== $tag =="
+  local line
+  line=$("$@" 2>/dev/null | grep '^{' | tail -1)
+  if [ -n "$line" ]; then
+    echo "{\"ts\": \"$(stamp)\", \"variant\": \"$tag\", \"result\": $line}" >> "$OUT"
+    echo "$line"
+  else
+    echo "{\"ts\": \"$(stamp)\", \"variant\": \"$tag\", \"error\": true}" >> "$OUT"
+  fi
+}
+
+QUICK=${1:-}
+
+note "r5 queue start: anchored chirp A/B, pallas A/Bs, 2^30 rebench, e2e live, compile cache"
+
+# ---- 1. headline + the round-2 pending A/Bs (VERDICT weak #4) ----
+run baseline    env SRTB_BENCH_TRACE_DIR=/tmp/r5_trace_baseline python bench.py
+run pallas      env SRTB_BENCH_USE_PALLAS=1 python bench.py
+run pallas_sk   env SRTB_BENCH_USE_PALLAS=1 SRTB_BENCH_USE_PALLAS_SK=1 python bench.py
+run pallas_fs   env SRTB_BENCH_FFT_STRATEGY=pallas python bench.py
+# the fused two-pass four-step (ops/pallas_fft2): segment C2C in 2 HBM
+# round trips, no XLA FFT op — the round-4 roofline-gap candidate.
+# Acceptance first, in isolation: does Mosaic take the two kernels at
+# all (strided col blocks, in-VMEM transposes, in-kernel twiddle)?
+echo "== pallas2 kernel acceptance probe (size sweep) =="
+# per-size isolation, flagship sizes included (round-3 advisor: the
+# padded-footprint sizing must be validated at m=2^28/2^29 before the
+# blocks become defaults); each size in its own subprocess so a Mosaic
+# rejection or VMEM failure at one size can't mask the others
+sweep_failed=0
+for log2m in 24 27 28 29; do
+  timeout 900 python -m srtb_tpu.tools.pallas2_probe --log2m "$log2m" \
+      > /tmp/p2probe.json 2>/dev/null
+  rc=$?
+  line=$(grep '^{' /tmp/p2probe.json 2>/dev/null | tail -1)
+  echo "{\"ts\": \"$(stamp)\", \"variant\": \"pallas2_mosaic_probe_$log2m\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$OUT"
+  echo "${line:-probe $log2m: no output (rc=$rc)}"
+  [ "$rc" -ne 0 ] && sweep_failed=1
+done
+# if any size failed at the default 80 MiB plan budget, A/B the largest
+# size at a reduced budget (smaller blocks, same kernels) before the
+# pipeline benches commit to a spelling
+if [ "$sweep_failed" = 1 ]; then
+  run pallas2_lowvmem_29 env SRTB_PALLAS2_VMEM_MB=48 timeout 900 \
+      python -m srtb_tpu.tools.pallas2_probe --log2m 29
+  run pallas2_lowvmem_small_29 env SRTB_PALLAS2_VMEM_MB=48 \
+      SRTB_PALLAS2_BB=128 SRTB_PALLAS2_RB=8 timeout 900 \
+      python -m srtb_tpu.tools.pallas2_probe --log2m 29
+fi
+# factorization A/B at 2^27 (default n1=4096x32768 vs 8192x16384):
+# different block geometry, same math — the fallback axis if the
+# default plan misses VMEM or underperforms
+run pallas2_n1_8192_27 env SRTB_PALLAS2_N1=8192 timeout 900 \
+    python -m srtb_tpu.tools.pallas2_probe --log2m 27
+# First pipeline exposure: bound it so a Mosaic/VMEM failure can't eat
+# the queue; if VMEM overflows, retry with smaller blocks.
+run pallas2     env SRTB_BENCH_FFT_STRATEGY=pallas2 SRTB_BENCH_DEADLINE=900 \
+    SRTB_BENCH_TRACE_DIR=/tmp/r5_trace_pallas2 python bench.py
+echo "== trace summary (pallas2) =="
+python -m srtb_tpu.tools.trace_summary /tmp/r5_trace_pallas2 --top 10 \
+    2>/dev/null \
+  | while read -r line; do
+      case "$line" in {*)
+        echo "{\"ts\": \"$(stamp)\", \"variant\": \"trace_summary_pallas2\", \"result\": $line}" >> "$OUT"
+        echo "$line";;
+      esac
+    done
+run pallas2_small_blk env SRTB_BENCH_FFT_STRATEGY=pallas2 SRTB_PALLAS2_BB=64 \
+    SRTB_PALLAS2_RB=8 SRTB_BENCH_DEADLINE=900 python bench.py
+# alternate Mosaic lowering of the same math (transpose-to-rows +
+# classic two-level helper) — the A/B partner / fallback if the
+# column-native dot_general spelling compiles or performs badly
+run pallas2_rowspell env SRTB_BENCH_FFT_STRATEGY=pallas2 \
+    SRTB_PALLAS2_P1=row SRTB_PALLAS2_ROWS=classic \
+    SRTB_BENCH_DEADLINE=900 python bench.py
+# dense-helper A/B on the PROVEN waterfall/SK row kernels
+run pallas_dense env SRTB_BENCH_USE_PALLAS=1 SRTB_BENCH_USE_PALLAS_SK=1 \
+    SRTB_PALLAS_ROWS=dense SRTB_BENCH_DEADLINE=900 python bench.py
+# big-block A/B on the same proven kernels: 56 MiB plan vs the 1 MB-plane
+# default (v5e has 128 MiB VMEM; fewer grid steps, longer DMA bursts)
+run pallas_bigblk env SRTB_BENCH_USE_PALLAS=1 SRTB_BENCH_USE_PALLAS_SK=1 \
+    SRTB_PALLAS_VMEM_MB=56 SRTB_BENCH_DEADLINE=900 python bench.py
+# everything-fused flagship: two-pass FFT + fused RFI/chirp + fused
+# waterfall/SK stats
+run pallas2_full env SRTB_BENCH_FFT_STRATEGY=pallas2 SRTB_BENCH_USE_PALLAS=1 \
+    SRTB_BENCH_USE_PALLAS_SK=1 SRTB_BENCH_DEADLINE=900 python bench.py
+
+# per-stage attribution of the baseline trace captured above
+echo "== trace summary (baseline) =="
+python -m srtb_tpu.tools.trace_summary /tmp/r5_trace_baseline --top 10 \
+    2>/dev/null \
+  | while read -r line; do
+      case "$line" in {*)
+        echo "{\"ts\": \"$(stamp)\", \"variant\": \"trace_summary\", \"result\": $line}" >> "$OUT"
+        echo "$line";;
+      esac
+    done
+
+# ---- 1b. blocked-plane Pallas unpack: Mosaic acceptance probe ----
+# (flip ops/pallas_kernels.PLANES_UNPACK_MOSAIC_OK to True if this
+# compiles and matches — the spelling avoids the sample-order kernel's
+# lane interleave, but only a real-chip compile proves Mosaic takes it)
+echo "== planes unpack Mosaic probe =="
+( timeout 300 python - <<'PYEOF'
+import numpy as np, jax.numpy as jnp
+from srtb_tpu.ops import pallas_kernels as pk, unpack as U
+rng = np.random.default_rng(0)
+data = jnp.asarray(rng.integers(0, 256, 1 << 16, dtype=np.uint8))
+got = np.asarray(pk.unpack_subbyte_planes_window(data, 2, interpret=False))
+want = np.asarray(U.unpack_subbyte_planes(data, 2))
+np.testing.assert_array_equal(got, want)
+print('{"probe": "planes_unpack_mosaic", "ok": true}')
+PYEOF
+) > /tmp/planes_probe.json 2>/dev/null
+rc=$?
+line=$(grep '^{' /tmp/planes_probe.json 2>/dev/null | tail -1)
+echo "{\"ts\": \"$(stamp)\", \"variant\": \"planes_unpack_mosaic_probe\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$OUT"
+
+# ---- 1c. MXU DFT precision A/B: 3-pass vs 6-pass bf16 on chip ----
+# accuracy is only provable on real bf16 MXU passes (CPU computes f32
+# exactly); if 'high' holds ~1e-6 while running ~2x, flip the default
+echo "== mxu precision probe =="
+( timeout 600 python - <<'PYEOF'
+import json, os, time
+from srtb_tpu.utils.platform import apply_platform_env
+apply_platform_env()
+import numpy as np, jax, jax.numpy as jnp
+from srtb_tpu.ops.mxu_fft import mxu_fft
+n = 1 << 22
+rng = np.random.default_rng(0)
+x = (rng.standard_normal(n) + 1j * rng.standard_normal(n)).astype(np.complex64)
+want = np.fft.fft(x.astype(np.complex128))
+for prec in ("highest", "high"):
+    os.environ["SRTB_MXU_PRECISION"] = prec
+    f = jax.jit(lambda v: mxu_fft(v))
+    y = f(jnp.asarray(x))
+    re, im = np.asarray(jnp.real(y)), np.asarray(jnp.imag(y))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        y = f(jnp.asarray(x))
+    np.asarray(jnp.real(y)[:8])
+    dt = (time.perf_counter() - t0) / 5
+    err = np.abs((re + 1j * im) - want).max() / np.abs(want).max()
+    print(json.dumps({"probe": "mxu_precision", "prec": prec,
+                      "rel_err": float(err), "ms": round(dt * 1e3, 2)}))
+PYEOF
+) | while read -r line; do
+      # one variant per precision: load-latest-row-per-variant consumers
+      # (queue_decisions) must see BOTH rows
+      case "$line" in
+        *'"prec": "highest"'*) v=mxu_precision_probe_highest;;
+        *'"prec": "high"'*) v=mxu_precision_probe_high;;
+        *) v=mxu_precision_probe;;
+      esac
+      case "$line" in {*) echo "{\"ts\": \"$(stamp)\", \"variant\": \"$v\", \"result\": $line}" >> "$OUT"; echo "$line";; esac
+    done
+
+# ---- 2. per-kernel rows incl. the anchored-vs-exact chirp A/B ----
+echo "== kernel bench (anchored chirp A/B) =="
+python -m srtb_tpu.tools.kernel_bench --log2n 28 --reps 5 2>/dev/null \
+  | while read -r line; do
+      echo "{\"ts\": \"$(stamp)\", \"variant\": \"kernel\", \"result\": $line}" >> "$OUT"
+      echo "$line"
+    done
+
+if [ "$QUICK" = "quick" ]; then exit 0; fi
+
+# ---- 1d. segment-R2C isolation sweep: pallas2 vs the field ----
+echo "== fft isolation sweep =="
+timeout 2400 python -m srtb_tpu.tools.fft_bench 27 29 \
+    monolithic,pallas,pallas2 2>/dev/null \
+  | while read -r line; do
+      case "$line" in {*)
+        echo "{\"ts\": \"$(stamp)\", \"variant\": \"fft_bench\", \"result\": $line}" >> "$OUT"
+        echo "$line";;
+      esac
+    done
+
+
+# ---- 3. 2^30 production segment rebench (VERDICT #3) ----
+run n2_30       env SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 SRTB_BENCH_REPS=3 python bench.py
+# classic staged plan with Pallas leg FFTs (VMEM rows instead of XLA's
+# giant batched FFTs) — candidate for the >=2x 2^30 target
+# first run of Pallas legs at this shape: bound it tighter than
+# bench.py's default 3000 s watchdog so a hang can't eat the queue
+run n2_30_pallas_legs env SRTB_STAGED_ROWS_IMPL=pallas SRTB_BENCH_LOG2N=30 \
+    SRTB_BENCH_LOG2CHAN=15 SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=1200 \
+    python bench.py
+# the blocked staged stage_a SIGSEGV probe: bounded, in a subshell so a
+# compiler crash cannot wedge this queue (note the rc either way)
+echo "== staged-blocked 2^30 probe =="
+( timeout 900 env SRTB_STAGED_BLOCKED=1 SRTB_BENCH_LOG2N=30 \
+    SRTB_BENCH_LOG2CHAN=15 SRTB_BENCH_REPS=1 SRTB_BENCH_DEADLINE=800 \
+    python bench.py > /tmp/staged_blocked_probe.json 2>/dev/null )
+rc=$?
+line=$(grep '^{' /tmp/staged_blocked_probe.json 2>/dev/null | tail -1)
+echo "{\"ts\": \"$(stamp)\", \"variant\": \"staged_blocked_probe\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$OUT"
+# workaround candidate: Pallas leg FFTs (no XLA batched-FFT op in the
+# crashing program at all)
+echo "== staged-blocked 2^30 probe, pallas legs =="
+( timeout 900 env SRTB_STAGED_BLOCKED=1 SRTB_STAGED_ROWS_IMPL=pallas \
+    SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 SRTB_BENCH_REPS=1 \
+    SRTB_BENCH_DEADLINE=800 \
+    python bench.py > /tmp/staged_blocked_pallas.json 2>/dev/null )
+rc=$?
+line=$(grep '^{' /tmp/staged_blocked_pallas.json 2>/dev/null | tail -1)
+echo "{\"ts\": \"$(stamp)\", \"variant\": \"staged_blocked_pallas_probe\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$OUT"
+# fused two-pass legs across the staged boundary (pass1 | pass2): the
+# fewest-HBM-passes 2^30 plan, classic unpack first, then the
+# lane-dense blocked unpack (both XLA-FFT-free)
+run n2_30_pallas2 env SRTB_STAGED_ROWS_IMPL=pallas2 SRTB_BENCH_LOG2N=30 \
+    SRTB_BENCH_LOG2CHAN=15 SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=1200 \
+    python bench.py
+# flagship everything-on 2^30: pallas2 staged legs + fused RFI/chirp +
+# fused waterfall/SK stats in stage (c)
+run n2_30_pallas2_full env SRTB_STAGED_ROWS_IMPL=pallas2 \
+    SRTB_BENCH_USE_PALLAS=1 SRTB_BENCH_USE_PALLAS_SK=1 \
+    SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 SRTB_BENCH_REPS=3 \
+    SRTB_BENCH_DEADLINE=1200 python bench.py
+# one-program 2^30: no XLA FFT scratch with pallas2, so the fused plan
+# may fit in 16 GB where it used to OOM — would erase both 4 GB staged
+# boundary crossings (VERDICT #3's second half).  Bounded probe.
+echo "== one-program 2^30 probe, pallas2 fused =="
+( timeout 1200 env SRTB_BENCH_STAGED=0 SRTB_BENCH_FFT_STRATEGY=pallas2 \
+    SRTB_BENCH_USE_PALLAS=1 SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 \
+    SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=1100 \
+    python bench.py > /tmp/fused_2_30_pallas2.json 2>/dev/null )
+rc=$?
+line=$(grep '^{' /tmp/fused_2_30_pallas2.json 2>/dev/null | tail -1)
+echo "{\"ts\": \"$(stamp)\", \"variant\": \"fused_2_30_pallas2_probe\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$OUT"
+
+echo "== staged-blocked 2^30 probe, pallas2 legs =="
+( timeout 1200 env SRTB_STAGED_BLOCKED=1 SRTB_STAGED_ROWS_IMPL=pallas2 \
+    SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 SRTB_BENCH_REPS=3 \
+    SRTB_BENCH_DEADLINE=1100 \
+    python bench.py > /tmp/staged_blocked_pallas2.json 2>/dev/null )
+rc=$?
+line=$(grep '^{' /tmp/staged_blocked_pallas2.json 2>/dev/null | tail -1)
+echo "{\"ts\": \"$(stamp)\", \"variant\": \"staged_blocked_pallas2_probe\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$OUT"
+
+# ---- 4. live UDP -> TPU end-to-end, 60 s at 2x wire rate (VERDICT #6),
+#         two receivers = the reference's per-polarization deployment ----
+python -m srtb_tpu.tools.e2e_live --seconds 60 --rate_x 2.0 --log2n 27 \
+  --receivers 2 --deadline_s 120 --gui --gui_min_interval_s 1 \
+  --out E2E_LIVE.jsonl \
+  || note "e2e_live failed"
+
+# ---- 5. compile-cache cold/warm proof across process restarts (VERDICT #7) ----
+# same config twice in separate processes; the second run's compile_s is
+# the warm number (target <= 10 s)
+run cache_cold  env SRTB_BENCH_LOG2N=27 SRTB_BENCH_REPS=3 python bench.py
+run cache_warm  env SRTB_BENCH_LOG2N=27 SRTB_BENCH_REPS=3 python bench.py
+
+# ---- 5b. AOT executable cache cold/warm (round-5: utils/aot_cache) ----
+# the fallback when the compile cache is bypassed by a remote-compile
+# service: the second run loads persisted *executables* — its compile_s
+# is the AOT warm-restart number (target <= 10 s regardless of cache
+# behavior above).  Then the number that actually matters: the 2^30
+# staged plan, whose cold compile was ~11 min in round 2.
+rm -rf /tmp/r5_aot_27 /tmp/r5_aot_30
+run aot_cold    env SRTB_BENCH_LOG2N=27 SRTB_BENCH_REPS=3 \
+    SRTB_BENCH_AOT_DIR=/tmp/r5_aot_27 python bench.py
+run aot_warm    env SRTB_BENCH_LOG2N=27 SRTB_BENCH_REPS=3 \
+    SRTB_BENCH_AOT_DIR=/tmp/r5_aot_27 python bench.py
+run aot_cold_30 env SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 \
+    SRTB_BENCH_REPS=1 SRTB_BENCH_AOT_DIR=/tmp/r5_aot_30 python bench.py
+run aot_warm_30 env SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 \
+    SRTB_BENCH_REPS=1 SRTB_BENCH_AOT_DIR=/tmp/r5_aot_30 python bench.py
+
+note "r5 queue done"
+
+# turn the rows into the decision tree's conclusions (report only;
+# applying a flip stays a reviewed edit) — the recovery commit then
+# carries its own analysis even if nobody is attached.  A crash here
+# must leave a trace like every other block (stderr goes to the queue
+# log, failure lands as a note row).
+line=$(python -m srtb_tpu.tools.queue_decisions --perf "$OUT" \
+       --out DECISIONS_r5.md | grep '^{' | tail -1)
+if [ -n "$line" ]; then
+  echo "{\"ts\": \"$(stamp)\", \"variant\": \"decisions\", \"result\": $line}" >> "$OUT"
+else
+  note "queue_decisions failed (no JSON line; see queue log stderr)"
+fi
+
+# ---- decision tree for the results ----
+# (srtb_tpu.tools.queue_decisions evaluates this tree automatically at
+#  the end of every queue run into DECISIONS_r5.md; applying a flip
+#  stays a reviewed edit, in-session or next round)
+# pallas2_mosaic_probe_24..29 all ok AND pallas2 >= 1.2x baseline
+#     -> make resolve_strategy "auto" pick pallas2 for n in [2^25, 2^30)
+#        and rerun the default bench so BENCH_r0N reflects it.
+# pallas2 VMEM/compile failure
+#     -> pallas2_lowvmem_* / pallas2_small_blk / pallas2_rowspell /
+#        pallas2_n1_8192_27 are the retries (budget, blocks, spelling,
+#        factorization); if all fail, monolithic stays default and the
+#        probe rc/error rows document why.
+# best(n2_30_pallas2, n2_30_pallas2_full, staged_blocked_pallas2,
+#      fused_2_30_pallas2) <= 1.4 s/segment
+#     -> VERDICT #3 target met; make that plan the n >= 2^30 default.
+# planes_unpack_mosaic_probe ok -> flip pallas_kernels.PLANES_UNPACK_MOSAIC_OK.
+# mxu_precision_probe_high rel_err <= ~2e-6 -> flip SRTB_MXU_PRECISION default.
+# pallas_dense >= pallas_sk -> flip pallas_fft.active_rows_helper default.
+# pallas_bigblk >= pallas_sk -> adopt SRTB_PALLAS_VMEM_MB=56 as the
+#     accelerator default row-block plan (ops/pallas_fft._row_block).
+# cache_warm compile_s <= 10 s -> VERDICT #7 done; else the axon remote
+#     compile service bypasses the local disk cache — document and file.
+# aot_warm / aot_warm_30 compile_s <= 10 s -> the AOT executable cache
+#     closes the warm-restart gap even with the compile cache bypassed;
+#     document the measured warm numbers in PERF.md and recommend
+#     aot_plan_path in the production config.

@@ -12,10 +12,10 @@
 #   nohup bash tools_tpu_watcher.sh >/dev/null 2>&1 &   # arm
 #   bash ci.sh --hardware                                # same, via CI
 #
-# Env: SRTB_TPU_QUEUE (default tools_tpu_r4_queue.sh), SRTB_WATCH_LOG.
+# Env: SRTB_TPU_QUEUE (default tools_tpu_r5_queue.sh), SRTB_WATCH_LOG.
 set -u
 cd "$(dirname "$0")"
-QUEUE=${SRTB_TPU_QUEUE:-tools_tpu_r4_queue.sh}
+QUEUE=${SRTB_TPU_QUEUE:-tools_tpu_r5_queue.sh}
 LOG=${SRTB_WATCH_LOG:-/tmp/tpu_watcher.log}
 PIDFILE=/tmp/tpu_watcher.pid
 
@@ -53,7 +53,8 @@ while true; do
     # name files that exist — one missing pathspec fails the WHOLE
     # commit and would lose the hardware rows.
     ARTS=""
-    for f in PERF_TPU.jsonl E2E_LIVE.jsonl DECISIONS_r4.md; do
+    for f in PERF_TPU.jsonl E2E_LIVE.jsonl DECISIONS_r4.md \
+             DECISIONS_r5.md; do
       [ -f "$f" ] && ARTS="$ARTS $f"
     done
     if [ -n "$ARTS" ]; then
