@@ -122,6 +122,14 @@ def time_series_error_gates(k_ch: int, t_len: int, ts_raw_max: float,
     return ts_sum_gate, ts_prop_gate
 
 
+def tree_mean(ts: jnp.ndarray) -> jnp.ndarray:
+    """Mean over the last axis via the pairwise tree (shape [..., 1]) —
+    the single home of the mean-subtract spelling whose rounding the
+    ``time_series_error_gates`` bound accounts for; used by the
+    single-chip detect tail and the distributed step body."""
+    return tree_sum_freq(ts[..., :, None]) / ts.shape[-1]
+
+
 def boxcar_lengths(max_boxcar_length: int, time_series_count: int) -> tuple:
     """Static list of boxcar lengths: 1 then 2,4,... while <= max and < T
     (ref: signal_detect_pipe.hpp:387-389)."""
@@ -181,8 +189,7 @@ def detect_from_time_series(ts: jnp.ndarray, zero_count: jnp.ndarray,
     # discipline as the frequency sum: the series sits at K*mean_power
     # scale, so an order-unspecified sum over T = 2^14 samples could
     # contribute more error than the whole frequency reduction
-    mean = tree_sum_freq(ts[..., :, None])[..., 0:1] / t
-    ts = ts - mean
+    ts = ts - tree_mean(ts)
 
     lengths = boxcar_lengths(max_boxcar_length, t)
     n_box = len(lengths)

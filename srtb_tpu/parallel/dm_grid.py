@@ -63,14 +63,14 @@ def build_chirp_bank(dm_list, n_spectrum: int, f_min: float, df: float,
 
 def _trial_body(spec_ri, chirp_block, *, channel_count, time_reserved_count,
                 snr_threshold, max_boxcar_length, sk_threshold,
-                dewindow=None):
+                dewindow=None, len_cap=None):
     """Per-device: run all local DM trials on the replicated spectrum."""
     spec = jax.lax.complex(spec_ri[0], spec_ri[1])
 
     def one(chirp_ri):
         chirp = jax.lax.complex(chirp_ri[0], chirp_ri[1])
         s = dd.dedisperse(spec, chirp)
-        wf = F.waterfall_c2c(s, channel_count, dewindow)
+        wf = F.waterfall_c2c(s, channel_count, dewindow, len_cap=len_cap)
         wf = rfi.mitigate_rfi_spectral_kurtosis(wf, sk_threshold)
         r = det.detect(wf, time_reserved_count, snr_threshold,
                        max_boxcar_length)
@@ -83,7 +83,8 @@ def dm_trial_search(spectrum_ri: jnp.ndarray, chirp_bank: jnp.ndarray,
                     dm_list, mesh: Mesh, *, channel_count: int,
                     time_reserved_count: int, snr_threshold: float,
                     max_boxcar_length: int, sk_threshold: float,
-                    dewindow=None) -> DMTrialResult:
+                    dewindow=None, len_cap: int | None = None
+                    ) -> DMTrialResult:
     """Run the DM grid on one segment's (RFI-cleaned) spectrum.
 
     ``spectrum_ri`` [2, n_spectrum] (re, im) is replicated (XLA broadcasts
@@ -99,7 +100,8 @@ def dm_trial_search(spectrum_ri: jnp.ndarray, chirp_bank: jnp.ndarray,
                    max_boxcar_length=max_boxcar_length,
                    sk_threshold=sk_threshold,
                    dewindow=None if dewindow is None
-                   else jnp.asarray(dewindow))
+                   else jnp.asarray(dewindow),
+                   len_cap=len_cap)
     fn = shard_map(body, mesh=mesh,
                    in_specs=(P(), P("dm", None, None)),
                    out_specs=P("dm"))

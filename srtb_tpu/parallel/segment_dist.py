@@ -276,9 +276,9 @@ class DistSegmentProcessor:
                     + jnp.imag(wf[:, :, :t]) ** 2),
                 "seq")                                  # [S, t]
             # tree-sum the time mean too (same discipline as the local
-            # channel sum above; det.detect_from_time_series does the
-            # same on the single-chip path)
-            ts = ts - det.tree_sum_freq(ts[..., :, None]) / ts.shape[-1]
+            # channel sum above; shared spelling with the single-chip
+            # detect tail)
+            ts = ts - det.tree_mean(ts)
             # boxcar cascade on the (replicated) time series
             lengths = det.boxcar_lengths(max_boxcar_length, t)
             acc = jnp.cumsum(ts, axis=-1)

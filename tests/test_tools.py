@@ -431,6 +431,55 @@ def test_queue_decisions(tmp_path):
     assert QD.evaluate(QD.load_rows(str(empty)))[0]["verdict"] == "NO DATA"
 
 
+def test_queue_decisions_failed_and_aot_rows(tmp_path):
+    """Round-5 review hardening: a failed (0.0) bench row is present
+    evidence but never a flip justification, and AOT warm verdicts
+    require the cache to have actually engaged (aot_active)."""
+    import json
+
+    from srtb_tpu.tools import queue_decisions as QD
+
+    rows = [
+        # dense succeeded, classic FAILED -> must not flip on a failure
+        {"variant": "pallas_sk", "result": {"value": 0.0}},
+        {"variant": "pallas_dense", "result": {"value": 1600.0}},
+        # aot_warm fast but the cache never engaged -> INVALID
+        {"variant": "aot_warm", "result": {"compile_s": 1.0,
+                                           "aot_active": False}},
+        # aot_warm_30 engaged and fast -> MET
+        {"variant": "aot_warm_30", "result": {"compile_s": 6.0,
+                                              "aot_active": True}},
+    ]
+    perf = tmp_path / "perf.jsonl"
+    perf.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    decisions = {d["decision"]: d
+                 for d in QD.evaluate(QD.load_rows(str(perf)))}
+    d = decisions["pallas rows helper default"]
+    assert d["verdict"] == "KEEP classic" and "failed" in d["evidence"]
+    assert decisions["AOT warm restart (2^27)"]["verdict"].startswith(
+        "INVALID")
+    assert decisions["AOT warm restart (2^30 staged)"]["verdict"] == "MET"
+
+
+def test_pallas2_pin_loud_at_dispatch(monkeypatch):
+    """An SRTB_PALLAS2_N1 pin that cannot fit the actual segment size
+    must fail loudly at the dispatch fallback (ops/fft and the staged
+    plan) instead of silently benchmarking the non-pallas2 path — while
+    the unpinned tiny-config fallback stays quiet."""
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+
+    from srtb_tpu.ops import fft as F
+
+    z = jnp.asarray(np.zeros(1 << 13, np.complex64))
+    # unpinned: quiet fallback (the documented tiny-config path)
+    F._pallas2_or_fallback(z, "pallas2_interpret")
+    monkeypatch.setenv("SRTB_PALLAS2_N1", "8192")
+    with pytest.raises(ValueError, match="SRTB_PALLAS2_N1"):
+        F._pallas2_or_fallback(z, "pallas2_interpret")
+
+
 def test_waterfall_service_per_receiver_stream_id(tmp_path):
     """data_stream_id names the PANE for per-receiver (S=1) segments —
     it must not be used as an S index (found live: MultiUdpSource
