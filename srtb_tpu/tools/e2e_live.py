@@ -179,7 +179,19 @@ def run(args) -> dict:
     waterfall_service = None
     gui_frames = [0]
     if args.gui:
+        import glob
+
         from srtb_tpu.gui.waterfall import WaterfallService
+        # clear stale frames from a prior run of the same prefix: the
+        # served-frames self-check below must count THIS run's renders,
+        # not last run's leftovers
+        for old in glob.glob(os.path.join(
+                os.path.dirname(args.prefix) or ".",
+                "waterfall_s*_*.png")):
+            try:
+                os.remove(old)
+            except OSError:
+                pass
         n_spec = n // 2
         nchan = min(cfg.spectrum_channel_count, n_spec)
         waterfall_service = WaterfallService(
@@ -233,6 +245,16 @@ def run(args) -> dict:
             f"http://127.0.0.1:{http_srv.port}/metrics.json",
             timeout=10) as r:
         metrics_http = json.loads(r.read().decode())
+    gui_frames_served = None
+    if args.gui:
+        # self-verifying: the server must actually list the frames the
+        # tap rendered (regression guard for serving the wrong
+        # directory, where /frames.json stayed empty forever)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{http_srv.port}/frames.json",
+                timeout=10) as r:
+            streams = json.loads(r.read().decode()).get("streams", {})
+        gui_frames_served = sum(len(v) for v in streams.values())
     http_srv.stop()
 
     total = metrics_http.get("packets_total", 0.0)
@@ -265,6 +287,7 @@ def run(args) -> dict:
         "deadline_s": args.deadline_s,
         "deadline_hits": 0,  # a hit aborts before this line is reached
         "gui_frames": gui_frames[0] if waterfall_service else None,
+        "gui_frames_served": gui_frames_served,
         "metrics_http": metrics_http,
     }
     try:

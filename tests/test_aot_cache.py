@@ -81,6 +81,32 @@ def test_aot_signature_miss_recompiles(tmp_path, monkeypatch):
     assert len(glob.glob(str(tmp_path / "aot" / "*.aot"))) == 2 * n_blobs
 
 
+def test_plan_signature_keys_on_trace_shape_only(tmp_path, monkeypatch):
+    """The AOT cache key must ignore deployment-local knobs (paths,
+    socket buffers) — an operator relocating outputs or tuning IO
+    between runs must still hit the cache — while any trace-shaping
+    field must miss."""
+    monkeypatch.setenv("SRTB_AOT_ALLOW_CPU", "1")
+    cfg = _cfg(tmp_path)
+    sig = SegmentProcessor(cfg).plan_signature()
+    # deployment-local changes: same signature
+    same = cfg.replace(baseband_output_file_prefix="/elsewhere/out_",
+                       udp_receiver_rcvbuf_bytes=1 << 20,
+                       segment_deadline_s=42.0)
+    assert SegmentProcessor(same).plan_signature() == sig
+    # run-local SRTB_ knobs (bench dirs, watcher logs): same signature
+    monkeypatch.setenv("SRTB_BENCH_AOT_DIR", "/tmp/other")
+    monkeypatch.setenv("SRTB_WATCH_LOG", "/tmp/w.log")
+    assert SegmentProcessor(same).plan_signature() == sig
+    # trace-shaping changes: different signature
+    assert SegmentProcessor(
+        cfg.replace(spectrum_channel_count=1 << 5)).plan_signature() != sig
+    assert SegmentProcessor(
+        cfg.replace(fft_strategy="four_step")).plan_signature() != sig
+    monkeypatch.setenv("SRTB_STAGED_ROWS_IMPL", "pallas")
+    assert SegmentProcessor(cfg).plan_signature() != sig
+
+
 def test_aot_cpu_default_off(tmp_path, monkeypatch):
     """Without the opt-in, CPU backends keep the plain jit wrappers and
     write nothing (the host-swap SIGILL policy)."""

@@ -72,6 +72,21 @@ def test_dm_grid_finds_true_dm(raw_segment):
     assert dm_list[idx] == 30.0, \
         f"best dm {dm_list[idx]} snr {snr}, peaks={np.asarray(res.snr_peaks).max(axis=-1)}"
 
+    # len_cap threads through to the trial waterfalls (Config.fft_len_cap
+    # contract): forcing the in-trial four-step recursion must not
+    # change any detection outcome
+    res_cap = dm_grid.dm_trial_search(
+        spec, bank, dm_list, mesh,
+        channel_count=proc.channel_count,
+        time_reserved_count=0,
+        snr_threshold=6.0,
+        max_boxcar_length=32,
+        sk_threshold=cfg.mitigate_rfi_spectral_kurtosis_threshold,
+        len_cap=1 << 4)
+    np.testing.assert_allclose(
+        np.asarray(res_cap.snr_peaks), np.asarray(res.snr_peaks),
+        rtol=2e-4, atol=1e-3)
+
 
 def test_chirp_bank_on_device_matches_host():
     mesh = M.dm_mesh(8)

@@ -255,12 +255,18 @@ def test_e2e_live_harness_smoke(tmp_path):
     rc = e2e_live.main([
         "--seconds", "1.5", "--rate_x", "0.05", "--log2n", "18",
         "--log2chan", "7", "--port", "42157", "--deadline_s", "60",
+        "--gui", "--gui_min_interval_s", "0.2",
         "--prefix", str(tmp_path) + "/out_", "--out", str(out)])
     assert rc == 0
     rec = json.loads(out.read_text().splitlines()[-1])
     assert rec["segments"] >= 1
     assert rec["packets_total"] > 0
     assert rec["metrics_http"]["segments"] == rec["segments"]
+    # the HTTP server must list the tap's rendered frames (regression:
+    # serving the prefix instead of its directory kept /frames.json
+    # empty forever)
+    assert rec["gui_frames"] >= 1
+    assert rec["gui_frames_served"] >= 1
     # both throughput denominators present and labeled (VERDICT r4 #5)
     assert rec["msamples_per_s_window"] > 0
     assert rec["lifetime_seconds"] >= rec["seconds"]
