@@ -48,16 +48,23 @@ import jax.numpy as jnp
 import numpy as np
 
 from srtb_tpu.ops import fft as F
-from srtb_tpu.utils.logging import log
 
-# v5e VMEM is ~16 MB/core.  Live per grid step: in + out + two stage
-# intermediates (all [rows, L] f32 pairs) + matrices + twiddle.
+# Default row-block plan: 1 MB planes (v5e VMEM is 128 MiB/core, but
+# small blocks keep the pipeline's working set comfortably inside the
+# 100 MiB scoped limit _call_kwargs sets; SRTB_PALLAS_VMEM_MB scales
+# both).  Live per grid step: in + out + stage intermediates (all
+# [rows, *] f32 pairs) + matrices + twiddle.
 _VMEM_BLOCK_ELEMS = 1 << 18  # 256K f32 = 1 MB per plane
 
-# Matmul precision for the DFT contractions: 3-pass bf16 ("highest"
-# would be 6) — for contraction lengths <= 512 the bf16x3 error is
-# ~1e-6 relative, measured against the float64 oracle in tests.
-_PRECISION = jax.lax.Precision.HIGH
+# Matmul precision for the DFT contractions.  HIGHEST (6-pass bf16,
+# f32-accurate) is the only accurate option real Mosaic accepts: the
+# round-5 acceptance run rejected 3-pass bf16 outright
+# ("NotImplementedError: Unsupported dot precision: HIGH",
+# PERF_TPU.jsonl 2026-08-02) — an error CPU interpret mode cannot
+# surface.  The extra passes run on VMEM-resident blocks in an
+# HBM-bound pipeline (roofline_frac ~0.06), so HIGHEST costs nothing
+# measurable end-to-end.
+_PRECISION = jax.lax.Precision.HIGHEST
 
 
 def _split_la_lb(length: int):
@@ -79,98 +86,58 @@ def supported(length: int, batch: int) -> bool:
 
 def vmem_fft_rows(xr, xi, war, wai, wbr, wbi, twr, twi, *, la, lb, rows):
     """The in-VMEM two-level row FFT on value arrays: [rows, L] f32
-    (re, im) -> length-L C2C along each row in natural order, L = la*lb.
-    Pure function of VMEM-resident values — shared by the kernels here
-    and by the fused two-pass four-step in ops/pallas_fft2."""
-    def mm(a, b):
-        return jax.lax.dot(a, b, precision=_PRECISION,
-                           preferred_element_type=jnp.float32)
+    (re, im) -> length-L C2C along each row, L = la*lb, la = 128.
+    Returns the natural-order result as a 3D ``[rows, la, lb]`` view
+    whose row-major flatten IS the natural-order row (element
+    ``[r, ka, kb]`` is bin ``k = ka*lb + kb``) — kernels store it to a
+    matching 3D ref and callers flatten OUTSIDE the pallas_call, where
+    the contiguous reshape is free metadata.  Pure function of
+    VMEM-resident values — shared by the kernels here and by the fused
+    two-pass four-step in ops/pallas_fft2.
 
-    # [rows, L] -> [La, rows*Lb]  (j1 major for the level-1 contraction)
-    def to_stage1(x):
-        x = x.reshape(rows, la, lb)
-        return jnp.transpose(x, (1, 0, 2)).reshape(la, rows * lb)
-
-    xr, xi = to_stage1(xr), to_stage1(xi)
-    # A[k1, (r, j2)] = sum_j1 Wa[j1, k1] x[j1, (r, j2)]
-    ar = mm(war.T, xr) - mm(wai.T, xi)
-    ai = mm(war.T, xi) + mm(wai.T, xr)
-    # twiddle w[k1, j2], broadcast over rows
-    a3r = ar.reshape(la, rows, lb)
-    a3i = ai.reshape(la, rows, lb)
-    twr = twr.reshape(la, 1, lb)
-    twi = twi.reshape(la, 1, lb)
-    br = a3r * twr - a3i * twi
-    bi = a3r * twi + a3i * twr
-    # B[(k1, r), k2] = sum_j2 A[(k1, r), j2] Wb[j2, k2]
-    b2r = br.reshape(la * rows, lb)
-    b2i = bi.reshape(la * rows, lb)
-    cr = mm(b2r, wbr) - mm(b2i, wbi)
-    ci = mm(b2r, wbi) + mm(b2i, wbr)
-    # natural order: X[k2*La + k1] -> [rows, Lb(k2), La(k1)] -> [rows, L]
-    c3r = cr.reshape(la, rows, lb)
-    c3i = ci.reshape(la, rows, lb)
-    yr = jnp.transpose(c3r, (1, 2, 0)).reshape(rows, la * lb)
-    yi = jnp.transpose(c3i, (1, 2, 0)).reshape(rows, la * lb)
+    This is the one spelling real Mosaic accepts (round-5 acceptance
+    probes, PERF_TPU.jsonl 2026-08-02): in-kernel lane-dim reshapes
+    compile only when the minor dim is a multiple of 128 on both sides,
+    which rules out the historical ``[rows, la, lb]`` input split and
+    any in-kernel flatten of the assembled result.  Decimation here is
+    ``j = jb*la + ja`` (ja the 128-lane minor digit), so the only input
+    reshape is the supported minor-128 split, both DFT contractions are
+    3D dot_generals against the middle axis, and the assembly is one
+    supported 3D transpose."""
+    dg = dot_mid
+    # j = jb*la + ja: the minor-128 split Mosaic accepts
+    x3r = xr.reshape(rows, lb, la)
+    x3i = xi.reshape(rows, lb, la)
+    # stage 1, contract jb: A[r, ja, kb] = sum_jb Wb[jb, kb] x[r, jb, ja]
+    ar = dg(x3r, wbr, 1) - dg(x3i, wbi, 1)      # [rows, la, lb]
+    ai = dg(x3r, wbi, 1) + dg(x3i, wbr, 1)
+    # twiddle tw[ja, kb] = exp(-+2*pi*i*ja*kb/L), broadcast over rows
+    twr3 = twr.reshape(1, la, lb)
+    twi3 = twi.reshape(1, la, lb)
+    br = ar * twr3 - ai * twi3
+    bi = ar * twi3 + ai * twr3
+    # stage 2, contract ja: C[r, kb, ka] = sum_ja Wa[ja, ka] B[r, ja, kb]
+    cr = dg(br, war, 1) - dg(bi, wai, 1)        # [rows, lb, la]
+    ci = dg(br, wai, 1) + dg(bi, war, 1)
+    # natural order k = ka*lb + kb: one 3D transpose to [r, ka, kb]
+    yr = jnp.transpose(cr, (0, 2, 1))           # [rows, la, lb]
+    yi = jnp.transpose(ci, (0, 2, 1))
     return yr, yi
 
 
 def dot_mid(a, b, dim):
     """dot_general contracting ``a``'s axis ``dim`` with ``b``'s axis 0
     under the module's DFT precision discipline — the single home of
-    that convention for the dense spellings here and in pallas_fft2."""
+    that convention for the spellings here and in pallas_fft2."""
     return jax.lax.dot_general(
         a, b, (((dim,), (0,)), ((), ())),
         precision=_PRECISION, preferred_element_type=jnp.float32)
 
 
-def vmem_fft_rows_dense(xr, xi, war, wai, wbr, wbi, twr, twi, *,
-                        la, lb, rows):
-    """dot_general spelling of :func:`vmem_fft_rows` — same contract,
-    different layout discipline: both DFT contractions run against the
-    *middle* axis of dense ``[rows, la, lb]`` views, so no intermediate
-    ever carries a sub-128 minor dim (the classic spelling's
-    ``[la, rows, lb]`` stages lane-pad lb -> 128, up to 4x VMEM), and
-    the only relayout is one final dense 3D transpose.  Kept alongside
-    the classic form so hardware can A/B the two lowerings
-    (SRTB_PALLAS2_ROWS in ops/pallas_fft2)."""
-    dg = dot_mid
-    x3r = xr.reshape(rows, la, lb)
-    x3i = xi.reshape(rows, la, lb)
-    # stage 1, contract j1: A[r, j2, k1] = sum_j1 x[r, j1, j2] Wa[j1, k1]
-    ar = dg(x3r, war, 1) - dg(x3i, wai, 1)      # [rows, lb, la]
-    ai = dg(x3r, wai, 1) + dg(x3i, war, 1)
-    # twiddle w[k1, j2] at [1, j2, k1] orientation, broadcast over rows
-    twr2 = twr.T.reshape(1, lb, la)
-    twi2 = twi.T.reshape(1, lb, la)
-    br = ar * twr2 - ai * twi2
-    bi = ar * twi2 + ai * twr2
-    # stage 2, contract j2: C[r, k1, k2] = sum_j2 B[r, j2, k1] Wb[j2, k2]
-    cr = dg(br, wbr, 1) - dg(bi, wbi, 1)        # [rows, la, lb]
-    ci = dg(br, wbi, 1) + dg(bi, wbr, 1)
-    # natural order k = k2*la + k1 -> [rows, k2, k1] -> [rows, L]
-    yr = jnp.transpose(cr, (0, 2, 1)).reshape(rows, la * lb)
-    yi = jnp.transpose(ci, (0, 2, 1)).reshape(rows, la * lb)
-    return yr, yi
-
-
-def active_rows_helper():
-    """Helper selection for the row-FFT kernels in this module:
-    the proven classic spelling by default; SRTB_PALLAS_ROWS=dense
-    switches to the dense dot_general spelling (hardware A/B — same
-    contract, pinned to the same oracles)."""
-    import os
-
-    if os.environ.get("SRTB_PALLAS_ROWS", "classic") == "dense":
-        return vmem_fft_rows_dense
-    return vmem_fft_rows
-
-
 def _fft_rows_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref, wbi_ref,
                      twr_ref, twi_ref, out_re_ref, out_im_ref, *,
-                     la, lb, rows, rows_helper=None):
-    helper = rows_helper or vmem_fft_rows
-    out_re_ref[:], out_im_ref[:] = helper(
+                     la, lb, rows):
+    out_re_ref[:], out_im_ref[:] = vmem_fft_rows(
         re_ref[:], im_ref[:], war_ref[:], wai_ref[:], wbr_ref[:],
         wbi_ref[:], twr_ref[:], twi_ref[:], la=la, lb=lb, rows=rows)
 
@@ -178,29 +145,33 @@ def _fft_rows_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref, wbi_ref,
 def _fft_rows_stats_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref,
                            wbi_ref, twr_ref, twi_ref, dwr_ref,
                            out_re_ref, out_im_ref, s2_ref, s4_ref, *,
-                           la, lb, rows, apply_dewindow,
-                           rows_helper=None):
+                           la, lb, rows, apply_dewindow):
     """fft_rows kernel + fused epilogue: optional de-window multiply and
     per-row power moments (sum |x|^2, sum |x|^4 as 128-lane partials) —
     the spectral-kurtosis statistics collected while the waterfall rows
     are still in VMEM, so the SK stage never re-reads the waterfall from
     HBM (ref: spectrum/rfi_mitigation.hpp:290-341 computes them in a
-    separate pass)."""
+    separate pass).  All values here carry the helper's 3D
+    ``[rows, la, lb]`` natural-flat view: the de-window vector arrives
+    pre-shaped ``[la, lb]`` from the host (an in-kernel [1, L] ->
+    [la, lb] split would be the unsupported minor-lb reshape) and the
+    moment partials reduce over kb, leaving [rows, la=128] lane
+    partials — a different partial grouping than the flat kernel's
+    historical L/128 chunks, same finished sums."""
     _fft_rows_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref, wbi_ref,
                      twr_ref, twi_ref, out_re_ref, out_im_ref,
-                     la=la, lb=lb, rows=rows, rows_helper=rows_helper)
+                     la=la, lb=lb, rows=rows)
     yr = out_re_ref[:]
     yi = out_im_ref[:]
     if apply_dewindow:
-        dw = dwr_ref[:]        # [1, L] reciprocal de-window coefficients
+        dw = dwr_ref[:].reshape(1, la, lb)  # reciprocal de-window coeffs
         yr = yr * dw
         yi = yi * dw
         out_re_ref[:] = yr
         out_im_ref[:] = yi
     p = yr * yr + yi * yi
-    p3 = p.reshape(rows, (la * lb) // 128, 128)
-    s2_ref[:] = jnp.sum(p3, axis=1)
-    s4_ref[:] = jnp.sum(p3 * p3, axis=1)
+    s2_ref[:] = jnp.sum(p, axis=2)
+    s4_ref[:] = jnp.sum(p * p, axis=2)
 
 
 def _vmem_mb() -> int | None:
@@ -224,22 +195,20 @@ def _vmem_mb() -> int | None:
     return mb
 
 
-def _rows_budget_padded(length: int, budget_bytes: int,
-                        dense: bool) -> int:
+def _rows_budget_padded(length: int, budget_bytes: int) -> int:
     """Largest rows whose PADDED footprint fits the budget, using the
     ops/pallas_fft2 accounting discipline: 2x-pipelined in/out block
-    refs at rows*length f32 each, plus the helper's live stages — the
-    classic spelling's [la, rows, lb] stages lane-pad lb -> 128 (up to
-    4x on the small-length end), which a flat per-plane divisor would
-    undercount exactly where it hurts."""
+    refs at rows*length f32 each (the 3D output block's minor dim lb
+    lane-pads to 128, up to 4x on the small-length end — which a flat
+    per-plane divisor would undercount exactly where it hurts), plus
+    the helper's live stages ([rows, la, lb] intermediates, lb
+    lane-padded)."""
     la, lb = _split_la_lb(length)
-    per_row_refs = 2 * 4 * length * 4
-    if dense:
-        per_row_live = 6 * length * 4 + 2 * la * max(lb, 128) * 4
-    else:
-        per_row_live = 6 * la * max(lb, 128) * 4
-    consts = 4 * (2 * la * la + 2 * lb * max(lb, 128)
-                  + 2 * la * max(lb, 128))
+    plb = max(lb, 128)
+    # 2x pipeline x (2 input refs at length + 2 output refs at la*plb)
+    per_row_refs = 2 * 2 * (length + la * plb) * 4
+    per_row_live = 6 * la * plb * 4
+    consts = 4 * (2 * la * la + 2 * lb * plb + 2 * la * plb)
     per_row = per_row_refs + per_row_live
     return max(1, (budget_bytes - consts) // per_row)
 
@@ -249,42 +218,46 @@ def _row_block(length: int, batch: int) -> int:
     if mb is None:
         elems = _VMEM_BLOCK_ELEMS
     else:
-        dense = active_rows_helper() is vmem_fft_rows_dense
-        rows = _rows_budget_padded(length, mb << 20, dense)
+        rows = _rows_budget_padded(length, mb << 20)
         elems = rows * length
     return _row_block_for(length, batch, elems)
 
 
 def _call_kwargs(interpret: bool) -> dict:
-    """Extra pallas_call kwargs: when SRTB_PALLAS_VMEM_MB enlarges the
-    plan, Mosaic's default scoped-vmem limit must be raised to match;
-    the proven default plan passes no params at all (bit-identical to
-    the measured round-2 path)."""
-    mb = None if interpret else _vmem_mb()
-    if mb is None:
+    """Extra pallas_call kwargs: an explicit scoped-vmem limit, always.
+    Mosaic's *default* limit is far below the v5e's physical 128 MiB,
+    and the L=2^16 leg overflows it — in which case the axon remote
+    compile helper crashes outright (HTTP 500) instead of reporting a
+    budget error (measured round 5, PERF_TPU.jsonl 2026-08-02).  100
+    MiB leaves headroom for Mosaic internal scratch; SRTB_PALLAS_VMEM_MB
+    overrides (and then also drives the block sizing above)."""
+    if interpret:
         return {}
     from jax.experimental.pallas import tpu as pltpu
 
+    mb = _vmem_mb() or 100
     return {"compiler_params": pltpu.CompilerParams(
         vmem_limit_bytes=mb << 20)}
 
 
 @functools.lru_cache(maxsize=None)
-def _row_block_for(length: int, batch: int, elems: int) -> int:
-    target = max(1, elems // length)
-    rows = target
-    while batch % rows:
-        rows -= 1
-    if rows == 1 and target > 1 and batch > 1:
-        # a batch with no small factors (prime/odd channel counts) forces
-        # one grid step per row — correct but loses the kernel's batching;
-        # warn once per shape (lru_cache memoizes the search *and* the
-        # warning) so pathological configs don't silently crawl
-        log.warning(
-            f"[pallas_fft] batch {batch} has no divisor <= {target}: "
-            "row-FFT runs one row per grid step; prefer power-of-two "
-            "channel counts (or fft_strategy=monolithic) for this shape")
-    return rows
+def _row_block_for(length: int, padded_batch: int, elems: int) -> int:
+    """Row block for a batch already padded to a multiple of 8: real
+    Mosaic requires the block's sublane dim divisible by 8 (round-5
+    acceptance run), so rows is the largest multiple-of-8 divisor of
+    the padded batch within the VMEM element target, floor 8."""
+    target = max(8, elems // length)
+    rows = (target // 8) * 8
+    while rows > 8 and padded_batch % rows:
+        rows -= 8
+    return max(8, rows)
+
+
+def _pad_batch(batch: int) -> int:
+    """Smallest multiple of 8 >= batch (the Mosaic sublane-tile floor);
+    padded rows are transformed and discarded — pure overhead only for
+    batches < 8 or odd batches, which no production shape uses."""
+    return -(-batch // 8) * 8
 
 
 @functools.lru_cache(maxsize=None)
@@ -338,13 +311,26 @@ class _Launch:
         if not supported(self.length, self.batch):
             raise ValueError(f"unsupported row FFT shape {self.shape}")
         self.la, self.lb = _split_la_lb(self.length)
-        self.re2 = re.reshape(self.batch, self.length)
-        self.im2 = im.reshape(self.batch, self.length)
-        self.rows = _row_block(self.length, self.batch)
-        self.grid = (self.batch // self.rows,)
+        # pad the batch to the Mosaic sublane-tile floor (multiple of 8)
+        self.pbatch = _pad_batch(self.batch)
+        re2 = re.reshape(self.batch, self.length)
+        im2 = im.reshape(self.batch, self.length)
+        if self.pbatch != self.batch:
+            pad = ((0, self.pbatch - self.batch), (0, 0))
+            re2 = jnp.pad(re2, pad)
+            im2 = jnp.pad(im2, pad)
+        self.re2, self.im2 = re2, im2
+        self.rows = _row_block(self.length, self.pbatch)
+        self.grid = (self.pbatch // self.rows,)
         self.block = pl.BlockSpec((self.rows, self.length),
                                   lambda i: (i, 0),
                                   memory_space=pltpu.VMEM)
+        # the kernels write the helper's 3D [rows, la, lb] natural-flat
+        # view; callers flatten the [batch, la, lb] result outside the
+        # pallas_call (contiguous row-major -> free metadata reshape)
+        self.out_block = pl.BlockSpec((self.rows, self.la, self.lb),
+                                      lambda i: (i, 0, 0),
+                                      memory_space=pltpu.VMEM)
         _, _, self.consts = leg_consts(self.length, inverse)
         self.const_specs = leg_const_specs(self.la, self.lb)
 
@@ -353,12 +339,16 @@ class _Launch:
         from jax.experimental import pallas as pl
         from jax.experimental.pallas import tpu as pltpu
 
-        return pl.BlockSpec(shp, lambda i: (0, 0),
+        return pl.BlockSpec(shp, lambda i: tuple(0 for _ in shp),
                             memory_space=pltpu.VMEM)
 
     def out_shape(self):
-        return jax.ShapeDtypeStruct((self.batch, self.length),
+        return jax.ShapeDtypeStruct((self.pbatch, self.la, self.lb),
                                     jnp.float32)
+
+    def unpad(self, out):
+        """Drop the batch padding rows (no-op slice when unpadded)."""
+        return out[:self.batch] if self.pbatch != self.batch else out
 
 
 def fft_rows_ri(re: jnp.ndarray, im: jnp.ndarray, inverse: bool = False,
@@ -371,18 +361,18 @@ def fft_rows_ri(re: jnp.ndarray, im: jnp.ndarray, inverse: bool = False,
 
     lc = _Launch(re, im, inverse)
     kernel = functools.partial(_fft_rows_kernel, la=lc.la, lb=lc.lb,
-                               rows=lc.rows,
-                               rows_helper=active_rows_helper())
+                               rows=lc.rows)
     out_re, out_im = pl.pallas_call(
         kernel,
         grid=lc.grid,
         in_specs=[lc.block, lc.block] + lc.const_specs,
-        out_specs=[lc.block, lc.block],
+        out_specs=[lc.out_block, lc.out_block],
         out_shape=[lc.out_shape()] * 2,
         interpret=interpret,
         **_call_kwargs(interpret),
     )(lc.re2, lc.im2, *lc.consts)
-    return out_re.reshape(lc.shape), out_im.reshape(lc.shape)
+    return (lc.unpad(out_re).reshape(lc.shape),
+            lc.unpad(out_im).reshape(lc.shape))
 
 
 def fft_rows(x: jnp.ndarray, inverse: bool = False,
@@ -411,26 +401,30 @@ def fft_rows_stats_ri(re: jnp.ndarray, im: jnp.ndarray,
     rows = lc.rows
     apply_dewindow = dewindow is not None
     if apply_dewindow:
-        dwr = (1.0 / dewindow.astype(jnp.float32)).reshape(1, length)
+        # pre-shaped [la, lb] on the host: the natural-flat [r, ka, kb]
+        # element is bin ka*lb + kb, and an in-kernel [1, L] -> [la, lb]
+        # split would be the unsupported minor-lb reshape
+        dwr = (1.0 / dewindow.astype(jnp.float32)).reshape(lc.la, lc.lb)
     else:  # placeholder tile, never read by the kernel
-        dwr = jnp.ones((1, length), jnp.float32)
+        dwr = jnp.ones((lc.la, lc.lb), jnp.float32)
 
     stat_block = pl.BlockSpec((rows, 128), lambda i: (i, 0),
                               memory_space=pltpu.VMEM)
     kernel = functools.partial(_fft_rows_stats_kernel, la=lc.la, lb=lc.lb,
-                               rows=rows, apply_dewindow=apply_dewindow,
-                               rows_helper=active_rows_helper())
+                               rows=rows, apply_dewindow=apply_dewindow)
     out_re, out_im, s2, s4 = pl.pallas_call(
         kernel,
         grid=lc.grid,
         in_specs=[lc.block, lc.block] + lc.const_specs
-                 + [lc.const_spec((1, length))],
-        out_specs=[lc.block, lc.block, stat_block, stat_block],
+                 + [lc.const_spec((lc.la, lc.lb))],
+        out_specs=[lc.out_block, lc.out_block, stat_block, stat_block],
         out_shape=[lc.out_shape(), lc.out_shape(),
-                   jax.ShapeDtypeStruct((batch, 128), jnp.float32),
-                   jax.ShapeDtypeStruct((batch, 128), jnp.float32)],
+                   jax.ShapeDtypeStruct((lc.pbatch, 128), jnp.float32),
+                   jax.ShapeDtypeStruct((lc.pbatch, 128), jnp.float32)],
         interpret=interpret,
         **_call_kwargs(interpret),
     )(lc.re2, lc.im2, *lc.consts, dwr)
-    return (out_re.reshape(shape), out_im.reshape(shape),
-            s2.reshape(*shape[:-1], 128), s4.reshape(*shape[:-1], 128))
+    return (lc.unpad(out_re).reshape(shape),
+            lc.unpad(out_im).reshape(shape),
+            lc.unpad(s2).reshape(*shape[:-1], 128),
+            lc.unpad(s4).reshape(*shape[:-1], 128))

@@ -16,9 +16,10 @@ layout work *into the leg's kernel* so the C2C is two passes total:
     four-step twiddle w[k1, j2] = exp(s*2*pi*i*k1*j2/m) computed
     *in-kernel* from iota with the exact hi/lo phase split (no m-sized
     table exists anywhere), and DMA out: intermediate B[k1, j2] laid
-    out [n1, n2].  SRTB_PALLAS2_P1=row selects the alternate
-    transpose-to-rows + vmem_fft_rows spelling (an independent Mosaic
-    lowering of the same math, for hardware A/B).
+    out [n1, n2].  (A transpose-to-rows spelling existed for hardware
+    A/B until round 5's real-Mosaic acceptance run: its in-kernel
+    flatten of the assembled row is a minor-lb reshape Mosaic rejects,
+    so the column-native form is now the one spelling.)
 
   pass 2 (grid over k1 row blocks):
     DMA a contiguous [rb, n2] row block, run the row FFT over j2, store
@@ -151,39 +152,31 @@ def _leg_const_bytes(la: int, lb: int) -> int:
     return 4 * (2 * la * la + 2 * lb * plb + 2 * la * plb)
 
 
-def _pass1_bytes(n1: int, bb: int, spelling: str, dense: bool) -> int:
+def _pass1_bytes(n1: int, bb: int) -> int:
     """Padded-VMEM footprint model for one pass-1 grid step: the four
     [n1, bb] block refs are double-buffered by the Pallas pipeline and
     lane-pad bb -> 128 (the round-3 review catch: logical-words sizing
     undercounted small-bb blocks 4x at n1=8192), plus the peak live
-    kernel intermediates per spelling, plus the leg consts."""
+    column-native kernel intermediates, plus the leg consts."""
     la, lb = PF._split_la_lb(n1)
     refs = 2 * 4 * n1 * max(bb, 128) * 4
-    if spelling == "col":
-        # dense [lb, bb, la]/[bb, la, lb] stages; stage-2 outputs carry
-        # minor dim lb (pads to 128), the final relayout minor dim bb
-        live = (4 * la * lb * bb * 4
-                + 2 * bb * la * max(lb, 128) * 4
-                + 2 * n1 * max(bb, 128) * 4)
-    elif dense:
-        # transposed [bb, n1] row pair + the dense helper's stages
-        live = 8 * bb * n1 * 4
-    else:
-        # classic helper: [la, rows, lb] stages lane-pad lb -> 128
-        live = 2 * bb * n1 * 4 + 6 * la * bb * max(lb, 128) * 4
+    # dense [lb, bb, la]/[bb, la, lb] stages; stage-2 outputs carry
+    # minor dim lb (pads to 128), the final relayout minor dim bb
+    live = (4 * la * lb * bb * 4
+            + 2 * bb * la * max(lb, 128) * 4
+            + 2 * n1 * max(bb, 128) * 4)
     return refs + live + _leg_const_bytes(la, lb)
 
 
-def _pass2_bytes(n2: int, rb: int, dense: bool) -> int:
-    """Same model for one pass-2 grid step: [rb, n2] blocks are already
-    lane-dense (rb is the sublane dim, min tile 8) — only the helper
-    stages with minor dim lb = n2/128 pad on the small-n2 end."""
+def _pass2_bytes(n2: int, rb: int) -> int:
+    """Same model for one pass-2 grid step: the [rb, n2] input blocks
+    are lane-dense (rb is the sublane dim, min tile 8); the 3D output
+    blocks and helper stages carry minor dim lb = n2/128, which pads to
+    128 on the small-n2 end."""
     la, lb = PF._split_la_lb(n2)
-    refs = 2 * 4 * max(rb, 8) * n2 * 4
-    if dense:
-        live = 6 * rb * n2 * 4 + 2 * rb * la * max(lb, 128) * 4
-    else:
-        live = 6 * la * rb * max(lb, 128) * 4
+    plb = max(lb, 128)
+    refs = 2 * 2 * max(rb, 8) * (n2 + la * plb) * 4
+    live = 6 * la * rb * plb * 4
     return refs + live + _leg_const_bytes(la, lb)
 
 
@@ -207,13 +200,10 @@ def _block_cols(n1: int, n2: int) -> int:
     env = os.environ.get("SRTB_PALLAS2_BB")
     if env:
         return int(env)
-    spelling = _p1_spelling()
-    dense = _rows_helper() is not PF.vmem_fft_rows
     budget = _vmem_budget()
     cands = [c for c in (1024, 512, 256, 128) if n2 % c == 0]
     return _pick_block(
-        cands, lambda c: _pass1_bytes(n1, c, spelling, dense) <= budget,
-        128)
+        cands, lambda c: _pass1_bytes(n1, c) <= budget, 128)
 
 
 def _block_rows(n2: int, n1: int) -> int:
@@ -223,11 +213,10 @@ def _block_rows(n2: int, n1: int) -> int:
     env = os.environ.get("SRTB_PALLAS2_RB")
     if env:
         return int(env)
-    dense = _rows_helper() is not PF.vmem_fft_rows
     budget = _vmem_budget()
     cands = [c for c in (256, 128, 64, 32, 16, 8) if n1 % c == 0]
     return _pick_block(
-        cands, lambda c: _pass2_bytes(n2, c, dense) <= budget, 8)
+        cands, lambda c: _pass2_bytes(n2, c) <= budget, 8)
 
 
 def _phase_cos_sin(r, m: int, sign: float):
@@ -245,84 +234,45 @@ def _phase_cos_sin(r, m: int, sign: float):
     return ca * cb - sa * sb, sa * cb + ca * sb
 
 
-def _fourstep_twiddle(rows_j2, n1: int, m: int, sign: float, j2_0):
-    """w[d, k1] = exp(sign*2*pi*i*(j2_0 + d)*k1/m) for d < rows_j2,
-    k1 < n1, computed in-kernel from iota (j2*k1 < m <= 2^29 is exact
-    in int32)."""
-    d = jax.lax.broadcasted_iota(jnp.int32, (rows_j2, n1), 0) + j2_0
-    k1 = jax.lax.broadcasted_iota(jnp.int32, (rows_j2, n1), 1)
-    return _phase_cos_sin(d * k1, m, sign)
-
-
-def _p1_spelling() -> str:
-    """Pass-1 kernel spelling: "col" (default — column-native
-    dot_general contractions, zero 2D input/output transposes, all
-    intermediates dense) or "row" (transpose to rows + the classic
-    two-level helper).  Two independent Mosaic lowerings of the same
-    math, A/B-able on hardware (SRTB_PALLAS2_P1)."""
-    return os.environ.get("SRTB_PALLAS2_P1", "col")
-
-
-def _rows_helper():
-    """Which in-VMEM row-FFT helper pass 2 (and the row spelling of
-    pass 1) uses: "dense" (default) or "classic" (SRTB_PALLAS2_ROWS)."""
-    if os.environ.get("SRTB_PALLAS2_ROWS", "dense") == "classic":
-        return PF.vmem_fft_rows
-    return PF.vmem_fft_rows_dense
-
-
 def _pass1_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref, wbi_ref,
                   twr_ref, twi_ref, out_re_ref, out_im_ref, *,
-                  n1, bb, la, lb, m, sign, spelling, rows_helper):
+                  n1, bb, la, lb, m, sign):
     from jax.experimental import pallas as pl
 
     j2_0 = pl.program_id(0) * bb
-    if spelling == "col":
-        # column-native: both DFT contractions run against the j1 axes
-        # of the [n1(j1), bb(j2)] block in place — no input transpose,
-        # no padded intermediate, one dense 3D relayout at the end
-        dg = PF.dot_mid
-        x3r = re_ref[:].reshape(la, lb, bb)
-        x3i = im_ref[:].reshape(la, lb, bb)
-        war, wai = war_ref[:], wai_ref[:]
-        # stage 1, contract j1a: A[j2, d, k1]
-        ar = dg(x3r, war, 0) - dg(x3i, wai, 0)      # [lb, bb, la]
-        ai = dg(x3r, wai, 0) + dg(x3i, war, 0)
-        # inner twiddle tw[k1, j2] at [j2, 1, k1] orientation
-        twr2 = twr_ref[:].T.reshape(lb, 1, la)
-        twi2 = twi_ref[:].T.reshape(lb, 1, la)
-        br = ar * twr2 - ai * twi2
-        bi = ar * twi2 + ai * twr2
-        # stage 2, contract j1b(lb): C[d, k1, k2]
-        wbr, wbi = wbr_ref[:], wbi_ref[:]
-        cr = dg(br, wbr, 0) - dg(bi, wbi, 0)        # [bb, la, lb]
-        ci = dg(br, wbi, 0) + dg(bi, wbr, 0)
-        # leg-natural index k = k2*la + k1 -> [k2, k1, d] -> [n1, bb]
-        yr = jnp.transpose(cr, (2, 1, 0)).reshape(n1, bb)
-        yi = jnp.transpose(ci, (2, 1, 0)).reshape(n1, bb)
-        # four-step twiddle at [k, d] orientation
-        wr, wi = _fourstep_twiddle_t(n1, bb, m, sign, j2_0)
-        out_re_ref[:] = yr * wr - yi * wi
-        out_im_ref[:] = yr * wi + yi * wr
-        return
-    # row spelling: strided [n1(j1), bb(j2)] block -> [bb, n1] rows
-    xr = re_ref[:].T
-    xi = im_ref[:].T
-    yr, yi = rows_helper(xr, xi, war_ref[:], wai_ref[:], wbr_ref[:],
-                         wbi_ref[:], twr_ref[:], twi_ref[:],
-                         la=la, lb=lb, rows=bb)   # A[j2, k1]
-    wr, wi = _fourstep_twiddle(bb, n1, m, sign, j2_0)
-    zr = yr * wr - yi * wi
-    zi = yr * wi + yi * wr
-    # back to [n1(k1), bb(j2)] for the strided column-block write
-    out_re_ref[:] = zr.T
-    out_im_ref[:] = zi.T
+    # column-native: both DFT contractions run against the j1 axes
+    # of the [n1(j1), bb(j2)] block in place — no input transpose,
+    # no padded intermediate, one dense 3D relayout at the end
+    dg = PF.dot_mid
+    x3r = re_ref[:].reshape(la, lb, bb)
+    x3i = im_ref[:].reshape(la, lb, bb)
+    war, wai = war_ref[:], wai_ref[:]
+    # stage 1, contract j1a: A[j2, d, k1]
+    ar = dg(x3r, war, 0) - dg(x3i, wai, 0)      # [lb, bb, la]
+    ai = dg(x3r, wai, 0) + dg(x3i, war, 0)
+    # inner twiddle tw[k1, j2] at [j2, 1, k1] orientation
+    twr2 = twr_ref[:].T.reshape(lb, 1, la)
+    twi2 = twi_ref[:].T.reshape(lb, 1, la)
+    br = ar * twr2 - ai * twi2
+    bi = ar * twi2 + ai * twr2
+    # stage 2, contract j1b(lb): C[d, k1, k2]
+    wbr, wbi = wbr_ref[:], wbi_ref[:]
+    cr = dg(br, wbr, 0) - dg(bi, wbi, 0)        # [bb, la, lb]
+    ci = dg(br, wbi, 0) + dg(bi, wbr, 0)
+    # leg-natural index k = k2*la + k1 -> [k2, k1, d] -> [n1, bb]
+    yr = jnp.transpose(cr, (2, 1, 0)).reshape(n1, bb)
+    yi = jnp.transpose(ci, (2, 1, 0)).reshape(n1, bb)
+    # four-step twiddle at [k, d] orientation
+    wr, wi = _fourstep_twiddle_t(n1, bb, m, sign, j2_0)
+    out_re_ref[:] = yr * wr - yi * wi
+    out_im_ref[:] = yr * wi + yi * wr
 
 
 def _fourstep_twiddle_t(n1: int, cols_j2: int, m: int, sign: float, j2_0):
-    """Transposed orientation of :func:`_fourstep_twiddle`:
-    w[k1, d] = exp(sign*2*pi*i*k1*(j2_0 + d)/m) for k1 < n1,
-    d < cols_j2 — the [n1, bb] layout the column-native pass-1 writes."""
+    """Four-step twiddle w[k1, d] = exp(sign*2*pi*i*k1*(j2_0 + d)/m) for
+    k1 < n1, d < cols_j2 — the [n1, bb] layout the column-native pass-1
+    writes — computed in-kernel from iota (k1*j2 < m <= 2^29 is exact in
+    int32)."""
     k1 = jax.lax.broadcasted_iota(jnp.int32, (n1, cols_j2), 0)
     d = jax.lax.broadcasted_iota(jnp.int32, (n1, cols_j2), 1) + j2_0
     return _phase_cos_sin(d * k1, m, sign)
@@ -330,14 +280,17 @@ def _fourstep_twiddle_t(n1: int, cols_j2: int, m: int, sign: float, j2_0):
 
 def _pass2_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref, wbi_ref,
                   twr_ref, twi_ref, out_re_ref, out_im_ref, *,
-                  n2, rb, la, lb, rows_helper):
-    # output stays [rb, n2] = C[k1, k2] k1-major blocked: a natural-order
-    # [n2, rb] column block would lane-pad rb -> 128 in VMEM (8-32 MB per
-    # plane at production n2) — callers restore order with unblock(), an
-    # XLA transpose the next elementwise pass absorbs
-    yr, yi = rows_helper(re_ref[:], im_ref[:], war_ref[:], wai_ref[:],
-                         wbr_ref[:], wbi_ref[:], twr_ref[:],
-                         twi_ref[:], la=la, lb=lb, rows=rb)
+                  n2, rb, la, lb):
+    # output stays k1-major blocked (a natural-order [n2, rb] column
+    # block would lane-pad rb -> 128 in VMEM, 8-32 MB per plane at
+    # production n2) — callers restore order with unblock(), an XLA
+    # transpose the next elementwise pass absorbs.  The helper returns
+    # its [rb, la, lb] natural-flat view; the 3D out refs match and the
+    # caller's flatten to [rb, n2] happens outside the pallas_call.
+    yr, yi = PF.vmem_fft_rows(re_ref[:], im_ref[:], war_ref[:],
+                              wai_ref[:], wbr_ref[:], wbi_ref[:],
+                              twr_ref[:], twi_ref[:],
+                              la=la, lb=lb, rows=rb)
     out_re_ref[:] = yr
     out_im_ref[:] = yi
 
@@ -362,8 +315,7 @@ def pass1_2d(re2, im2, inverse: bool = False, interpret: bool = False):
     col_block = pl.BlockSpec((n1, bb), lambda i: (0, i),
                              memory_space=pltpu.VMEM)
     k1 = functools.partial(_pass1_kernel, n1=n1, bb=bb, la=la1, lb=lb1,
-                           m=m, sign=sign, spelling=_p1_spelling(),
-                           rows_helper=_rows_helper())
+                           m=m, sign=sign)
     mid_shape = jax.ShapeDtypeStruct((n1, n2), jnp.float32)
     kwargs = {}
     if not interpret:
@@ -396,22 +348,25 @@ def pass2_2d(br, bi, inverse: bool = False, interpret: bool = False):
     la2, lb2, consts2 = PF.leg_consts(n2, inverse)
     row_block = pl.BlockSpec((rb, n2), lambda i: (i, 0),
                              memory_space=pltpu.VMEM)
-    k2 = functools.partial(_pass2_kernel, n2=n2, rb=rb, la=la2, lb=lb2,
-                           rows_helper=_rows_helper())
-    out_shape = jax.ShapeDtypeStruct((n1, n2), jnp.float32)
+    out_block = pl.BlockSpec((rb, la2, lb2), lambda i: (i, 0, 0),
+                             memory_space=pltpu.VMEM)
+    k2 = functools.partial(_pass2_kernel, n2=n2, rb=rb, la=la2, lb=lb2)
+    out_shape = jax.ShapeDtypeStruct((n1, la2, lb2), jnp.float32)
     kwargs = {}
     if not interpret:
         kwargs["compiler_params"] = pltpu.CompilerParams(
             vmem_limit_bytes=_vmem_budget())
-    return pl.pallas_call(
+    yr3, yi3 = pl.pallas_call(
         k2,
         grid=(n1 // rb,),
         in_specs=[row_block, row_block] + PF.leg_const_specs(la2, lb2),
-        out_specs=[row_block, row_block],
+        out_specs=[out_block, out_block],
         out_shape=[out_shape, out_shape],
         interpret=interpret,
         **kwargs,
     )(br, bi, *consts2)
+    # contiguous [n1, la2, lb2] -> [n1, n2]: free metadata reshape
+    return yr3.reshape(n1, n2), yi3.reshape(n1, n2)
 
 
 def _fft2_2d(re2, im2, n1, n2, inverse, natural, interpret):

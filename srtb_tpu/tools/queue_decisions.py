@@ -133,25 +133,9 @@ def evaluate(rows: dict) -> list[dict]:
             add("SRTB_MXU_PRECISION default", "KEEP highest",
                 f"high rel_err {hi.get('rel_err')}")
 
-    # ---- dense rows helper on the proven kernels ----
-    dense = _value(rows.get("pallas_dense"))
-    sk = _value(rows.get("pallas_sk"))
-    # "is not None", matching the pallas2 decision's convention: a failed
-    # bench's 0.0 value row is present evidence (a KEEP verdict), not
-    # missing data.  A flip needs BOTH benches healthy — "dense 1200 >=
-    # classic 0" is a comparison against a failure, not a win.
-    if dense is not None and sk is not None:
-        if dense > 0 and sk > 0 and dense >= sk:
-            add("pallas rows helper default", "FLIP to dense",
-                f"dense {dense:.0f} >= classic {sk:.0f} Msamples/s",
-                "flip ops/pallas_fft.active_rows_helper default")
-        elif dense > 0 and sk > 0:
-            add("pallas rows helper default", "KEEP classic",
-                f"dense {dense:.0f} < classic {sk:.0f} Msamples/s")
-        else:
-            add("pallas rows helper default", "KEEP classic",
-                f"failed bench row(s): dense {dense}, classic {sk} — "
-                "no flip on failed evidence")
+    # (the dense-vs-classic rows-helper A/B retired in round 5: real
+    # Mosaic rejects the spellings' minor-lb reshapes, so one legal
+    # spelling remains — see ops/pallas_fft.vmem_fft_rows)
 
     # ---- warm-compile restart target ----
     warm = _result(rows.get("cache_warm"))
