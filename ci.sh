@@ -24,13 +24,13 @@ if [ "${1:-}" = "--hardware" ]; then
   exit 0
 fi
 
-echo "== [1/6] native build =="
+echo "== [1/7] native build =="
 make -C srtb_tpu/native
 
-echo "== [2/6] native sanitizer harness (ASan/UBSan) =="
+echo "== [2/7] native sanitizer harness (ASan/UBSan) =="
 make -C srtb_tpu/native check
 
-echo "== [3/6] static checks (compile + import) =="
+echo "== [3/7] static checks (compile + import) =="
 python -m compileall -q srtb_tpu tests bench.py __graft_entry__.py
 python - <<'EOF'
 import importlib, pkgutil
@@ -45,7 +45,7 @@ assert not bad, bad
 print(f"all srtb_tpu modules import cleanly")
 EOF
 
-echo "== [4/6] pytest (8-device CPU mesh) =="
+echo "== [4/7] pytest (8-device CPU mesh) =="
 FAST_ARGS=()
 if [ "${1:-}" = "--fast" ]; then
   FAST_ARGS=(--deselect tests/test_dist_fft.py::test_dist_fft_large_n_twiddle_precision
@@ -53,10 +53,60 @@ if [ "${1:-}" = "--fast" ]; then
 fi
 python -m pytest tests/ -q "${FAST_ARGS[@]}"
 
-echo "== [5/6] bench smoke =="
+echo "== [5/7] bench smoke =="
 JAX_PLATFORMS=cpu SRTB_BENCH_LOG2N=16 python bench.py | tail -1
 
-echo "== [6/6] multichip dryrun (8 virtual devices) =="
+echo "== [6/7] telemetry smoke (journal + report + /metrics + /healthz) =="
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json, os, tempfile, urllib.request
+
+from srtb_tpu.config import Config
+from srtb_tpu.gui.server import WaterfallHTTPServer
+from srtb_tpu.io.synth import make_dispersed_baseband
+from srtb_tpu.pipeline.runtime import Pipeline
+from srtb_tpu.tools import telemetry_report as TR
+
+tmp = tempfile.mkdtemp(prefix="srtb_ci_tele_")
+n = 1 << 16
+make_dispersed_baseband(n * 3, 1405.0, 64.0, 0.0, pulse_positions=n,
+                        nbits=8).tofile(os.path.join(tmp, "bb.bin"))
+journal = os.path.join(tmp, "journal.jsonl")
+cfg = Config(baseband_input_count=n, baseband_input_bits=8,
+             baseband_freq_low=1405.0, baseband_bandwidth=64.0,
+             baseband_sample_rate=128e6,
+             input_file_path=os.path.join(tmp, "bb.bin"),
+             baseband_output_file_prefix=os.path.join(tmp, "out_"),
+             spectrum_channel_count=1 << 8,
+             mitigate_rfi_average_method_threshold=100.0,
+             mitigate_rfi_spectral_kurtosis_threshold=2.0,
+             baseband_reserve_sample=False, writer_thread_count=0,
+             telemetry_journal_path=journal)
+with Pipeline(cfg, sinks=[]) as pipe:
+    stats = pipe.run()
+assert stats.segments >= 2, stats
+# journal non-empty and parseable by telemetry_report
+recs = TR.load(journal)
+assert recs, "telemetry journal is empty"
+rep = TR.report(journal)
+for stage in ("ingest", "dispatch", "fetch", "sink"):
+    assert rep["stages"][stage]["count"] == stats.segments, (stage, rep)
+assert TR.main([journal, "--format", "json"]) == 0
+# live endpoints from a WaterfallHTTPServer
+srv = WaterfallHTTPServer(tmp, port=0).start()
+try:
+    base = f"http://127.0.0.1:{srv.port}"
+    prom = urllib.request.urlopen(base + "/metrics").read().decode()
+    assert "# TYPE srtb_stage_seconds histogram" in prom, prom[:400]
+    assert 'srtb_stage_seconds_bucket{le="+Inf",stage="dispatch"}' in prom
+    h = json.loads(urllib.request.urlopen(base + "/healthz").read())
+    assert h["ok"] and h["status"] == "ok", h
+finally:
+    srv.stop()
+print(f"telemetry smoke OK: {stats.segments} segments, "
+      f"{len(recs)} spans, /metrics + /healthz live")
+EOF
+
+echo "== [7/7] multichip dryrun (8 virtual devices) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

@@ -140,6 +140,17 @@ class Config:
     # termination handler (loud stacktrace), matching the reference's
     # fail-loudly philosophy (ref: util/termination_handler.hpp)
     segment_deadline_s: float = 0.0
+    # segment-span telemetry journal: one JSONL record per processed
+    # segment (per-stage wall clock, queue depth, loss counters,
+    # detection count, dump decision — utils/telemetry.py); "" disables.
+    # Summarize with `python -m srtb_tpu.tools.telemetry_report`.
+    telemetry_journal_path: str = ""
+    # size-rotate the journal when the active file would exceed this
+    # (renamed to <path>.1, one previous generation kept)
+    telemetry_journal_max_bytes: int = 64 << 20
+    # /healthz flips to 503 when the last processed segment is older
+    # than this many seconds (gui/server.py staleness detection)
+    health_stale_after_s: float = 30.0
     # candidate-writer thread count; >0 uses the async writer pool (native
     # C++ when built — the reference's boost thread pools,
     # write_signal_pipe.hpp:159-280), 0 writes synchronously
@@ -183,6 +194,7 @@ class Config:
         "gui_pixmap_height", "gui_http_port", "n_devices", "log_level",
         "writer_thread_count", "distributed_num_processes",
         "distributed_process_id", "gui_scroll_lines",
+        "telemetry_journal_max_bytes",
     })
     _FLOAT_FIELDS = frozenset({
         "baseband_freq_low", "baseband_bandwidth", "baseband_sample_rate",
@@ -190,6 +202,7 @@ class Config:
         "mitigate_rfi_spectral_kurtosis_threshold",
         "signal_detect_signal_noise_threshold",
         "signal_detect_channel_threshold", "segment_deadline_s",
+        "health_stale_after_s",
     })
     _BOOL_FIELDS = frozenset({
         "baseband_reserve_sample", "baseband_write_all", "gui_enable",

@@ -147,6 +147,7 @@ contrast <input class="contrast" type="range" min="20" max="300"
 
 class _Handler(http.server.BaseHTTPRequestHandler):
     directory = "."
+    health_stale_after_s = 30.0
 
     def log_message(self, *args):  # quiet
         pass
@@ -181,22 +182,34 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     def _do_get(self):
         if self.path in ("/metrics", "/metrics.json"):
             # live observability beyond the reference's log-only story
-            # (SURVEY.md §5.5): JSON snapshot or Prometheus text format
+            # (SURVEY.md §5.5): JSON snapshot or Prometheus text
+            # exposition (counters/gauges, sliding-window rates, and
+            # the per-stage wall-clock histograms)
             from srtb_tpu.utils.metrics import metrics
 
-            snap = metrics.snapshot()
             if self.path == "/metrics.json":
-                data = (json.dumps(snap, sort_keys=True) + "\n").encode()
+                data = (json.dumps(metrics.snapshot(), sort_keys=True)
+                        + "\n").encode()
                 ctype = "application/json"
             else:
-                lines = []
-                for k in sorted(snap):
-                    name = "srtb_" + re.sub(r"[^a-zA-Z0-9_]", "_", k)
-                    lines.append(f"{name} {snap[k]:.17g}")
-                data = ("\n".join(lines) + "\n").encode()
+                data = metrics.prometheus().encode()
                 ctype = "text/plain; version=0.0.4"
             self.send_response(200)
             self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
+        if self.path == "/healthz":
+            # last-segment-age staleness: 503 while the pipeline is
+            # wedged (no cooperation needed from the stuck thread),
+            # 200 when segments flow or before the first one (startup)
+            from srtb_tpu.utils.telemetry import health
+
+            h = health(stale_after_s=self.health_stale_after_s)
+            data = (json.dumps(h, sort_keys=True) + "\n").encode()
+            self.send_response(200 if h["ok"] else 503)
+            self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
@@ -249,8 +262,11 @@ class WaterfallHTTPServer:
     """Serve the waterfall PNG directory on a background thread."""
 
     def __init__(self, directory: str, port: int = 0,
-                 address: str = "127.0.0.1"):
-        handler = type("Handler", (_Handler,), {"directory": directory})
+                 address: str = "127.0.0.1",
+                 health_stale_after_s: float = 30.0):
+        handler = type("Handler", (_Handler,), {
+            "directory": directory,
+            "health_stale_after_s": health_stale_after_s})
         self._httpd = http.server.ThreadingHTTPServer((address, port),
                                                       handler)
         self.port = self._httpd.server_address[1]

@@ -22,6 +22,7 @@ from srtb_tpu.ops import dedisperse as dd
 from srtb_tpu.pipeline.work import SegmentWork
 from srtb_tpu.utils.bufferpool import BufferPool
 from srtb_tpu.utils.logging import log
+from srtb_tpu.utils.metrics import metrics
 
 # process-wide segment-buffer pool (ref: srtb::host_allocator singleton,
 # global_variables.hpp:49-61)
@@ -63,6 +64,16 @@ class BasebandFileReader:
             self._exhausted = True
             raise StopIteration
         buf[:len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
+        # ingest telemetry: windowed read throughput + pool occupancy
+        # gauges (the host-buffer analog of the receiver ring gauges)
+        metrics.add("file_bytes_read", len(chunk))
+        metrics.window("file_bytes_read").add(len(chunk))
+        pool_stats = self.pool.stats()
+        metrics.set("segment_pool_cached_blocks",
+                    pool_stats["cached_blocks"])
+        metrics.set("segment_pool_cached_bytes",
+                    pool_stats["cached_bytes"])
+        metrics.set("segment_pool_in_use", pool_stats["in_use"])
         self.logical_offset += self.segment_bytes
         if len(chunk) < self.segment_bytes:
             # final partial segment: emit zero-padded, then stop

@@ -366,6 +366,13 @@ class AsyncioBlockReceiver(PythonBlockReceiver):
             loop.run_until_complete(loop.shutdown_asyncgens())
             loop.close()
 
+    def queue_depth(self) -> int:
+        """Packets waiting between the event loop and the block
+        assembler (the provider's ring-occupancy analog; at ``_q_max``
+        the oldest packet is dropped as loss)."""
+        with self._cv:
+            return len(self._q)
+
     def _next_packet(self) -> bytes:
         need = self.fmt.packet_payload_size
         while True:
@@ -603,6 +610,15 @@ class UdpReceiverSource:
         first_counter, lost, total = self.receiver.receive_block(buf)
         metrics.add("packets_total", total)
         metrics.add("packets_lost", lost)
+        # windowed loss accounting: snapshot()/Prometheus derive the
+        # loss *rate over the last 10 s* from these — a loss burst is
+        # visible while it happens, not diluted into the lifetime ratio
+        metrics.window("packets_total").add(total)
+        metrics.window("packets_lost").add(lost)
+        depth = getattr(self.receiver, "queue_depth", None)
+        if depth is not None:
+            metrics.set(f"udp_rx{self.data_stream_id}_queue_packets",
+                        depth())
         if lost:
             log.warning(f"[udp_receiver] lost {lost}/{total} packets "
                         f"({lost / total:.2%})")

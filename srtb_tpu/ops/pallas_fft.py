@@ -233,11 +233,20 @@ def _call_kwargs(interpret: bool) -> dict:
     overrides (and then also drives the block sizing above)."""
     if interpret:
         return {}
+    mb = _vmem_mb() or 100
+    return {"compiler_params": tpu_compiler_params(
+        vmem_limit_bytes=mb << 20)}
+
+
+def tpu_compiler_params(**kwargs):
+    """Mosaic compiler-params across the jax rename: ``CompilerParams``
+    (new spelling) falling back to ``TPUCompilerParams`` (the only one
+    this image's jax 0.4.37 ships) — shared by the pallas2 kernels."""
     from jax.experimental.pallas import tpu as pltpu
 
-    mb = _vmem_mb() or 100
-    return {"compiler_params": pltpu.CompilerParams(
-        vmem_limit_bytes=mb << 20)}
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
+    return cls(**kwargs)
 
 
 @functools.lru_cache(maxsize=None)

@@ -319,7 +319,7 @@ def pass1_2d(re2, im2, inverse: bool = False, interpret: bool = False):
     mid_shape = jax.ShapeDtypeStruct((n1, n2), jnp.float32)
     kwargs = {}
     if not interpret:
-        kwargs["compiler_params"] = pltpu.CompilerParams(
+        kwargs["compiler_params"] = PF.tpu_compiler_params(
             vmem_limit_bytes=_vmem_budget())
     return pl.pallas_call(
         k1,
@@ -354,7 +354,7 @@ def pass2_2d(br, bi, inverse: bool = False, interpret: bool = False):
     out_shape = jax.ShapeDtypeStruct((n1, la2, lb2), jnp.float32)
     kwargs = {}
     if not interpret:
-        kwargs["compiler_params"] = pltpu.CompilerParams(
+        kwargs["compiler_params"] = PF.tpu_compiler_params(
             vmem_limit_bytes=_vmem_budget())
     yr3, yi3 = pl.pallas_call(
         k2,

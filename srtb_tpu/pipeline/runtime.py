@@ -13,6 +13,7 @@ framework is this small host loop with work accounting
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 from dataclasses import dataclass, field
@@ -25,8 +26,10 @@ from srtb_tpu.io.file_input import BasebandFileReader
 from srtb_tpu.io.writers import WriteAllSink, WriteSignalSink
 from srtb_tpu.pipeline.segment import SegmentProcessor
 from srtb_tpu.pipeline.work import SegmentResultWork, SegmentWork
+from srtb_tpu.utils import telemetry
 from srtb_tpu.utils.logging import log
 from srtb_tpu.utils.metrics import metrics
+from srtb_tpu.utils.tracing import StageTimer, trace_annotation
 
 
 @dataclass
@@ -187,20 +190,76 @@ class Pipeline:
         self.sinks = sinks
         self.keep_waterfall = keep_waterfall
         self.stats = PipelineStats()
+        # every completed host-stage timing also lands in a bounded
+        # histogram, so /metrics carries live p50/p95/p99 per stage
+        self.stage_timer = StageTimer(
+            on_stage=lambda name, dt: metrics.histogram(
+                "stage_seconds", labels={"stage": name}).observe(dt))
+        self.journal = None
+        jpath = getattr(cfg, "telemetry_journal_path", "")
+        if jpath:
+            from srtb_tpu.utils.telemetry import SpanJournal
+            self.journal = SpanJournal(
+                jpath, max_bytes=getattr(
+                    cfg, "telemetry_journal_max_bytes", 64 << 20))
+
+    @contextlib.contextmanager
+    def _stage(self, name: str):
+        """One named host stage: StageTimer accumulation + per-segment
+        ``last`` capture + an xprof TraceAnnotation so device traces and
+        the span journal correlate by stage name."""
+        with trace_annotation(f"srtb:{name}"), \
+                self.stage_timer.stage(name):
+            yield
+
+    def _timed_ingest(self, it):
+        """One source read as the "ingest" stage; the terminal failed
+        read (source exhausted — for a UDP source, a receive blocked
+        until shutdown) is NOT recorded, so the ingest histogram holds
+        exactly one sample per segment like every other stage."""
+        t0 = time.perf_counter()
+        with trace_annotation("srtb:ingest"):
+            seg = next(it, None)
+        if seg is not None:
+            self.stage_timer.record("ingest", time.perf_counter() - t0)
+        return seg
+
+    def _record_segment(self, index: int, seg, det_res, positive: bool,
+                        span: dict, queue_depth: int,
+                        n_samples: int) -> None:
+        """Per-drained-segment telemetry: lifetime counters, sliding
+        window rates (segments/s and samples/s over the last 10 s — a
+        stall is visible immediately, unlike the lifetime average), the
+        /healthz liveness stamp, and one journal span record."""
+        metrics.add("segments")
+        metrics.add("samples", n_samples)
+        if positive:
+            metrics.add("signals")
+        metrics.window("segments").add(1)
+        metrics.window("samples").add(n_samples)
+        telemetry.mark_segment()
+        det_count = 0
+        counts = getattr(det_res, "signal_counts", None)
+        if counts is not None:
+            det_count = int(np.asarray(counts).sum())
+        if self.journal is not None:
+            self.journal.write(telemetry.segment_span(
+                index, span, queue_depth, det_count, positive, n_samples,
+                timestamp_ns=getattr(seg, "timestamp", 0)))
 
     def run(self, max_segments: int | None = None) -> PipelineStats:
         cfg = self.cfg
         start = time.perf_counter()
-        pending: list[tuple[SegmentWork, object, object]] = []
+        pending: list[tuple] = []
         n_samples_per_seg = cfg.baseband_input_count
 
         drained = [self.checkpoint.segments_done if self.checkpoint else 0]
 
-        def drain(item):
-            _drain_body(self._fetch_device(item))
+        def drain(item, depth):
+            _drain_body(self._fetch_device(item), depth)
 
-        def _drain_body(item):
-            seg, wf, det_res, offset_after = item
+        def _drain_body(item, depth):
+            seg, wf, det_res, offset_after, span = item
             positive = has_signal(
                 cfg, det_res,
                 frequency_bin_count=(wf.shape[-2] if wf is not None
@@ -209,40 +268,55 @@ class Pipeline:
                 self.stats.signals += 1
                 log.info("[pipeline] signal detected in segment "
                          f"{self.stats.segments}")
-            self._push_sinks(seg, wf, det_res, positive)
+            with self._stage("sink"):
+                self._push_sinks(seg, wf, det_res, positive)
+            span["sink"] = self.stage_timer.last["sink"]
             # file mode: sinks never retain segments (no piggybank deque),
             # so the host buffer can go back to the pool for the reader
             pool = getattr(self.source, "pool", None)
             if pool is not None and cfg.input_file_path:
                 pool.release(seg.data)
             drained[0] += 1
-            metrics.add("segments")
-            metrics.add("samples", n_samples_per_seg)
-            if positive:
-                metrics.add("signals")
+            self._record_segment(drained[0] - 1, seg, det_res, positive,
+                                 span, queue_depth=depth,
+                                 n_samples=n_samples_per_seg)
             if self.checkpoint is not None:
                 # a checkpointed segment must be durable: flush queued
                 # async candidate writes before recording it as done
                 self._drain_sinks()
                 self.checkpoint.update(drained[0], offset_after)
 
-        for i, seg in enumerate(self.source):
-            if max_segments is not None and i >= max_segments:
+        it = iter(self.source)
+        i = 0
+        while max_segments is None or i < max_segments:
+            seg = self._timed_ingest(it)
+            if seg is None:
                 break
-            wf, det_res = self.processor.process(seg.data)
+            with self._stage("dispatch"):
+                wf, det_res = self.processor.process(seg.data)
+            span = {"ingest": self.stage_timer.last["ingest"],
+                    "dispatch": self.stage_timer.last["dispatch"]}
             pending.append((seg, wf, det_res,
-                            getattr(self.source, "logical_offset", 0)))
+                            getattr(self.source, "logical_offset", 0),
+                            span))
             # keep at most 2 segments in flight (the reference's queue
-            # capacity, config.hpp:40-43): drain the oldest
+            # capacity, config.hpp:40-43): drain the oldest.  The span's
+            # queue_depth is the in-flight count AT drain time (including
+            # the item being drained) — captured before the pop, so a
+            # full queue journals as 2, not a perpetual 1
             if len(pending) >= 2:
-                drain(pending.pop(0))
+                depth = len(pending)
+                drain(pending.pop(0), depth)
             self.stats.segments += 1
             self.stats.samples += n_samples_per_seg
+            i += 1
 
-        for item in pending:
-            drain(item)
+        while pending:
+            depth = len(pending)
+            drain(pending.pop(0), depth)
         self._drain_sinks()
         self.stats.elapsed_s = time.perf_counter() - start
+        self.stats.extras["stages"] = self.stage_timer.summary()
         log.info(f"[pipeline] {self.stats.segments} segments, "
                  f"{self.stats.msamples_per_sec:.1f} Msamples/s")
         return self.stats
@@ -285,13 +359,19 @@ class Pipeline:
         The detect results (a few KB) are fetched eagerly.  The waterfall
         can be multi-GB and most sinks never read it (WriteSignalSink only
         touches it for written segments), so it is wrapped in a lazy proxy
-        whose eventual ``np.asarray`` still runs under the deadline."""
-        seg, wf, det_res, offset_after = item
-        det_res = self._sync_with_deadline(
-            lambda: jax.tree_util.tree_map(np.asarray, det_res))
+        whose eventual ``np.asarray`` still runs under the deadline.
+
+        The timed "fetch" stage therefore covers the blocking detect
+        fetch (= device completion of the whole segment program); a lazy
+        waterfall transfer lands in the consuming sink's time."""
+        seg, wf, det_res, offset_after, span = item
+        with self._stage("fetch"):
+            det_res = self._sync_with_deadline(
+                lambda: jax.tree_util.tree_map(np.asarray, det_res))
+        span["fetch"] = self.stage_timer.last["fetch"]
         if wf is not None and self.cfg.segment_deadline_s > 0:
             wf = _DeadlineArray(wf, self._sync_with_deadline)
-        return seg, wf, det_res, offset_after
+        return seg, wf, det_res, offset_after, span
 
     def _drain_sinks(self) -> None:
         for sink in self.sinks:
@@ -305,6 +385,9 @@ class Pipeline:
         if self._owned_writer_pool is not None:
             self._owned_writer_pool.close()
             self._owned_writer_pool = None
+        if self.journal is not None:
+            self.journal.close()
+            self.journal = None
 
     def __enter__(self):
         return self
@@ -401,6 +484,11 @@ class DMSearchPipeline:
                              f"snr {record['best_snr']:.1f}")
                 self.stats.segments += 1
                 self.stats.samples += cfg.baseband_input_count
+                metrics.add("segments")
+                metrics.add("samples", cfg.baseband_input_count)
+                metrics.window("segments").add(1)
+                metrics.window("samples").add(cfg.baseband_input_count)
+                telemetry.mark_segment()  # /healthz liveness
         self.stats.elapsed_s = time.perf_counter() - start
         return self.stats
 
@@ -424,40 +512,48 @@ class ThreadedPipeline(Pipeline):
         def source_f(stop_token, _):
             if max_segments is not None and count[0] >= max_segments:
                 raise StopIteration
-            try:
-                seg = next(it)
-            except StopIteration:
-                raise StopIteration from None
+            seg = self._timed_ingest(it)
+            if seg is None:
+                raise StopIteration
             count[0] += 1
-            return seg
+            # carry the ingest time with the work item: the span is
+            # assembled across three threads
+            return (seg, self.stage_timer.last["ingest"])
 
-        def device_f(stop_token, seg):
-            wf, det_res = self.processor.process(seg.data)
+        def device_f(stop_token, item):
+            seg, ingest_dt = item
+            with self._stage("dispatch"):
+                wf, det_res = self.processor.process(seg.data)
+            span = {"ingest": ingest_dt,
+                    "dispatch": self.stage_timer.last["dispatch"]}
             self.stats.segments += 1
             self.stats.samples += cfg.baseband_input_count
             return (seg, wf, det_res,
-                    getattr(self.source, "logical_offset", 0))
+                    getattr(self.source, "logical_offset", 0), span)
 
         def drain_f(stop_token, item):
             return _drain_body(stop_token, self._fetch_device(item))
 
         def _drain_body(stop_token, item):
-            seg, wf, det_res, offset_after = item
+            seg, wf, det_res, offset_after, span = item
             positive = has_signal(
                 cfg, det_res,
                 frequency_bin_count=(wf.shape[-2] if wf is not None
                                      else None))
             if positive:
                 self.stats.signals += 1
-            self._push_sinks(seg, wf, det_res, positive)
+            with self._stage("sink"):
+                self._push_sinks(seg, wf, det_res, positive)
+            span["sink"] = self.stage_timer.last["sink"]
             pool = getattr(self.source, "pool", None)
             if pool is not None and cfg.input_file_path:
                 pool.release(seg.data)
             drained[0] += 1
-            metrics.add("segments")
-            metrics.add("samples", cfg.baseband_input_count)
-            if positive:
-                metrics.add("signals")
+            # +1: the item being drained was already popped from q_res,
+            # so qsize() alone would understate the in-flight depth
+            self._record_segment(drained[0] - 1, seg, det_res, positive,
+                                 span, queue_depth=q_res.qsize() + 1,
+                                 n_samples=cfg.baseband_input_count)
             if self.checkpoint is not None:
                 self._drain_sinks()  # durability before recording done
                 self.checkpoint.update(drained[0], offset_after)
@@ -479,6 +575,7 @@ class ThreadedPipeline(Pipeline):
                 raise p.exception
         self._drain_sinks()
         self.stats.elapsed_s = time.perf_counter() - start_t
+        self.stats.extras["stages"] = self.stage_timer.summary()
         log.info(f"[pipeline threaded] {self.stats.segments} segments, "
                  f"{self.stats.msamples_per_sec:.1f} Msamples/s")
         return self.stats

@@ -34,6 +34,10 @@ def main(argv=None) -> int:
         from srtb_tpu.utils.compile_cache import enable_compile_cache
         enable_compile_cache(cfg.fft_fftw_wisdom_path)
     log.info(f"[main] nsamps_reserved = {dd.nsamps_reserved(cfg)}")
+    if cfg.telemetry_journal_path:
+        log.info("[main] segment-span journal -> "
+                 f"{cfg.telemetry_journal_path} (summarize with "
+                 "python -m srtb_tpu.tools.telemetry_report)")
 
     sinks = None
     waterfall_service = None
@@ -52,7 +56,8 @@ def main(argv=None) -> int:
         if cfg.gui_http_port:
             from srtb_tpu.gui.server import WaterfallHTTPServer
             gui_server = WaterfallHTTPServer(
-                out_dir, port=cfg.gui_http_port).start()
+                out_dir, port=cfg.gui_http_port,
+                health_stale_after_s=cfg.health_stale_after_s).start()
 
     if cfg.input_file_path and os.path.exists(cfg.input_file_path):
         source = None  # Pipeline builds the file reader

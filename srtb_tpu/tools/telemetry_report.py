@@ -1,0 +1,195 @@
+"""Summarize a segment-span telemetry journal (utils/telemetry.py).
+
+``trace_summary`` attributes *device* time from an xprof trace; this
+tool is its host-side complement: it reads the JSONL span journal the
+pipeline writes (one record per segment) and reports
+
+- a per-stage wall-clock table with exact p50/p95/p99 (computed from
+  the raw per-segment samples, unlike the bounded-bucket /metrics
+  histograms, so it doubles as their ground truth);
+- a throughput timeline (segments/s, Msamples/s, detections, loss
+  deltas per time bin) — the "profile per-stage, then attack the
+  dominant pass" loop of PERF.md, runnable on any past observation.
+
+Usage: python -m srtb_tpu.tools.telemetry_report JOURNAL.jsonl
+           [--bin SECONDS] [--format json|md]
+
+Reads ``<path>.1`` (the rotated generation) first when present, so the
+report covers everything still on disk.  Output: markdown tables (md,
+default) or one JSON document (json).  Exit 1 when no span records.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def load(path: str, include_rotated: bool = True) -> list[dict]:
+    """Parse span records, oldest first, tolerating partial lines (a
+    journal being written concurrently ends mid-record)."""
+    records = []
+    paths = []
+    if include_rotated and os.path.exists(path + ".1"):
+        paths.append(path + ".1")
+    paths.append(path)
+    for p in paths:
+        try:
+            with open(p) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line.startswith("{"):
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("type") == "segment_span":
+                        records.append(rec)
+        except OSError:
+            continue
+    return records
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Exact linear-interpolation percentile (numpy 'linear' method)."""
+    if not sorted_vals:
+        return 0.0
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = q * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def stage_stats(records: list[dict]) -> dict:
+    """stage -> {count, mean_ms, p50_ms, p95_ms, p99_ms, max_ms,
+    total_s}, plus a synthetic 'segment' stage (sum over stages of each
+    record: the per-segment host wall clock)."""
+    samples: dict[str, list[float]] = {}
+    for rec in records:
+        stages = rec.get("stages_ms") or {}
+        for name, ms in stages.items():
+            samples.setdefault(name, []).append(float(ms))
+        if stages:
+            samples.setdefault("segment", []).append(
+                float(sum(stages.values())))
+    out = {}
+    for name, vals in sorted(samples.items()):
+        vals.sort()
+        out[name] = {
+            "count": len(vals),
+            "mean_ms": round(sum(vals) / len(vals), 3),
+            "p50_ms": round(_percentile(vals, 0.50), 3),
+            "p95_ms": round(_percentile(vals, 0.95), 3),
+            "p99_ms": round(_percentile(vals, 0.99), 3),
+            "max_ms": round(vals[-1], 3),
+            "total_s": round(sum(vals) / 1e3, 3),
+        }
+    return out
+
+
+def timeline(records: list[dict], bin_s: float = 10.0) -> list[dict]:
+    """Throughput per time bin: segments/s, Msamples/s, detections,
+    dumps, and packet-loss deltas (the journal stores cumulative
+    counters; consecutive-record differences localize a burst)."""
+    recs = [r for r in records if "ts" in r]
+    if not recs:
+        return []
+    recs.sort(key=lambda r: r["ts"])
+    t0 = recs[0]["ts"]
+    bins: dict[int, dict] = {}
+    prev_lost = prev_total = None
+    for r in recs:
+        b = int((r["ts"] - t0) // bin_s)
+        cur = bins.setdefault(b, {
+            "t_start_s": round(b * bin_s, 3), "segments": 0,
+            "samples": 0, "detections": 0, "dumps": 0,
+            "packets_lost_delta": 0, "packets_total_delta": 0})
+        cur["segments"] += 1
+        cur["samples"] += int(r.get("samples", 0))
+        cur["detections"] += int(r.get("detections", 0))
+        cur["dumps"] += 1 if r.get("dump") else 0
+        lost, total = r.get("packets_lost"), r.get("packets_total")
+        if lost is not None and prev_lost is not None:
+            cur["packets_lost_delta"] += max(0, lost - prev_lost)
+            cur["packets_total_delta"] += max(0, total - prev_total)
+        prev_lost, prev_total = lost, total
+    out = []
+    last_b = max(bins)
+    span = recs[-1]["ts"] - t0
+    # each record stands for ~one inter-arrival interval, so the mean
+    # gap is the floor for the final bin's covered time: a tail record
+    # landing just past a bin boundary then reports ~the true rate
+    # instead of an n/epsilon spike
+    mean_gap = span / (len(recs) - 1) if len(recs) > 1 else bin_s
+    for b in sorted(bins):
+        cur = bins[b]
+        # the final bin is usually partial: divide by the time actually
+        # covered, not the full width, or a steady pipeline shows a
+        # phantom end-of-run slowdown
+        width = bin_s if b != last_b else \
+            min(bin_s, max(span - b * bin_s, mean_gap, 1e-3))
+        cur["segments_per_sec"] = round(cur["segments"] / width, 3)
+        cur["msamples_per_sec"] = round(cur["samples"] / width / 1e6, 3)
+        out.append(cur)
+    return out
+
+
+def report(path: str, bin_s: float = 10.0) -> dict:
+    records = load(path)
+    return {
+        "journal": path,
+        "records": len(records),
+        "stages": stage_stats(records),
+        "timeline": timeline(records, bin_s),
+    }
+
+
+def _md(rep: dict) -> str:
+    lines = [f"# Telemetry report — {rep['journal']}",
+             "", f"{rep['records']} segment spans.", "",
+             "## Per-stage wall clock (ms)", "",
+             "| stage | count | mean | p50 | p95 | p99 | max | "
+             "total s |", "|---|---|---|---|---|---|---|---|"]
+    for name, s in rep["stages"].items():
+        lines.append(
+            f"| {name} | {s['count']} | {s['mean_ms']} | {s['p50_ms']} |"
+            f" {s['p95_ms']} | {s['p99_ms']} | {s['max_ms']} |"
+            f" {s['total_s']} |")
+    lines += ["", "## Throughput timeline", "",
+              "| t (s) | segments | seg/s | Msamples/s | detections | "
+              "dumps | pkts lost |", "|---|---|---|---|---|---|---|"]
+    for b in rep["timeline"]:
+        lines.append(
+            f"| {b['t_start_s']} | {b['segments']} | "
+            f"{b['segments_per_sec']} | {b['msamples_per_sec']} | "
+            f"{b['detections']} | {b['dumps']} | "
+            f"{b['packets_lost_delta']} |")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("journal")
+    p.add_argument("--bin", type=float, default=10.0)
+    p.add_argument("--format", choices=("md", "json"), default="md")
+    args = p.parse_args(argv)
+    rep = report(args.journal, args.bin)
+    if not rep["records"]:
+        print(json.dumps({"error": f"no segment spans in {args.journal}"}),
+              file=sys.stderr)
+        return 1
+    if args.format == "json":
+        print(json.dumps(rep, sort_keys=True))
+    else:
+        print(_md(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -59,6 +59,17 @@ class BufferPool:
             self._out.discard(id(base))
             self._free.setdefault(base.nbytes, []).append(base)
 
+    def stats(self) -> dict:
+        """Occupancy snapshot for the buffer gauges (telemetry): cached
+        block count/bytes and buffers currently out."""
+        with self._lock:
+            cached = sum(len(v) for v in self._free.values())
+            cached_bytes = sum(size * len(v)
+                               for size, v in self._free.items())
+            return {"cached_blocks": cached,
+                    "cached_bytes": cached_bytes,
+                    "in_use": len(self._out)}
+
     def free_all(self) -> int:
         """Drop all cached blocks (ref: deallocate_all_free_ptrs); returns
         count of buffers still in use (leak diagnostic,

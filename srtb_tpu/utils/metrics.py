@@ -2,22 +2,169 @@
 
 The reference's observability is logs: packet-loss rates
 (io/udp/udp_receiver.hpp:154-164), allocator sizes, per-pipe timestamps
-(SURVEY.md §5.5).  Here metrics are first-class counters with a one-line
-summary and optional JSON export, covering the quantities BASELINE.md
-tracks (segments/s, Msamples/s, loss rate, detections).
+(SURVEY.md §5.5).  Here metrics are first-class typed instruments:
+
+- flat **counters/gauges** (``add``/``set``) covering the quantities
+  BASELINE.md tracks (segments/s, Msamples/s, loss rate, detections);
+- bounded-bucket **histograms** with interpolated p50/p95/p99 (per-stage
+  wall-clock — the "profile per-stage, then attack the dominant pass"
+  loop of PERF.md, always-on);
+- **sliding windows** for rates over the last N seconds (a stalled
+  observation shows 0 seg/s immediately instead of a slowly decaying
+  lifetime average).
+
+One registry (:data:`metrics`) feeds the JSON snapshot
+(``/metrics.json``), the Prometheus text exposition (``/metrics``), and
+the segment-span journal (utils/telemetry.py).
 """
 
 from __future__ import annotations
 
+import bisect
+import collections
 import json
+import math
+import re
 import threading
 import time
+
+# Exponential-ish bounds from 0.5 ms to 2 min: host stage times span
+# ~1 ms (sink push) to ~minutes (a 2^30 cold compile inside the first
+# dispatch); the overflow bucket catches anything slower.
+DEFAULT_TIME_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+class Histogram:
+    """Bounded-bucket histogram (Prometheus cumulative-bucket semantics)
+    with linearly interpolated quantiles.
+
+    ``bounds`` are upper bucket edges; one overflow bucket is implicit.
+    Quantiles interpolate within the owning bucket (the first bucket
+    interpolates from 0, the overflow bucket clamps to the highest
+    finite edge — the same convention as PromQL's histogram_quantile,
+    so the /metrics view and the in-process view agree).
+    """
+
+    __slots__ = ("name", "labels", "bounds", "_counts", "sum", "count",
+                 "_lock")
+
+    def __init__(self, name: str, buckets=DEFAULT_TIME_BUCKETS,
+                 labels: dict | None = None):
+        if not buckets:
+            raise ValueError("histogram needs at least one bucket edge")
+        self.name = name
+        self.labels = dict(labels or {})
+        self.bounds = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        i = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self._counts[i] += 1
+            self.sum += value
+            self.count += 1
+
+    def quantile(self, q: float) -> float:
+        """Interpolated q-quantile (q in [0, 1]); NaN when empty."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self.count
+        if total == 0:
+            return math.nan
+        rank = q * total
+        cum = 0.0
+        for i, c in enumerate(counts):
+            if cum + c >= rank and c > 0:
+                if i >= len(self.bounds):       # overflow bucket
+                    return self.bounds[-1]
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i]
+                return lo + (hi - lo) * (rank - cum) / c
+            cum += c
+        return self.bounds[-1]
+
+    def percentiles(self) -> dict:
+        return {"p50": self.quantile(0.50), "p95": self.quantile(0.95),
+                "p99": self.quantile(0.99)}
+
+    def cumulative_buckets(self) -> list[tuple[float, int]]:
+        """[(upper_edge, cumulative_count)] including (+inf, total)."""
+        with self._lock:
+            counts = list(self._counts)
+        out = []
+        cum = 0
+        for edge, c in zip(self.bounds, counts):
+            cum += c
+            out.append((edge, cum))
+        out.append((math.inf, cum + counts[-1]))
+        return out
+
+
+class SlidingWindow:
+    """Sum/rate of increments over the trailing ``window_s`` seconds.
+
+    A lifetime average hides a stall for minutes; the window answers
+    "what is the pipeline doing *now*".  ``clock`` is injectable for
+    deterministic tests.
+    """
+
+    __slots__ = ("name", "window_s", "_clock", "_events", "_start",
+                 "_lock")
+
+    def __init__(self, name: str, window_s: float = 10.0,
+                 clock=time.monotonic):
+        if window_s <= 0:
+            raise ValueError("window_s must be positive")
+        self.name = name
+        self.window_s = float(window_s)
+        self._clock = clock
+        self._events: collections.deque = collections.deque()
+        self._start = clock()
+        self._lock = threading.Lock()
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - self.window_s
+        while self._events and self._events[0][0] < cutoff:
+            self._events.popleft()
+
+    def add(self, value: float = 1.0) -> None:
+        now = self._clock()
+        with self._lock:
+            self._events.append((now, value))
+            self._prune(now)
+
+    def sum(self) -> float:
+        now = self._clock()
+        with self._lock:
+            self._prune(now)
+            return float(sum(v for _, v in self._events))
+
+    def rate(self) -> float:
+        """Per-second rate over the window (over the elapsed time while
+        younger than one window, so early readings aren't diluted)."""
+        now = self._clock()
+        with self._lock:
+            self._prune(now)
+            total = sum(v for _, v in self._events)
+        denom = min(self.window_s, max(now - self._start, 1e-9))
+        return float(total) / denom
+
+
+def _label_key(labels: dict | None) -> tuple:
+    return tuple(sorted((labels or {}).items()))
 
 
 class Metrics:
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: dict[str, float] = {}
+        self._histograms: dict[tuple, Histogram] = {}
+        self._windows: dict[str, SlidingWindow] = {}
         self._start = time.monotonic()
 
     def add(self, name: str, value: float = 1.0) -> None:
@@ -32,16 +179,47 @@ class Metrics:
         with self._lock:
             return self._counters.get(name, 0.0)
 
+    def histogram(self, name: str, buckets=DEFAULT_TIME_BUCKETS,
+                  labels: dict | None = None) -> Histogram:
+        """Get-or-create; (name, labels) identify the series.  Buckets
+        are fixed at creation (first caller wins, like Prometheus
+        clients)."""
+        key = (name, _label_key(labels))
+        with self._lock:
+            h = self._histograms.get(key)
+            if h is None:
+                h = self._histograms[key] = Histogram(
+                    name, buckets=buckets, labels=labels)
+        return h
+
+    def window(self, name: str, window_s: float = 10.0) -> SlidingWindow:
+        """Get-or-create a sliding-window rate (first caller fixes the
+        window length)."""
+        with self._lock:
+            w = self._windows.get(name)
+            if w is None:
+                w = self._windows[name] = SlidingWindow(
+                    name, window_s=window_s)
+        return w
+
     def reset(self) -> None:
-        """Clear all counters and restart the clock (tests; a fresh
+        """Clear all instruments and restart the clock (tests; a fresh
         observation run)."""
         with self._lock:
             self._counters.clear()
+            self._histograms.clear()
+            self._windows.clear()
             self._start = time.monotonic()
 
-    def snapshot(self) -> dict:
+    def _scalar_series(self):
+        """Counters + derived scalars (lifetime and windowed loss rate,
+        lifetime Msamples/s, elapsed), plus the instrument lists — ONE
+        computation shared by snapshot() and prometheus() so the JSON
+        and Prometheus views can never drift apart."""
         with self._lock:
             out = dict(self._counters)
+            hists = list(self._histograms.values())
+            windows = list(self._windows.values())
         elapsed = time.monotonic() - self._start
         out["elapsed_s"] = elapsed
         if "samples" in out and elapsed > 0:
@@ -49,10 +227,88 @@ class Metrics:
         if "packets_total" in out and out["packets_total"] > 0:
             out["packet_loss_rate"] = (
                 out.get("packets_lost", 0.0) / out["packets_total"])
+        by_name = {w.name: w for w in windows}
+        if "packets_total" in by_name and "packets_lost" in by_name:
+            total_w = by_name["packets_total"].sum()
+            if total_w > 0:
+                out["packet_loss_rate_window"] = (
+                    by_name["packets_lost"].sum() / total_w)
+        return out, windows, hists
+
+    def snapshot(self) -> dict:
+        out, windows, hists = self._scalar_series()
+        for w in windows:
+            out[f"{w.name}_per_sec_{w.window_s:g}s"] = w.rate()
+        for h in hists:
+            base = "_".join([h.name] + [str(v) for _, v
+                                        in sorted(h.labels.items())])
+            if h.count:
+                p = h.percentiles()
+                out[f"{base}_p50"] = p["p50"]
+                out[f"{base}_p95"] = p["p95"]
+                out[f"{base}_p99"] = p["p99"]
+                out[f"{base}_mean"] = h.sum / h.count
+            out[f"{base}_count"] = h.count
         return out
 
     def to_json(self) -> str:
         return json.dumps(self.snapshot(), sort_keys=True)
+
+    # ---- Prometheus text exposition (format version 0.0.4) ----
+
+    @staticmethod
+    def _prom_name(name: str) -> str:
+        return "srtb_" + re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+    @staticmethod
+    def _prom_labels(labels: dict) -> str:
+        if not labels:
+            return ""
+        def esc(v):
+            return str(v).replace("\\", r"\\").replace('"', r'\"') \
+                         .replace("\n", r"\n")
+        inner = ",".join(f'{k}="{esc(v)}"'
+                         for k, v in sorted(labels.items()))
+        return "{" + inner + "}"
+
+    def prometheus(self) -> str:
+        """Render every instrument in the Prometheus text format: flat
+        counters/gauges as gauges (we don't track which are monotonic),
+        windows as gauges, histograms with cumulative ``_bucket``/
+        ``_sum``/``_count`` series.  The scalar set matches
+        /metrics.json exactly (derived series like packet_loss_rate
+        and msamples_per_sec included), so an alert written against
+        either endpoint sees the other's values too."""
+        scalars, windows, hists = self._scalar_series()
+        lines = []
+
+        def val(v: float) -> str:
+            return f"{v:.17g}"
+
+        for k in sorted(scalars):
+            name = self._prom_name(k)
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {val(scalars[k])}")
+        for w in windows:
+            name = self._prom_name(w.name) + "_per_sec"
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(
+                f'{name}{{window_s="{w.window_s:g}"}} {val(w.rate())}')
+        for hname in sorted({h.name for h in hists}):
+            name = self._prom_name(hname)
+            lines.append(f"# TYPE {name} histogram")
+            for h in hists:
+                if h.name != hname:
+                    continue
+                for edge, cum in h.cumulative_buckets():
+                    le = "+Inf" if math.isinf(edge) else f"{edge:g}"
+                    labels = dict(h.labels, le=le)
+                    lines.append(
+                        f"{name}_bucket{self._prom_labels(labels)} {cum}")
+                lbl = self._prom_labels(h.labels)
+                lines.append(f"{name}_sum{lbl} {val(h.sum)}")
+                lines.append(f"{name}_count{lbl} {h.count}")
+        return "\n".join(lines) + "\n"
 
 
 metrics = Metrics()

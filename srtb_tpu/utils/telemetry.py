@@ -1,0 +1,150 @@
+"""Segment-span telemetry: rotating JSONL journal + pipeline health.
+
+The reference's per-pipe timestamp logs (SURVEY.md §5.1, §5.5) answer
+"where did this segment spend its time" only via grep.  Here every
+processed segment emits one structured JSONL record — segment id,
+per-stage wall-clock (from the pipeline's integrated StageTimer),
+queue depth, cumulative loss/drop counters, detection count and the
+dump decision — to a size-rotated journal file.  Host stages are also
+wrapped in ``jax.profiler.TraceAnnotation`` (pipeline/runtime.py), so
+an xprof trace and the journal correlate by stage name.
+
+``tools/telemetry_report.py`` turns a journal into per-stage percentile
+tables and throughput timelines; ``health()`` feeds the ``/healthz``
+endpoint (gui/server.py) with last-segment-age staleness detection.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from srtb_tpu.utils.logging import log
+from srtb_tpu.utils.metrics import metrics
+
+SPAN_SCHEMA_VERSION = 1
+
+# gauge names shared between the pipeline (writer) and health() (reader)
+LAST_SEGMENT_MONOTONIC = "last_segment_monotonic"
+LAST_SEGMENT_UNIX = "last_segment_unix"
+
+
+class SpanJournal:
+    """Append-only JSONL with single-generation size rotation: when the
+    active file would exceed ``max_bytes`` it is renamed to ``<path>.1``
+    (replacing the previous generation) and a fresh file starts — an
+    always-on journal on a long observation can never fill the disk,
+    and the last ~2 x max_bytes of spans are always on hand."""
+
+    def __init__(self, path: str, max_bytes: int = 64 << 20):
+        if max_bytes <= 0:
+            raise ValueError("max_bytes must be positive")
+        self.path = path
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._file = open(path, "a")
+        self._size = self._file.tell()
+
+    def write(self, record: dict) -> None:
+        """Best-effort append: an I/O failure (disk full, rotation
+        rename error) logs once and disables the journal — telemetry
+        must never abort the observation it is describing."""
+        line = json.dumps(record, sort_keys=True) + "\n"
+        with self._lock:
+            if self._file is None:
+                return
+            try:
+                if self._size and self._size + len(line) > self.max_bytes:
+                    self._rotate()
+                self._file.write(line)
+                self._file.flush()
+                self._size += len(line)
+            except OSError as e:
+                log.warning(f"[telemetry] journal {self.path} failed "
+                            f"({e!r}); disabling span journal")
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+
+    def _rotate(self) -> None:
+        self._file.close()
+        os.replace(self.path, self.path + ".1")
+        self._file = open(self.path, "a")
+        self._size = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def segment_span(segment: int, stages_s: dict, queue_depth: int,
+                 detections: int, dump: bool, samples: int,
+                 timestamp_ns: int = 0, extra: dict | None = None) -> dict:
+    """One journal record.  ``stages_s`` maps stage name -> seconds for
+    THIS segment; loss/drop counters are the cumulative registry values
+    at drain time (deltas between consecutive records localize a loss
+    burst to a segment)."""
+    rec = {
+        "type": "segment_span",
+        "v": SPAN_SCHEMA_VERSION,
+        "ts": time.time(),
+        "segment": int(segment),
+        "timestamp_ns": int(timestamp_ns),
+        "stages_ms": {k: round(v * 1e3, 3) for k, v in stages_s.items()},
+        "queue_depth": int(queue_depth),
+        "detections": int(detections),
+        "dump": bool(dump),
+        "samples": int(samples),
+        "packets_total": metrics.get("packets_total"),
+        "packets_lost": metrics.get("packets_lost"),
+        "segments_dropped": metrics.get("segments_dropped"),
+    }
+    if extra:
+        rec.update(extra)
+    return rec
+
+
+def mark_segment() -> None:
+    """Stamp the registry with "a segment just finished" — the signal
+    health() ages against."""
+    metrics.set(LAST_SEGMENT_MONOTONIC, time.monotonic())
+    metrics.set(LAST_SEGMENT_UNIX, time.time())
+
+
+def health(stale_after_s: float = 30.0) -> dict:
+    """Pipeline liveness from the shared registry: ``ok`` before any
+    segment (startup / idle server is healthy), ``ok`` while the last
+    segment is younger than ``stale_after_s``, ``stale`` otherwise — a
+    wedged accelerator or dead source flips /healthz to 503 without any
+    in-process cooperation from the stuck thread."""
+    last = metrics.get(LAST_SEGMENT_MONOTONIC)
+    out = {
+        "segments": metrics.get("segments"),
+        "signals": metrics.get("signals"),
+        "stale_after_s": float(stale_after_s),
+    }
+    if not last:
+        out.update(status="idle", ok=True, last_segment_age_s=None)
+        return out
+    age = time.monotonic() - last
+    out["last_segment_age_s"] = round(age, 3)
+    if age > stale_after_s:
+        out.update(status="stale", ok=False)
+    else:
+        out.update(status="ok", ok=True)
+    return out

@@ -9,9 +9,27 @@ harness in test-fft_wrappers, hand-recorded kernel timings — SURVEY.md
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 
 from srtb_tpu.utils.logging import log
+
+
+@contextlib.contextmanager
+def trace_annotation(name: str):
+    """``jax.profiler.TraceAnnotation`` when available (shows host-side
+    stage extents on the xprof timeline, correlating the span journal
+    with device traces by stage name); a no-op on backends without it.
+    Importing jax lazily keeps pure-host tools (telemetry_report) free
+    of the jax import cost."""
+    try:
+        import jax
+
+        cm = jax.profiler.TraceAnnotation(name)
+    except Exception:  # pragma: no cover - profiler-less backend
+        cm = contextlib.nullcontext()
+    with cm:
+        yield
 
 
 @contextlib.contextmanager
@@ -35,11 +53,34 @@ def device_trace(trace_dir: str):
 
 class StageTimer:
     """Accumulates wall-clock per named stage; the per-pipe-timestamp logs
-    of the reference, queryable instead of grep-able."""
+    of the reference, queryable instead of grep-able.
 
-    def __init__(self):
+    Integrated into pipeline/runtime.py (each host stage of every
+    segment runs under ``stage()``): ``last`` holds the most recent
+    duration per stage so the caller can assemble a per-segment span,
+    and ``on_stage(name, seconds)`` (when set) feeds every completed
+    timing to the metrics histograms.  Thread-safe — the threaded
+    pipeline runs each stage on its own thread.
+    """
+
+    def __init__(self, on_stage=None):
         self.totals: dict[str, float] = {}
         self.counts: dict[str, int] = {}
+        self.last: dict[str, float] = {}
+        self.on_stage = on_stage
+        self._lock = threading.Lock()
+
+    def record(self, name: str, dt: float) -> None:
+        """Record one externally timed stage duration (used where the
+        caller must decide *after* timing whether the sample counts —
+        e.g. the terminal failed source read must not pollute the
+        ingest histogram)."""
+        with self._lock:
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+            self.last[name] = dt
+        if self.on_stage is not None:
+            self.on_stage(name, dt)
 
     @contextlib.contextmanager
     def stage(self, name: str):
@@ -47,12 +88,12 @@ class StageTimer:
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            self.totals[name] = self.totals.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + 1
+            self.record(name, time.perf_counter() - t0)
 
     def summary(self) -> dict:
-        return {name: {"total_s": round(t, 6),
-                       "count": self.counts[name],
-                       "mean_ms": round(1e3 * t / self.counts[name], 3)}
-                for name, t in sorted(self.totals.items())}
+        with self._lock:
+            return {name: {"total_s": round(t, 6),
+                           "count": self.counts[name],
+                           "mean_ms": round(1e3 * t / self.counts[name],
+                                            3)}
+                    for name, t in sorted(self.totals.items())}

@@ -189,7 +189,11 @@ def test_row_block_vmem_budget_knob(monkeypatch):
     monkeypatch.delenv("SRTB_PALLAS_VMEM_MB", raising=False)
     base = PF._row_block(1 << 14, 1 << 11)      # 2^18/2^14 = 16 rows
     assert base == 16
-    assert PF._call_kwargs(interpret=False) == {}
+    # unset: the block plan keeps the proven default, but the Mosaic
+    # scoped-vmem limit is ALWAYS set (100 MiB; the compiler default is
+    # far below the v5e's 128 MiB and the L=2^16 leg overflows it)
+    kw0 = PF._call_kwargs(interpret=False)
+    assert kw0["compiler_params"].vmem_limit_bytes == 100 << 20
     monkeypatch.setenv("SRTB_PALLAS_VMEM_MB", "56")
     big = PF._row_block(1 << 14, 1 << 11)
     assert big > base and (1 << 11) % big == 0

@@ -82,15 +82,10 @@ python -m srtb_tpu.tools.trace_summary /tmp/r5_trace_pallas2 --top 10 \
     done
 run pallas2_small_blk env SRTB_BENCH_FFT_STRATEGY=pallas2 SRTB_PALLAS2_BB=64 \
     SRTB_PALLAS2_RB=8 SRTB_BENCH_DEADLINE=900 python bench.py
-# alternate Mosaic lowering of the same math (transpose-to-rows +
-# classic two-level helper) — the A/B partner / fallback if the
-# column-native dot_general spelling compiles or performs badly
-run pallas2_rowspell env SRTB_BENCH_FFT_STRATEGY=pallas2 \
-    SRTB_PALLAS2_P1=row SRTB_PALLAS2_ROWS=classic \
-    SRTB_BENCH_DEADLINE=900 python bench.py
-# dense-helper A/B on the PROVEN waterfall/SK row kernels
-run pallas_dense env SRTB_BENCH_USE_PALLAS=1 SRTB_BENCH_USE_PALLAS_SK=1 \
-    SRTB_PALLAS_ROWS=dense SRTB_BENCH_DEADLINE=900 python bench.py
+# (the SRTB_PALLAS2_P1/SRTB_PALLAS2_ROWS/SRTB_PALLAS_ROWS A/B legs are
+# retired: real Mosaic rejects the alternate spellings' minor-lb
+# reshapes, so only the column-native + vmem_fft_rows lowering ships —
+# see PERF.md "pallas2" and ops/pallas_fft.vmem_fft_rows)
 # big-block A/B on the same proven kernels: 56 MiB plan vs the 1 MB-plane
 # default (v5e has 128 MiB VMEM; fewer grid steps, longer DMA bursts)
 run pallas_bigblk env SRTB_BENCH_USE_PALLAS=1 SRTB_BENCH_USE_PALLAS_SK=1 \
@@ -305,16 +300,15 @@ fi
 #     -> make resolve_strategy "auto" pick pallas2 for n in [2^25, 2^30)
 #        and rerun the default bench so BENCH_r0N reflects it.
 # pallas2 VMEM/compile failure
-#     -> pallas2_lowvmem_* / pallas2_small_blk / pallas2_rowspell /
-#        pallas2_n1_8192_27 are the retries (budget, blocks, spelling,
-#        factorization); if all fail, monolithic stays default and the
-#        probe rc/error rows document why.
+#     -> pallas2_lowvmem_* / pallas2_small_blk / pallas2_n1_8192_27 are
+#        the retries (budget, blocks, factorization); if all fail,
+#        monolithic stays default and the probe rc/error rows document
+#        why.
 # best(n2_30_pallas2, n2_30_pallas2_full, staged_blocked_pallas2,
 #      fused_2_30_pallas2) <= 1.4 s/segment
 #     -> VERDICT #3 target met; make that plan the n >= 2^30 default.
 # planes_unpack_mosaic_probe ok -> flip pallas_kernels.PLANES_UNPACK_MOSAIC_OK.
 # mxu_precision_probe_high rel_err <= ~2e-6 -> flip SRTB_MXU_PRECISION default.
-# pallas_dense >= pallas_sk -> flip pallas_fft.active_rows_helper default.
 # pallas_bigblk >= pallas_sk -> adopt SRTB_PALLAS_VMEM_MB=56 as the
 #     accelerator default row-block plan (ops/pallas_fft._row_block).
 # cache_warm compile_s <= 10 s -> VERDICT #7 done; else the axon remote
