@@ -151,7 +151,21 @@ def baseline_pass(on_accel: bool, realtime_factor: float) -> bool:
     return bool(on_accel and realtime_factor >= 1.0)
 
 
-def run_bench(platform_error):
+def parse_args(argv=None):
+    """--overlap on|off: A/B legs for the async-dispatch overlap win.
+    "on" (default, the historical timer semantics) dispatches all reps
+    back to back and syncs once — host time and tunnel RTT hide under
+    device compute, the way the runtime's in-flight engine streams.
+    "off" is the serial reference leg: a blocking host sync after every
+    segment, so the per-segment RTT lands in every segment."""
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--overlap", choices=("on", "off"), default="on")
+    return p.parse_args(argv)
+
+
+def run_bench(platform_error, overlap: str = "on"):
     import jax
 
     from srtb_tpu.utils.platform import apply_platform_env
@@ -259,6 +273,12 @@ def run_bench(platform_error):
         wf, res = proc.run_device(raw_dev)
         last = res.signal_counts
         del wf, res
+        if overlap == "off":
+            # serial reference leg (the runtime's inflight_segments=1
+            # A/B twin): a blocking host sync per segment, so the
+            # per-segment dispatch + tunnel RTT (~60 ms, PERF.md) is
+            # paid every time
+            np.asarray(last)
     np.asarray(last)
     dt = (time.perf_counter() - t0) / reps
 
@@ -280,6 +300,7 @@ def run_bench(platform_error):
         "achieved_gflops_s": round(flops / dt / 1e9, 1),
         "model_hbm_gb": round(bytes_moved / 1e9, 3),
         "achieved_gbps": round(bytes_moved / dt / 1e9, 1),
+        "overlap": overlap,
     }
     if cfg.aot_plan_path:
         # whether the AOT executable cache actually engaged — the
@@ -329,11 +350,12 @@ def _arm_watchdog(platform, err):
 
 
 def main():
+    args = parse_args()
     platform, err = pick_platform()
     os.environ["JAX_PLATFORMS"] = platform
     watchdog = _arm_watchdog(platform, err)
     try:
-        run_bench(err)
+        run_bench(err, overlap=args.overlap)
         # disarm before teardown: a slow runtime shutdown must not fire
         # a second, contradictory diagnostic line after the real result
         if watchdog is not None:

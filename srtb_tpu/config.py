@@ -134,6 +134,19 @@ class Config:
     # (PERF_TPU.jsonl kernel rows) — opt-in for shapes where the 2-read
     # pass wins
     use_pallas_sk: bool = False
+    # bounded window of segments dispatched to the device before the
+    # oldest result is drained (pipeline/runtime.py async engine):
+    # ingest + unpack + H2D staging of segment k+1..k+W-1 run while the
+    # device computes segment k, and fetch polls device readiness
+    # instead of blocking.  1 = fully serial (the A/B reference leg);
+    # 2-3 hides host time under device compute (the reference's
+    # queue-capacity-2 pipe graph, config.hpp:40-43)
+    inflight_segments: int = 2
+    # micro-batch: stack B consecutive segments into ONE jit call
+    # (vmapped fused plan) to amortize per-dispatch host overhead and
+    # tunnel RTT (~60 ms per host sync, PERF.md) over B segments.
+    # 1 = off; >1 requires the fused plan (not staged)
+    micro_batch_segments: int = 1
     # fail-fast watchdog on the per-segment device sync (seconds,
     # 0 = disabled): a wedged accelerator runtime otherwise hangs the
     # observation silently — on expiry the process aborts through the
@@ -194,7 +207,8 @@ class Config:
         "gui_pixmap_height", "gui_http_port", "n_devices", "log_level",
         "writer_thread_count", "distributed_num_processes",
         "distributed_process_id", "gui_scroll_lines",
-        "telemetry_journal_max_bytes",
+        "telemetry_journal_max_bytes", "inflight_segments",
+        "micro_batch_segments",
     })
     _FLOAT_FIELDS = frozenset({
         "baseband_freq_low", "baseband_bandwidth", "baseband_sample_rate",

@@ -1,0 +1,131 @@
+"""Accounted-loss backpressure between a real-time source and the engine.
+
+The reference's never-stall-on-loss property (SURVEY.md; measured in the
+e2e overload test): when compute cannot keep up with a real-time source,
+the source must keep running and the excess must surface as *accounted*
+loss — never as silent latency or a stalled receiver.  For UDP ingest the
+kernel already provides this (a full rcvbuf drops packets, and the
+counter gaps are accounted by the receivers); this module provides the
+same contract at segment granularity for any ``SegmentWork`` iterator —
+e.g. a file replayed at wire rate, or a source whose own buffering must
+not be trusted to stay bounded when the engine's in-flight window fills.
+
+``DropOldestSegmentBuffer`` pulls the wrapped source on its own thread
+into a bounded deque.  When the pipeline (the consumer) falls behind and
+the deque is full, the OLDEST buffered segment is dropped and counted
+(``segments_dropped`` counter + 10 s window + the span journal's
+cumulative field), keeping the freshest data — matching the real-time
+bias of the reference's lossy visualization taps (pipe_io.hpp:79-94),
+but with loss that is always visible in /metrics and the journal.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+from srtb_tpu.utils.logging import log
+from srtb_tpu.utils.metrics import metrics
+
+
+class DropOldestSegmentBuffer:
+    """Bounded segment buffer with drop-oldest overflow accounting.
+
+    Iterating yields segments in production order (minus accounted
+    drops); iteration ends when the wrapped source is exhausted and the
+    buffer has drained.  A source exception is re-raised to the
+    consumer at the point of the failed ``__next__``.
+
+    Not for checkpointed file replays: the pump thread reads ahead of
+    the consumer, so the forwarded ``logical_offset`` is the pump's
+    position, and a drop means a resume offset can never be exact —
+    lossy buffering and exactly-once checkpointing are contradictory
+    by construction.
+    """
+
+    def __init__(self, source, capacity: int = 4,
+                 name: str = "segment_buffer"):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.source = source
+        self.capacity = int(capacity)
+        self.name = name
+        self.dropped = 0
+        self._buf: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._done = False
+        self._error: BaseException | None = None
+        self._thread = threading.Thread(target=self._pump, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    def _pump(self) -> None:
+        try:
+            for seg in self.source:
+                with self._cv:
+                    if self._done:
+                        break
+                    if len(self._buf) >= self.capacity:
+                        victim = self._buf.popleft()
+                        self.dropped += 1
+                        metrics.add("segments_dropped")
+                        metrics.window("segments_dropped").add(1)
+                        # a pooled source's buffer must go back to the
+                        # pool: the pipeline only releases segments it
+                        # actually drains
+                        pool = getattr(self.source, "pool", None)
+                        if pool is not None:
+                            pool.release(victim.data)
+                        log.warning(
+                            f"[{self.name}] consumer behind: dropped "
+                            f"oldest segment ({self.dropped} total)")
+                    self._buf.append(seg)
+                    metrics.set(f"{self.name}_depth", len(self._buf))
+                    self._cv.notify()
+        except BaseException as e:  # noqa: BLE001 - hand to the consumer
+            with self._cv:
+                if not self._done:  # an unblock-by-close is not an error
+                    self._error = e
+        finally:
+            with self._cv:
+                self._done = True
+                self._cv.notify_all()
+
+    @property
+    def pool(self):
+        """Forward the wrapped source's buffer pool (if any) so the
+        pipeline's drain path keeps releasing segment buffers exactly
+        as it would against the unwrapped source."""
+        return getattr(self.source, "pool", None)
+
+    @property
+    def logical_offset(self):
+        return getattr(self.source, "logical_offset", 0)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        with self._cv:
+            while not self._buf:
+                if self._error is not None:
+                    err, self._error = self._error, None
+                    raise err
+                if self._done:
+                    raise StopIteration
+                self._cv.wait()
+            seg = self._buf.popleft()
+            metrics.set(f"{self.name}_depth", len(self._buf))
+            return seg
+
+    def close(self) -> None:
+        with self._cv:
+            self._done = True
+            self._cv.notify_all()
+        # close the wrapped source FIRST: a pump thread blocked inside a
+        # receive only unblocks when the underlying fd goes away (the
+        # raised OSError is swallowed because _done is already set)
+        close = getattr(self.source, "close", None)
+        if close is not None:
+            close()
+        self._thread.join(timeout=5)

@@ -75,7 +75,11 @@ class WorkQueue:
         return self._q.qsize()
 
 
-_SENTINEL = object()
+# end-of-stream marker: a producer that is not a Pipe (e.g. the async
+# engine's main loop feeding its sink pipe) pushes this to terminate the
+# consumer cleanly; Pipe._run forwards it downstream automatically
+SENTINEL = object()
+_SENTINEL = SENTINEL  # historical private alias
 
 
 class Pipe:

@@ -2,17 +2,38 @@
 
 The reference's thread-per-pipe/bounded-queue machinery
 (ref: pipeline/framework/pipe.hpp, pipe_io.hpp) exists to overlap GPU
-kernels of consecutive segments.  Under JAX, async dispatch already
-overlaps: ``process(segment_k+1)`` is enqueued while ``segment_k``'s
-results are still materializing, and host->HBM transfer of the next
-segment overlaps device compute (double buffering).  What remains of the
-framework is this small host loop with work accounting
-(ref: main.cpp:146-162 work_in_pipeline_count) and orderly shutdown
-(ref: framework/exit_handler.hpp).
+kernels of consecutive segments.  Under JAX, async dispatch provides the
+device-side half for free; the host-side half is the **async in-flight
+segment engine** in :meth:`Pipeline.run`:
+
+- a bounded window of ``Config.inflight_segments`` segments is
+  dispatched before the oldest result is drained, so segment k+1's
+  ingest, sub-byte unpack, and H2D staging run while the device
+  computes segment k (the double-buffer AstroAccelerate builds with
+  CUDA streams, arXiv:2101.00941);
+- fetch is non-blocking where possible: the drain loop polls device
+  readiness (``jax.Array.is_ready``) and drains completed segments in
+  order, blocking only when the window is full or the source is done;
+- sink work (writers, lazy waterfall transfer, journal, checkpoint)
+  runs on a dedicated framework Pipe, off the dispatch critical path;
+- per segment, the wall clock between dispatch returning and fetch
+  starting is journaled as ``overlap_hidden_ms`` (+ the ``overlap``
+  stage histogram and the ``inflight_depth`` gauge), so overlap
+  efficiency is measurable, not assumed;
+- optional micro-batching (``Config.micro_batch_segments`` = B > 1)
+  stacks B segments into ONE vmapped jit call, amortizing dispatch
+  overhead and tunnel RTT over B segments.
+
+``inflight_segments = 1`` is the fully serial reference leg (ingest ->
+dispatch -> blocking fetch -> sink per segment) used by the A/B
+harness.  Work accounting (ref: main.cpp:146-162
+work_in_pipeline_count) and orderly shutdown
+(ref: framework/exit_handler.hpp) carry over from the reference.
 """
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import os
 import time
@@ -157,9 +178,18 @@ class Pipeline:
     """File (or any SegmentWork iterator) to sinks."""
 
     def __init__(self, cfg: Config, source=None, sinks=None,
-                 keep_waterfall: bool = True):
+                 keep_waterfall: bool = True, processor=None):
         self.cfg = cfg
-        self.processor = SegmentProcessor(cfg)
+        if processor is None:
+            # donate the per-segment input buffer on accelerators: the
+            # engine stages a fresh device array per segment and never
+            # reuses it, so XLA may recycle its HBM as program scratch
+            # (steady state does no net fresh device allocation).  Kept
+            # off on CPU where donation is a no-op.
+            from srtb_tpu.utils.platform import on_accelerator
+            processor = SegmentProcessor(cfg,
+                                         donate_input=on_accelerator())
+        self.processor = processor
         self._owned_writer_pool = None
         self.checkpoint = None
         if cfg.checkpoint_path:
@@ -226,7 +256,9 @@ class Pipeline:
 
     def _record_segment(self, index: int, seg, det_res, positive: bool,
                         span: dict, queue_depth: int,
-                        n_samples: int) -> None:
+                        n_samples: int,
+                        overlap_hidden_s: float | None = None,
+                        inflight_depth: int | None = None) -> None:
         """Per-drained-segment telemetry: lifetime counters, sliding
         window rates (segments/s and samples/s over the last 10 s — a
         stall is visible immediately, unlike the lifetime average), the
@@ -245,75 +277,330 @@ class Pipeline:
         if self.journal is not None:
             self.journal.write(telemetry.segment_span(
                 index, span, queue_depth, det_count, positive, n_samples,
-                timestamp_ns=getattr(seg, "timestamp", 0)))
+                timestamp_ns=getattr(seg, "timestamp", 0),
+                overlap_hidden_s=overlap_hidden_s,
+                inflight_depth=inflight_depth))
+
+    # ---------------------------------------------- async segment engine
+
+    @staticmethod
+    def _result_ready(det_res) -> bool:
+        """True when every device array in the detect result has
+        materialized (``jax.Array.is_ready``) — the non-blocking fetch
+        probe.  Objects without a readiness probe (host arrays, test
+        stubs that choose not to implement one) count as ready."""
+        try:
+            leaves = jax.tree_util.tree_leaves(det_res)
+        except Exception:
+            return True
+        for leaf in leaves:
+            probe = getattr(leaf, "is_ready", None)
+            if probe is None:
+                continue
+            try:
+                if not probe():
+                    return False
+            except Exception:
+                return True
+        return True
+
+    def _dispatch_segment(self, seg, ingest_s: float,
+                          offset_after: int) -> tuple:
+        """Stage one segment's bytes to the device (async H2D) and
+        enqueue its program; both run under the "dispatch" stage.
+        ``offset_after`` is the source's logical offset captured right
+        after THIS segment's ingest (not at dispatch time — with
+        batching, later ingests have already advanced the source).
+        Returns the in-flight record."""
+        with self._stage("dispatch"):
+            stage_in = getattr(self.processor, "stage_input", None)
+            if stage_in is not None:
+                wf, det_res = self.processor.run_device(
+                    stage_in(seg.data))
+            else:  # duck-typed stub processors (tests)
+                wf, det_res = self.processor.process(seg.data)
+        span = {"ingest": ingest_s,
+                "dispatch": self.stage_timer.last["dispatch"]}
+        return (seg, wf, det_res, offset_after, span,
+                time.perf_counter())
+
+    def _dispatch_micro_batch(self, segs: list, ingests: list,
+                              offsets: list) -> list:
+        """Stack B ingested segments into ONE vmapped jit call; each
+        segment's results are lazy device slices of the batch outputs.
+        The batch dispatch cost is amortized evenly across the spans;
+        each item keeps its OWN post-ingest source offset so a
+        checkpoint written after a partially drained batch resumes at
+        the first undrained segment, not past the whole batch."""
+        t0 = time.perf_counter()
+        with trace_annotation("srtb:dispatch"):
+            stacked = np.stack([np.asarray(s.data) for s in segs])
+            wf_b, det_b = self.processor.process_batch(stacked)
+        per_seg = (time.perf_counter() - t0) / len(segs)
+        items = []
+        for i, seg in enumerate(segs):
+            self.stage_timer.record("dispatch", per_seg)
+            det_i = jax.tree_util.tree_map(
+                lambda x, j=i: x[j], det_b)
+            span = {"ingest": ingests[i], "dispatch": per_seg}
+            items.append((seg, wf_b[i], det_i, offsets[i], span,
+                          time.perf_counter()))
+        return items
+
+    def _fetch_inflight(self, item: tuple, depth: int,
+                        live_depth: int) -> tuple:
+        """Resolve one in-flight record to host data.  The gap between
+        dispatch returning and this fetch starting is host time the
+        engine hid under device compute — journaled as
+        ``overlap_hidden_ms`` and observed into the ``overlap`` stage
+        histogram."""
+        seg, wf, det_res, offset_after, span, t_dispatched = item
+        hidden = max(0.0, time.perf_counter() - t_dispatched)
+        self.stage_timer.record("overlap", hidden)
+        seg, wf, det_res, offset_after, span = self._fetch_device(
+            (seg, wf, det_res, offset_after, span))
+        return (seg, wf, det_res, offset_after, span, hidden, depth,
+                live_depth)
+
+    def _drain_body(self, item: tuple, drained: list) -> None:
+        """Sink-side half of one segment: detection gate, sink pushes,
+        buffer-pool release, journal record, checkpoint.  Runs on the
+        sink pipe thread in overlapped mode (off the dispatch critical
+        path), inline in serial mode."""
+        cfg = self.cfg
+        seg, wf, det_res, offset_after, span, hidden, depth, live = item
+        positive = has_signal(
+            cfg, det_res,
+            frequency_bin_count=(wf.shape[-2] if wf is not None
+                                 else None))
+        if positive:
+            self.stats.signals += 1
+            # drained[0] is the index this segment journals as; the
+            # dispatch counter runs ahead of the drain in overlapped
+            # mode and would name the wrong segment
+            log.info("[pipeline] signal detected in segment "
+                     f"{drained[0]}")
+        with self._stage("sink"):
+            self._push_sinks(seg, wf, det_res, positive)
+        span["sink"] = self.stage_timer.last["sink"]
+        # file mode: sinks never retain segments (no piggybank deque),
+        # so the host buffer can go back to the pool for the reader
+        pool = getattr(self.source, "pool", None)
+        if pool is not None and cfg.input_file_path:
+            pool.release(seg.data)
+        drained[0] += 1
+        self._record_segment(drained[0] - 1, seg, det_res, positive,
+                             span, queue_depth=depth,
+                             n_samples=cfg.baseband_input_count,
+                             overlap_hidden_s=hidden,
+                             inflight_depth=live)
+        if self.checkpoint is not None:
+            # a checkpointed segment must be durable: flush queued
+            # async candidate writes before recording it as done
+            self._drain_sinks()
+            self.checkpoint.update(drained[0], offset_after)
 
     def run(self, max_segments: int | None = None) -> PipelineStats:
-        cfg = self.cfg
-        start = time.perf_counter()
-        pending: list[tuple] = []
-        n_samples_per_seg = cfg.baseband_input_count
+        """The async in-flight engine (see module docstring).  With
+        ``inflight_segments = 1`` this degenerates to the fully serial
+        reference loop; the default window of 2 reproduces the
+        reference's queue-capacity-2 pipe graph with sink work off the
+        critical path."""
+        from srtb_tpu.pipeline import framework as fw
 
+        cfg = self.cfg
+        window = max(1, int(getattr(cfg, "inflight_segments", 2) or 1))
+        batch = max(1, int(getattr(cfg, "micro_batch_segments", 1) or 1))
+        if batch > window:
+            raise ValueError(
+                f"micro_batch_segments={batch} exceeds "
+                f"inflight_segments={window}: a batch dispatch must fit "
+                "the in-flight window")
+        if batch > 1 and getattr(self.processor, "staged", False):
+            # fail before any ingest/compile happens: process_batch
+            # would reject this anyway, but only after B multi-GB
+            # segments were read and stacked
+            raise ValueError(
+                "micro_batch_segments > 1 requires the fused plan "
+                "(staged segments are already dispatch-amortized)")
+        start = time.perf_counter()
+        n_samples_per_seg = cfg.baseband_input_count
         drained = [self.checkpoint.segments_done if self.checkpoint else 0]
 
-        def drain(item, depth):
-            _drain_body(self._fetch_device(item), depth)
+        # sink work runs on a framework Pipe in overlapped mode so
+        # writers + the lazy waterfall transfer cannot serialize into
+        # the next segment's ingest/dispatch; serial mode keeps it
+        # inline (the honest A/B reference leg)
+        use_sink_pipe = window > 1
+        stop = fw.StopToken()
+        q_sink = fw.WorkQueue(capacity=window)
+        # a segment is "in flight" from dispatch until its SINK
+        # completes: the admission gate below bounds this count by the
+        # window, so at most W waterfalls are device-resident at once.
+        # Without sink accounting, fetched-but-unsunk items in the
+        # queue would stack up to ~2W waterfalls — an HBM regression
+        # at multi-GB waterfall sizes the old 2-deep loop never risked.
+        import threading
+        live_lock = threading.Lock()
+        live = [0]
 
-        def _drain_body(item, depth):
-            seg, wf, det_res, offset_after, span = item
-            positive = has_signal(
-                cfg, det_res,
-                frequency_bin_count=(wf.shape[-2] if wf is not None
-                                     else None))
-            if positive:
-                self.stats.signals += 1
-                log.info("[pipeline] signal detected in segment "
-                         f"{self.stats.segments}")
-            with self._stage("sink"):
-                self._push_sinks(seg, wf, det_res, positive)
-            span["sink"] = self.stage_timer.last["sink"]
-            # file mode: sinks never retain segments (no piggybank deque),
-            # so the host buffer can go back to the pool for the reader
-            pool = getattr(self.source, "pool", None)
-            if pool is not None and cfg.input_file_path:
-                pool.release(seg.data)
-            drained[0] += 1
-            self._record_segment(drained[0] - 1, seg, det_res, positive,
-                                 span, queue_depth=depth,
-                                 n_samples=n_samples_per_seg)
-            if self.checkpoint is not None:
-                # a checkpointed segment must be durable: flush queued
-                # async candidate writes before recording it as done
-                self._drain_sinks()
-                self.checkpoint.update(drained[0], offset_after)
+        def live_count() -> int:
+            with live_lock:
+                return live[0]
 
+        def live_add(n: int) -> None:
+            with live_lock:
+                live[0] += n
+                metrics.set("inflight_depth", live[0])
+
+        def sink_f(_stop, item):
+            try:
+                self._drain_body(item, drained)
+            finally:
+                live_add(-1)
+
+        sink_pipe = None
+        if use_sink_pipe:
+            sink_pipe = fw.start_pipe(sink_f, q_sink, None, stop,
+                                      "sink_drain")
+
+        def sink_alive() -> bool:
+            return sink_pipe is None or sink_pipe.exception is None
+
+        def push_sink(item) -> bool:
+            """Bounded push to the sink pipe: blocks while the queue is
+            full (the engine's backpressure point — sinks falling
+            behind transitively stalls ingest, which a lossy source
+            surfaces as accounted loss), but bails out if the sink
+            thread crashed while the queue was full — WorkQueue.push's
+            stop-token loop cannot see a dead consumer."""
+            while not q_sink.push_lossy(item):
+                if not sink_alive() or stop.stop_requested:
+                    return False
+                time.sleep(0.002)
+            return True
+
+        def emit(fetched) -> bool:
+            if sink_pipe is None:
+                try:
+                    self._drain_body(fetched, drained)
+                finally:
+                    live_add(-1)
+                return True
+            return push_sink(fetched)
+
+        pending: collections.deque = collections.deque()
         it = iter(self.source)
-        i = 0
-        while max_segments is None or i < max_segments:
+        dispatched = [0]
+        exhausted = [False]
+
+        def want_more() -> bool:
+            return (not exhausted[0]
+                    and (max_segments is None
+                         or dispatched[0] < max_segments))
+
+        def ingest_one():
+            """One source read; returns (seg, ingest_seconds,
+            offset_after_this_segment) or None when exhausted."""
             seg = self._timed_ingest(it)
             if seg is None:
-                break
-            with self._stage("dispatch"):
-                wf, det_res = self.processor.process(seg.data)
-            span = {"ingest": self.stage_timer.last["ingest"],
-                    "dispatch": self.stage_timer.last["dispatch"]}
-            pending.append((seg, wf, det_res,
-                            getattr(self.source, "logical_offset", 0),
-                            span))
-            # keep at most 2 segments in flight (the reference's queue
-            # capacity, config.hpp:40-43): drain the oldest.  The span's
-            # queue_depth is the in-flight count AT drain time (including
-            # the item being drained) — captured before the pop, so a
-            # full queue journals as 2, not a perpetual 1
-            if len(pending) >= 2:
-                depth = len(pending)
-                drain(pending.pop(0), depth)
-            self.stats.segments += 1
-            self.stats.samples += n_samples_per_seg
-            i += 1
+                exhausted[0] = True
+                return None
+            return (seg, self.stage_timer.last["ingest"],
+                    getattr(self.source, "logical_offset", 0))
 
-        while pending:
+        # dispatch granularity: a micro-batch lands B segments at once,
+        # so admission is gated on the whole unit fitting the window —
+        # in-flight depth never exceeds inflight_segments
+        unit = batch
+
+        def fill_window() -> None:
+            while live_count() + unit <= window and want_more() \
+                    and sink_alive():
+                if batch > 1:
+                    budget = batch if max_segments is None else \
+                        min(batch, max_segments - dispatched[0])
+                    got = []
+                    while len(got) < budget:
+                        one = ingest_one()
+                        if one is None:
+                            break
+                        got.append(one)
+                    if not got:
+                        return
+                    segs, ingests, offsets = map(list, zip(*got))
+                    if len(segs) == batch:
+                        items = self._dispatch_micro_batch(
+                            segs, ingests, offsets)
+                    else:  # tail shorter than B: single-segment plan
+                        items = [self._dispatch_segment(s, dt, off)
+                                 for s, dt, off in got]
+                    pending.extend(items)
+                    live_add(len(segs))
+                    dispatched[0] += len(segs)
+                    self.stats.segments += len(segs)
+                    self.stats.samples += n_samples_per_seg * len(segs)
+                else:
+                    one = ingest_one()
+                    if one is None:
+                        return
+                    pending.append(self._dispatch_segment(*one))
+                    live_add(1)
+                    dispatched[0] += 1
+                    self.stats.segments += 1
+                    self.stats.samples += n_samples_per_seg
+
+        def drain_oldest() -> bool:
+            # journaled depths, both captured AT drain time including
+            # the item being drained (a full window journals as W, not
+            # a perpetual W-1): queue_depth = dispatched-not-yet-
+            # fetched, inflight_depth = dispatched-through-sink (the
+            # gauge's definition — fetched-but-unsunk items on the
+            # sink pipe still hold device waterfalls)
             depth = len(pending)
-            drain(pending.pop(0), depth)
+            live_now = live_count()
+            item = pending.popleft()
+            return emit(self._fetch_inflight(item, depth, live_now))
+
+        try:
+            while sink_alive():
+                fill_window()
+                if not pending:
+                    if want_more() and live_count() > 0 and sink_alive():
+                        # the whole window is parked in the sink
+                        # backlog: wait for the sink to free a slot
+                        time.sleep(0.002)
+                        continue
+                    break
+                # non-blocking drain: everything already materialized
+                # goes straight to the sink side, in order
+                while pending and sink_alive() \
+                        and self._result_ready(pending[0][2]):
+                    if not drain_oldest():
+                        break
+                if not pending:
+                    continue
+                # window too full to admit the next dispatch unit (or
+                # source done): block on the oldest — the in-order
+                # point where overlap is actually earned
+                if live_count() + unit > window or not want_more():
+                    if not drain_oldest():
+                        break
+            while pending and sink_alive():
+                if not drain_oldest():
+                    break
+        finally:
+            if sink_pipe is not None:
+                push_sink(fw.SENTINEL)
+                # unbounded: the sink may legitimately be flushing a
+                # multi-GB waterfall (same contract as _drain_sinks);
+                # a *crashed* sink thread has already exited, so this
+                # returns immediately in every failure path
+                sink_pipe.join()
+                stop.request_stop()
+            metrics.set("inflight_depth", 0)
+        if sink_pipe is not None and sink_pipe.exception is not None:
+            raise sink_pipe.exception
         self._drain_sinks()
         self.stats.elapsed_s = time.perf_counter() - start
         self.stats.extras["stages"] = self.stage_timer.summary()

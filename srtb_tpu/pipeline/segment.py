@@ -76,7 +76,8 @@ class SegmentProcessor:
 
     def __init__(self, cfg: Config, window_name: str = W.DEFAULT_WINDOW,
                  compute_chirp_on_device: bool | None = None,
-                 staged: bool | None = None):
+                 staged: bool | None = None,
+                 donate_input: bool = False):
         self.cfg = cfg
         self.fmt = formats.resolve(cfg.baseband_format_type)
         n = cfg.baseband_input_count
@@ -155,8 +156,18 @@ class SegmentProcessor:
         # XLA FFT row-length cap override (Config.fft_len_cap; None =
         # the ops/fft default), threaded through every FFT entry point
         self._len_cap = cfg.fft_len_cap or None
-        self._jit_process = jax.jit(self._process)
-        self._jit_stage_a = jax.jit(self._stage_a)
+        # Input donation (async engine): every segment's raw byte array
+        # is a fresh device_put the caller never reuses, so donating it
+        # lets XLA recycle that HBM as program scratch — steady-state
+        # streaming does no net fresh device allocation per segment.
+        # Off by default: external callers (bench.py, A/B tests) legally
+        # reuse one device-resident input across calls, which donation
+        # would invalidate.
+        self._donate_input = bool(donate_input)
+        in_donate = (0,) if self._donate_input else ()
+        self._jit_process = jax.jit(self._process, donate_argnums=in_donate)
+        self._jit_process_batch = None  # built lazily (micro-batch mode)
+        self._jit_stage_a = jax.jit(self._stage_a, donate_argnums=in_donate)
         # the staged intermediates are consumed exactly once, so stages
         # donate their inputs — without this the 4 GB boundary array of a
         # 2^30 segment stays live across the next program's entire temp
@@ -472,6 +483,13 @@ class SegmentProcessor:
         "mitigate_rfi_freq_list", "baseband_reserve_sample",
         "fft_strategy", "fft_len_cap", "use_pallas", "use_pallas_sk",
         "use_emulated_fp64",
+        # overlap-engine trace shapers: micro_batch_segments changes the
+        # traced program (vmapped batch plan) outright;
+        # inflight_segments shapes the runtime's donation/aliasing
+        # pattern around the executables — a restarted process with
+        # different overlap settings must miss the cache cleanly, not
+        # load a stale executable
+        "inflight_segments", "micro_batch_segments",
     )
 
     def plan_signature(self) -> str:
@@ -496,7 +514,8 @@ class SegmentProcessor:
             {"cfg": cfg_d, "env": knobs, "staged": self.staged,
              "interp": self._pallas_interpret,
              "window": self._window_name,
-             "has_chirp": self.chirp is not None},
+             "has_chirp": self.chirp is not None,
+             "donate_input": self._donate_input},
             sort_keys=True, default=str)
 
     def enable_aot(self, path: str, allow_cpu: bool = False) -> bool:
@@ -528,6 +547,41 @@ class SegmentProcessor:
                 "stage_c", sig, self._jit_stage_c, b_out)
         self.aot_active = True
         return True
+
+    def stage_input(self, raw) -> jnp.ndarray:
+        """Start the async host->device transfer of one segment's raw
+        bytes and return the device handle immediately (H2D staging).
+        The overlap engine calls this right after ingest, so the
+        transfer runs under the *previous* segment's device compute
+        instead of serializing into the next dispatch."""
+        expected = self.cfg.segment_bytes(self.fmt.data_stream_count)
+        if raw.shape != (expected,):
+            raise ValueError(
+                f"segment must be {expected} bytes, got {raw.shape}")
+        return jax.device_put(np.ascontiguousarray(raw, dtype=np.uint8))
+
+    def process_batch(self, raws) -> tuple[jnp.ndarray, det.DetectResult]:
+        """Micro-batch mode: run B stacked segments ``raws`` [B, bytes]
+        in ONE jit call (the fused plan vmapped over the batch axis),
+        amortizing per-dispatch host overhead and tunnel RTT over B
+        segments.  Returns ``(waterfall_ri, detect)`` with a leading
+        batch axis on every array; slice per segment with
+        ``jax.tree_util.tree_map(lambda x: x[i], ...)``."""
+        if self.staged:
+            raise ValueError(
+                "micro_batch_segments > 1 requires the fused plan "
+                "(staged segments are already dispatch-amortized)")
+        raw = jnp.asarray(raws, dtype=jnp.uint8)
+        expected = self.cfg.segment_bytes(self.fmt.data_stream_count)
+        if raw.ndim != 2 or raw.shape[1] != expected:
+            raise ValueError(
+                f"batch must be [B, {expected}] bytes, got {raw.shape}")
+        if self._jit_process_batch is None:
+            in_donate = (0,) if self._donate_input else ()
+            self._jit_process_batch = jax.jit(
+                jax.vmap(self._process, in_axes=(0, None)),
+                donate_argnums=in_donate)
+        return self._jit_process_batch(raw, self.chirp)
 
     def process(self, raw) -> tuple[jnp.ndarray, det.DetectResult]:
         """Run one segment. ``raw`` is the uint8 byte array of the segment

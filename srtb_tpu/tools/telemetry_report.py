@@ -9,7 +9,13 @@ pipeline writes (one record per segment) and reports
   histograms, so it doubles as their ground truth);
 - a throughput timeline (segments/s, Msamples/s, detections, loss
   deltas per time bin) — the "profile per-stage, then attack the
-  dominant pass" loop of PERF.md, runnable on any past observation.
+  dominant pass" loop of PERF.md, runnable on any past observation;
+- overlap efficiency of the async engine (schema-v2 spans): how much
+  host/transfer time hid under device compute vs how much device wait
+  blocked the drain loop, plus in-flight depth statistics.
+
+Mixed v1/v2 journals (rotation can leave a v1 tail after an upgrade)
+are summarized tolerantly: records simply lack the newer fields.
 
 Usage: python -m srtb_tpu.tools.telemetry_report JOURNAL.jsonl
            [--bin SECONDS] [--format json|md]
@@ -69,7 +75,12 @@ def _percentile(sorted_vals: list[float], q: float) -> float:
 def stage_stats(records: list[dict]) -> dict:
     """stage -> {count, mean_ms, p50_ms, p95_ms, p99_ms, max_ms,
     total_s}, plus a synthetic 'segment' stage (sum over stages of each
-    record: the per-segment host wall clock)."""
+    record: the per-segment host wall clock) and — for v2 records — an
+    'overlap' pseudo-stage from ``overlap_hidden_ms``.  Overlap is
+    concurrent with the staged wall clock, so it is *excluded* from the
+    'segment' sum.  Fields are read tolerantly: a mixed v1/v2 journal
+    (rotation can leave a v1 tail after an upgrade) must summarize, not
+    KeyError."""
     samples: dict[str, list[float]] = {}
     for rec in records:
         stages = rec.get("stages_ms") or {}
@@ -78,6 +89,9 @@ def stage_stats(records: list[dict]) -> dict:
         if stages:
             samples.setdefault("segment", []).append(
                 float(sum(stages.values())))
+        hidden = rec.get("overlap_hidden_ms")
+        if hidden is not None:
+            samples.setdefault("overlap", []).append(float(hidden))
     out = {}
     for name, vals in sorted(samples.items()):
         vals.sort()
@@ -127,7 +141,17 @@ def timeline(records: list[dict], bin_s: float = 10.0) -> list[dict]:
     # landing just past a bin boundary then reports ~the true rate
     # instead of an n/epsilon spike
     mean_gap = span / (len(recs) - 1) if len(recs) > 1 else bin_s
-    for b in sorted(bins):
+    for b in range(last_b + 1):
+        if b not in bins:
+            # a stalled pipeline writes no records: the stall must show
+            # as explicit 0-seg/s rows, not as silently missing bins
+            out.append({"t_start_s": round(b * bin_s, 3), "segments": 0,
+                        "samples": 0, "detections": 0, "dumps": 0,
+                        "packets_lost_delta": 0,
+                        "packets_total_delta": 0,
+                        "segments_per_sec": 0.0,
+                        "msamples_per_sec": 0.0})
+            continue
         cur = bins[b]
         # the final bin is usually partial: divide by the time actually
         # covered, not the full width, or a steady pipeline shows a
@@ -140,12 +164,51 @@ def timeline(records: list[dict], bin_s: float = 10.0) -> list[dict]:
     return out
 
 
+def overlap_stats(records: list[dict]) -> dict:
+    """Overlap efficiency of the async engine from v2 spans:
+    ``overlap_hidden_ms`` is host/transfer time that ran under device
+    compute, the blocking ``fetch`` stage is device wait that was NOT
+    hidden — ``efficiency = hidden / (hidden + blocked fetch)`` (1.0 =
+    the engine hid every device wait).  Caveat: hidden time is an
+    upper bound (it includes host gap after the device finished), so
+    on a source/sink-bound pipeline efficiency reads ~1.0 while the
+    device idles — check the ingest/sink stage shares alongside it.
+    v1 records (no overlap fields) are skipped; empty dict when none
+    qualify."""
+    hidden, fetch, depths = [], [], []
+    for r in records:
+        h = r.get("overlap_hidden_ms")
+        if h is None:
+            continue
+        hidden.append(float(h))
+        fetch.append(float((r.get("stages_ms") or {}).get("fetch", 0.0)))
+        d = r.get("inflight_depth")
+        if d is not None:
+            depths.append(int(d))
+    if not hidden:
+        return {}
+    tot_h, tot_f = sum(hidden), sum(fetch)
+    out = {
+        "records": len(hidden),
+        "hidden_total_s": round(tot_h / 1e3, 3),
+        "hidden_mean_ms": round(tot_h / len(hidden), 3),
+        "blocked_fetch_total_s": round(tot_f / 1e3, 3),
+        "efficiency": (round(tot_h / (tot_h + tot_f), 4)
+                       if tot_h + tot_f > 0 else 0.0),
+    }
+    if depths:
+        out["inflight_depth_mean"] = round(sum(depths) / len(depths), 2)
+        out["inflight_depth_max"] = max(depths)
+    return out
+
+
 def report(path: str, bin_s: float = 10.0) -> dict:
     records = load(path)
     return {
         "journal": path,
         "records": len(records),
         "stages": stage_stats(records),
+        "overlap": overlap_stats(records),
         "timeline": timeline(records, bin_s),
     }
 
@@ -161,6 +224,17 @@ def _md(rep: dict) -> str:
             f"| {name} | {s['count']} | {s['mean_ms']} | {s['p50_ms']} |"
             f" {s['p95_ms']} | {s['p99_ms']} | {s['max_ms']} |"
             f" {s['total_s']} |")
+    ov = rep.get("overlap") or {}
+    if ov:
+        lines += ["", "## Overlap (async engine)", "",
+                  f"hidden under device compute: {ov['hidden_total_s']} s"
+                  f" total ({ov['hidden_mean_ms']} ms/segment mean), "
+                  f"blocked fetch: {ov['blocked_fetch_total_s']} s, "
+                  f"efficiency: {ov['efficiency']}"]
+        if "inflight_depth_mean" in ov:
+            lines.append(
+                f"in-flight depth: mean {ov['inflight_depth_mean']}, "
+                f"max {ov['inflight_depth_max']}")
     lines += ["", "## Throughput timeline", "",
               "| t (s) | segments | seg/s | Msamples/s | detections | "
               "dumps | pkts lost |", "|---|---|---|---|---|---|---|"]

@@ -14,6 +14,12 @@ Safety model:
 - Blobs are keyed by SHA-256 of (jax version, backend platform, device
   kind, program name, plan signature) — a changed config, JAX upgrade,
   or different accelerator generation misses cleanly and recompiles.
+  The plan signature (SegmentProcessor.plan_signature) allowlists every
+  trace-shaping config field, including the overlap engine's knobs
+  (``inflight_segments``, ``micro_batch_segments``) and the input
+  donation flag: a restarted process with different overlap settings
+  can never load a stale executable whose donation/aliasing or batch
+  shape no longer matches.
 - CPU backends are OFF by default, same policy and same reason as
   compile_cache.enable_compile_cache: XLA:CPU AOT machine code is keyed
   without host CPU features, and a stale entry after a host swap can
@@ -85,10 +91,15 @@ class AotPlanCache:
             # single-device programs, and the default (all local
             # devices) makes the loaded executable demand one shard
             # per device on multi-device hosts (e.g. the forced
-            # 8-device CPU test platform)
-            compiled = deserialize_and_load(
-                blob, in_tree, out_tree,
-                execution_devices=[jax.devices()[0]])
+            # 8-device CPU test platform).  Older jax releases do not
+            # take the kwarg — fall back to the default placement
+            # (single-device hosts are unaffected).
+            try:
+                compiled = deserialize_and_load(
+                    blob, in_tree, out_tree,
+                    execution_devices=[jax.devices()[0]])
+            except TypeError:
+                compiled = deserialize_and_load(blob, in_tree, out_tree)
             log.info(f"[aot_cache] loaded {name} from {path}")
             return compiled
         except Exception as e:  # corrupt blob / jax drift: recompile

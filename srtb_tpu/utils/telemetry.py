@@ -24,7 +24,12 @@ import time
 from srtb_tpu.utils.logging import log
 from srtb_tpu.utils.metrics import metrics
 
-SPAN_SCHEMA_VERSION = 1
+# v2 (async overlap engine): adds ``overlap_hidden_ms`` (host/transfer
+# time hidden under device compute for this segment) and
+# ``inflight_depth`` (dispatched-not-yet-drained segments at drain
+# time).  Readers must tolerate a mixed v1/v2 journal: rotation can
+# leave a v1 tail in ``<path>.1`` after an upgrade.
+SPAN_SCHEMA_VERSION = 2
 
 # gauge names shared between the pipeline (writer) and health() (reader)
 LAST_SEGMENT_MONOTONIC = "last_segment_monotonic"
@@ -94,11 +99,30 @@ class SpanJournal:
 
 def segment_span(segment: int, stages_s: dict, queue_depth: int,
                  detections: int, dump: bool, samples: int,
-                 timestamp_ns: int = 0, extra: dict | None = None) -> dict:
+                 timestamp_ns: int = 0, extra: dict | None = None,
+                 overlap_hidden_s: float | None = None,
+                 inflight_depth: int | None = None) -> dict:
     """One journal record.  ``stages_s`` maps stage name -> seconds for
     THIS segment; loss/drop counters are the cumulative registry values
     at drain time (deltas between consecutive records localize a loss
-    burst to a segment)."""
+    burst to a segment).
+
+    v2 fields: ``overlap_hidden_ms`` is the wall clock between this
+    segment's dispatch returning and its fetch starting — host work
+    (ingest/dispatch of later segments, sink of earlier ones) that ran
+    while the device computed this segment, i.e. latency the async
+    engine hid.  It is an UPPER bound on hidden device time: the host
+    gap also covers time after the device already finished, so on a
+    source- or sink-bound pipeline (device mostly idle) it reads high
+    — interpret it together with the ingest/sink stage shares.  It is
+    NOT part of ``stages_ms`` (concurrent with, not additional to, the
+    staged wall clock).  Both v2 fields are OMITTED when the caller did
+    not measure them (``None``) — a pipeline that overlaps but does not
+    measure (ThreadedPipeline) must not journal a fake 0, which would
+    read as "measured, nothing hidden".  ``inflight_depth`` counts
+    dispatched-but-not-fully-drained segments (through sink completion,
+    matching the ``srtb_inflight_depth`` gauge) at this segment's
+    drain."""
     rec = {
         "type": "segment_span",
         "v": SPAN_SCHEMA_VERSION,
@@ -114,6 +138,11 @@ def segment_span(segment: int, stages_s: dict, queue_depth: int,
         "packets_lost": metrics.get("packets_lost"),
         "segments_dropped": metrics.get("segments_dropped"),
     }
+    if overlap_hidden_s is not None:
+        rec["overlap_hidden_ms"] = round(
+            max(overlap_hidden_s, 0.0) * 1e3, 3)
+    if inflight_depth is not None:
+        rec["inflight_depth"] = int(inflight_depth)
     if extra:
         rec.update(extra)
     return rec

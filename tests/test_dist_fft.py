@@ -43,6 +43,7 @@ def test_dist_rfft(seq_mesh8, log2n):
                                rtol=1e-3, atol=3e-2 * np.sqrt(n))
 
 
+@pytest.mark.slow  # 2^24 on the CPU mesh: ~10-15 s each
 def test_dist_fft_large_n_twiddle_precision(seq_mesh8):
     """At n >= 2^24 a twiddle phase computed as a plain f32 ratio product
     loses enough mantissa to corrupt whole bins; the hi/lo integer-split
@@ -61,6 +62,7 @@ def test_dist_fft_large_n_twiddle_precision(seq_mesh8):
     assert rel_rms < 5e-6, f"rel RMS {rel_rms:.2e}"
 
 
+@pytest.mark.slow  # 2^24 on the CPU mesh: ~10-15 s each
 def test_dist_rfft_large_n_twiddle_precision(seq_mesh8):
     """Same large-n precision discipline for the Hermitian post-process
     twiddle exp(-i*pi*k/m) of the distributed R2C."""
@@ -84,6 +86,7 @@ def test_dist_fft_output_sharding(seq_mesh8):
     assert len(out.sharding.device_set) == 8
 
 
+@pytest.mark.slow  # 2^24 on the CPU mesh: ~10-15 s each
 def test_dist_fft_pallas_legs(seq_mesh8):
     """Pallas VMEM leg FFTs under the a2a transposes (rows_impl knob):
     local legs at n = 2^24 are [2048, 4096]-shaped — inside the row
@@ -100,6 +103,7 @@ def test_dist_fft_pallas_legs(seq_mesh8):
     assert np.abs(got - want).max() / scale < 2e-5
 
 
+@pytest.mark.slow  # 2^24 on the CPU mesh: ~10-15 s each
 def test_dist_rfft_pallas_legs_matches_xla_legs(seq_mesh8):
     """The full distributed R2C (pack + dist C2C + Hermitian mirror)
     must be leg-implementation-independent."""

@@ -71,7 +71,8 @@ _WORKER = textwrap.dedent("""
 
     # cross-process collective over the full mesh: global psum of a
     # (dm, seq)-sharded array
-    f = jax.jit(jax.shard_map(
+    from srtb_tpu.parallel.compat import shard_map
+    f = jax.jit(shard_map(
         lambda x: jax.lax.psum(jax.lax.psum(x, "seq"), "dm"),
         mesh=mesh, in_specs=P("dm", "seq"), out_specs=P()))
     n_dm, n_seq = mesh.devices.shape
@@ -141,6 +142,14 @@ _WORKER = textwrap.dedent("""
 
 
 def test_two_process_group_collectives(tmp_path):
+    import jax
+    if jax.__version_info__ < (0, 5):
+        # jaxlib 0.4.x's CPU client rejects cross-process computations
+        # outright ("Multiprocess computations aren't implemented on
+        # the CPU backend"); the gloo-backed CPU collectives this test
+        # exercises exist only on newer runtimes
+        pytest.skip("cross-process CPU collectives unsupported by this "
+                    "jaxlib")
     script = tmp_path / "worker.py"
     script.write_text(_WORKER)
     env = dict(os.environ)

@@ -165,6 +165,14 @@ PYEOF
       case "$line" in {*) echo "{\"ts\": \"$(stamp)\", \"variant\": \"$v\", \"result\": $line}" >> "$OUT"; echo "$line";; esac
     done
 
+# ---- 1e. overlap A/B at the bench default (async dispatch window vs
+#           a blocking host sync per segment — measures how much host
+#           time + tunnel RTT the in-flight engine hides) ----
+run overlap_on_27  env SRTB_BENCH_LOG2N=27 SRTB_BENCH_REPS=5 \
+    python bench.py --overlap on
+run overlap_off_27 env SRTB_BENCH_LOG2N=27 SRTB_BENCH_REPS=5 \
+    python bench.py --overlap off
+
 # ---- 2. per-kernel rows incl. the anchored-vs-exact chirp A/B ----
 echo "== kernel bench (anchored chirp A/B) =="
 python -m srtb_tpu.tools.kernel_bench --log2n 28 --reps 5 2>/dev/null \
@@ -248,6 +256,14 @@ rc=$?
 line=$(grep '^{' /tmp/staged_blocked_pallas2.json 2>/dev/null | tail -1)
 echo "{\"ts\": \"$(stamp)\", \"variant\": \"staged_blocked_pallas2_probe\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$OUT"
 
+# overlap A/B at the 2^30 production segment (staged plan): the serial
+# leg pays the host sync against a 2.7 s device segment — small relative
+# win expected here, but the off row anchors the model
+run overlap_on_30  env SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 \
+    SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=1200 python bench.py --overlap on
+run overlap_off_30 env SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 \
+    SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=1200 python bench.py --overlap off
+
 # ---- 4. live UDP -> TPU end-to-end, 60 s at 2x wire rate (VERDICT #6),
 #         two receivers = the reference's per-polarization deployment ----
 python -m srtb_tpu.tools.e2e_live --seconds 60 --rate_x 2.0 --log2n 27 \
@@ -317,3 +333,7 @@ fi
 #     closes the warm-restart gap even with the compile cache bypassed;
 #     document the measured warm numbers in PERF.md and recommend
 #     aot_plan_path in the production config.
+# overlap_on_27 / overlap_off_27 -> the measured per-segment host-sync
+#     cost (~60 ms RTT model, PERF.md); if on/off >= 1.1x the async
+#     engine's default inflight_segments=2 stands confirmed, and
+#     overlap_off_30 anchors the same model at the staged 2^30 plan.
