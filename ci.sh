@@ -24,13 +24,13 @@ if [ "${1:-}" = "--hardware" ]; then
   exit 0
 fi
 
-echo "== [1/7] native build =="
+echo "== [1/8] native build =="
 make -C srtb_tpu/native
 
-echo "== [2/7] native sanitizer harness (ASan/UBSan) =="
+echo "== [2/8] native sanitizer harness (ASan/UBSan) =="
 make -C srtb_tpu/native check
 
-echo "== [3/7] static checks (compile + import) =="
+echo "== [3/8] static checks (compile + import) =="
 python -m compileall -q srtb_tpu tests bench.py __graft_entry__.py
 python - <<'EOF'
 import importlib, pkgutil
@@ -45,7 +45,12 @@ assert not bad, bad
 print(f"all srtb_tpu modules import cleanly")
 EOF
 
-echo "== [4/7] pytest (8-device CPU mesh) =="
+echo "== [4/8] srtb-lint (static analysis vs baseline) =="
+# fails on findings not in srtb_tpu/analysis/baseline.json; accept an
+# intentional finding with --write-baseline + a note, or a pragma
+JAX_PLATFORMS=cpu python -m srtb_tpu.tools.lint srtb_tpu/
+
+echo "== [5/8] pytest (8-device CPU mesh) =="
 FAST_ARGS=()
 if [ "${1:-}" = "--fast" ]; then
   # one source of truth for what "slow" means: the pytest marker
@@ -54,10 +59,10 @@ if [ "${1:-}" = "--fast" ]; then
 fi
 python -m pytest tests/ -q "${FAST_ARGS[@]}"
 
-echo "== [5/7] bench smoke =="
+echo "== [6/8] bench smoke =="
 JAX_PLATFORMS=cpu SRTB_BENCH_LOG2N=16 python bench.py | tail -1
 
-echo "== [6/7] telemetry smoke (journal + report + /metrics + /healthz) =="
+echo "== [7/8] telemetry + sanitizer smoke (journal + report + /metrics + /healthz + Config.sanitize) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import json, os, tempfile, urllib.request
 
@@ -114,9 +119,24 @@ finally:
 print(f"telemetry smoke OK: {stats.segments} segments, "
       f"{len(recs)} v2 spans, overlap stage live, "
       "/metrics + /healthz live")
+
+# one short pipeline with the runtime sanitizer armed: transfer
+# tripwire + NaN tripwires + thread checks all live on a real run
+import numpy as np
+cfg_s = cfg.replace(sanitize=True, inflight_segments=2,
+                    telemetry_journal_path="",
+                    baseband_output_file_prefix=os.path.join(
+                        tmp, "san_"))
+with Pipeline(cfg_s, sinks=[]) as pipe:
+    stats_s = pipe.run()
+assert stats_s.segments == stats.segments, (stats_s, stats)
+assert not hasattr(np.asarray, "_srtb_sanitize_orig"), \
+    "sanitizer tripwire not restored"
+print(f"sanitizer smoke OK: {stats_s.segments} segments with "
+      "Config.sanitize on, tripwire restored")
 EOF
 
-echo "== [7/7] multichip dryrun (8 virtual devices) =="
+echo "== [8/8] multichip dryrun (8 virtual devices) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

@@ -1,0 +1,27 @@
+"""srtb-lint: project-specific static analysis + runtime sanitizer.
+
+The async in-flight engine (pipeline/runtime.py) lives or dies on
+properties pytest cannot see: no hidden host syncs inside the dispatch
+window, no reads of donated buffers, no per-call jit re-tracing, no f64
+drift into the df64 device chain, no cross-thread mutation of engine
+state without a lock.  This package checks those mechanically:
+
+- :mod:`srtb_tpu.analysis.lint` — an AST linter over the package source
+  (no imports of the scanned code), one rule module per hazard class
+  under :mod:`srtb_tpu.analysis.rules`.  Run it with
+  ``python -m srtb_tpu.tools.lint srtb_tpu/``.
+- :mod:`srtb_tpu.analysis.sanitizer` — an opt-in runtime sanitizer
+  (``Config.sanitize``) that traps implicit device-to-host transfers,
+  NaN/Inf at segment-plan boundaries, stage contract violations,
+  wrong-thread access to engine state, and leaked threads.  Zero cost
+  when disabled.
+
+Pragmas: ``# srtb-lint: disable=RULE[,RULE...]`` on the offending line
+(or the comment line directly above) suppresses a finding;
+``# srtb-lint: disable-file=RULE`` anywhere suppresses a rule for the
+whole file.  Pre-existing accepted findings live in ``baseline.json``
+next to this package; the CLI fails only on findings not in the
+baseline.
+"""
+
+from srtb_tpu.analysis.core import Finding  # noqa: F401

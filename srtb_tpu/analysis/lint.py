@@ -1,0 +1,162 @@
+"""srtb-lint driver: scan paths, run every rule, apply pragmas and the
+baseline, render findings.
+
+Usage (CI runs exactly this)::
+
+    python -m srtb_tpu.tools.lint srtb_tpu/
+
+Exit code 0 when every finding is pragma-suppressed or baselined, 1
+when new findings exist (print them), 2 on usage errors.  The baseline
+lives at ``srtb_tpu/analysis/baseline.json``; refresh it after fixing
+or accepting findings with ``--write-baseline`` (notes on existing
+entries are carried forward).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from srtb_tpu.analysis.core import Baseline, ModuleSource, Project
+from srtb_tpu.analysis.rules import ALL_RULES
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__),
+                                "baseline.json")
+
+
+def _iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in sorted(dirs)
+                           if d not in ("__pycache__", ".git")]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+        else:
+            raise FileNotFoundError(p)
+
+
+def _rel_dotted(path: str, scan_root: str) -> tuple[str, str]:
+    """Stable package-relative path + dotted module name.  Files inside
+    a package (``__init__.py`` chain) key relative to the directory
+    containing the top package ("srtb_tpu/ops/fft.py"); loose files
+    (test fixtures) key relative to the scanned root."""
+    p = os.path.abspath(path)
+    d = os.path.dirname(p)
+    root = d
+    while os.path.exists(os.path.join(root, "__init__.py")):
+        root = os.path.dirname(root)
+    if root != d:
+        rel = os.path.relpath(p, root)
+    else:
+        rel = os.path.relpath(p, scan_root)
+    dotted = rel[:-3].replace(os.sep, ".")
+    if dotted.endswith(".__init__"):
+        dotted = dotted[: -len(".__init__")]
+    return rel, dotted
+
+
+def load_modules(paths) -> list[ModuleSource]:
+    scan_root = None
+    for p in paths:
+        r = p if os.path.isdir(p) else os.path.dirname(p) or "."
+        scan_root = r if scan_root is None else os.path.commonpath(
+            [scan_root, os.path.abspath(r)])
+        scan_root = os.path.abspath(scan_root)
+    mods = []
+    for f in _iter_py_files(paths):
+        rel, dotted = _rel_dotted(f, scan_root or ".")
+        with open(f, encoding="utf-8") as fh:
+            text = fh.read()
+        try:
+            mods.append(ModuleSource(f, rel, text, dotted))
+        except SyntaxError as e:
+            raise SyntaxError(f"{f}: {e}") from e
+    return mods
+
+
+def run(paths) -> list:
+    """All pragma-filtered findings for ``paths``, sorted."""
+    mods = load_modules(paths)
+    project = Project(mods)
+    findings = []
+    for mod in mods:
+        for rule in ALL_RULES:
+            for f in rule.check(project, mod):
+                if not mod.disabled(f.line, f.rule):
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.rel, f.line, f.col, f.rule))
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="srtb-lint",
+        description="static analysis for JAX hot-path hazards "
+                    "(see srtb_tpu/analysis/)")
+    ap.add_argument("paths", nargs="*", default=["srtb_tpu"])
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON (default: the checked-in one)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, baseline ignored")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept current findings into --baseline "
+                         "(existing notes are kept)")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    ap.add_argument("--verbose", "-v", action="store_true",
+                    help="also show baselined findings and stale "
+                         "baseline entries")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.RULE}: {rule.DOC}")
+        return 0
+
+    try:
+        findings = run(args.paths)
+    except (FileNotFoundError, SyntaxError) as e:
+        print(f"srtb-lint: {e}", file=sys.stderr)
+        return 2
+
+    baseline = (Baseline() if args.no_baseline
+                else Baseline.load(args.baseline))
+    if args.write_baseline:
+        old = Baseline.load(args.baseline)
+        Baseline.from_findings(findings, old=old).save(args.baseline)
+        print(f"srtb-lint: wrote {len(findings)} finding(s) to "
+              f"{args.baseline}")
+        return 0
+    new, accepted, stale = baseline.filter(findings)
+
+    if args.format == "json":
+        print(json.dumps({
+            "new": [vars(f) for f in new],
+            "accepted": [vars(f) for f in accepted],
+            "stale_baseline_keys": stale,
+        }, indent=2, default=str))
+    else:
+        for f in new:
+            print(f.render())
+        if args.verbose:
+            for f in accepted:
+                print(f"{f.render()}  [baselined]")
+            for k in stale:
+                print(f"stale baseline entry (no longer fires): {k}")
+        summary = (f"srtb-lint: {len(new)} new, {len(accepted)} "
+                   f"baselined, {len(stale)} stale baseline entr"
+                   f"{'y' if len(stale) == 1 else 'ies'} "
+                   f"({len(findings)} total)")
+        print(summary, file=sys.stderr if new else sys.stdout)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

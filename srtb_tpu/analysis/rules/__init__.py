@@ -1,0 +1,13 @@
+"""srtb-lint rule registry: one module per hazard class.
+
+Each rule module exposes ``RULE`` (the id used in findings, pragmas and
+the baseline), ``DOC`` (one line for ``--list-rules``) and
+``check(project, module) -> iterator of Finding``.
+"""
+
+from srtb_tpu.analysis.rules import (donate, dtype_drift, host_sync,
+                                     recompile, shared_state)
+
+ALL_RULES = (host_sync, donate, recompile, dtype_drift, shared_state)
+
+RULE_IDS = tuple(r.RULE for r in ALL_RULES)

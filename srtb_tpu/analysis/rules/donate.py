@@ -1,0 +1,158 @@
+"""use-after-donate: reading a buffer after it was passed to a donated
+jit argument.
+
+The engine donates every segment's input buffer (SegmentProcessor
+``donate_input``) so XLA can recycle its HBM as program scratch.  On an
+accelerator that makes the buffer *invalid the moment the call is
+dispatched* — a later read returns garbage or raises, and CPU CI never
+notices because CPU donation is a no-op.  This rule tracks, per
+function, variables passed at a donated position and flags any
+subsequent read (branch-aware: a read in a sibling ``else`` branch is
+not "after"; a read earlier in the same loop body is — the donation
+invalidates the buffer for the *next* iteration).
+
+Donating callees are found two ways: wrappers assigned from
+``jax.jit(..., donate_argnums=...)`` in the scanned tree (a non-literal
+``donate_argnums`` counts as donating position 0), plus the known
+donating API of this codebase (``DONATING_API``) whose donation is
+conditional on construction flags and therefore invisible at the call
+site.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from srtb_tpu.analysis.core import Finding, ModuleSource, Project
+
+RULE = "use-after-donate"
+DOC = "read of a buffer after it was passed to a donated jit argument"
+
+# method name -> donated positional args (0-based, self excluded).
+# SegmentProcessor.run_device / process_batch donate their input when
+# constructed with donate_input=True — the call site can't see that.
+DONATING_API = {"run_device": {0}, "process_batch": {0}}
+
+
+def _stmt_paths(fnode):
+    """Map id(stmt) -> path of (block-id, index, block-is-loop-body)
+    tuples, giving a branch-aware 'executes after' partial order."""
+    paths: dict[int, tuple] = {}
+
+    def walk(stmts, prefix, is_loop):
+        for i, s in enumerate(stmts):
+            p = prefix + ((id(stmts), i, is_loop),)
+            paths[id(s)] = p
+            for _name, blk in ast.iter_fields(s):
+                if isinstance(blk, list) and blk \
+                        and isinstance(blk[0], ast.stmt):
+                    walk(blk, p, isinstance(s, (ast.For, ast.While)))
+    walk(fnode.body, (), False)
+    return paths
+
+
+def _order(dp, lp):
+    """'after' | 'loop' (same loop body, lexically before — next
+    iteration reads a donated buffer) | None."""
+    for k in range(min(len(dp), len(lp))):
+        db, di, dloop = dp[k]
+        lb, li, _ = lp[k]
+        if db != lb:
+            return None  # diverged into sibling branches
+        if di != li:
+            if li > di:
+                return "after"
+            return "loop" if dloop else None
+    return None  # nested within the same statement
+
+
+def _donating_positions(project: Project, mod: ModuleSource, caller,
+                        call: ast.Call):
+    func = call.func
+    # self._jit_x / module-level wrapper assigned from jax.jit(...)
+    name = cls = None
+    if isinstance(func, ast.Attribute) and isinstance(
+            func.value, ast.Name) and func.value.id == "self":
+        name, cls = func.attr, caller.class_name
+    elif isinstance(func, ast.Name):
+        name, cls = func.id, None
+    if name is not None:
+        hit = project.jit_wrappers.get((mod.dotted, cls, name))
+        if hit is not None:
+            donated = hit[1]
+            if donated == "dynamic":
+                return {0}
+            if donated:
+                return set(donated)
+    if isinstance(func, ast.Attribute) and func.attr in DONATING_API:
+        return DONATING_API[func.attr]
+    return None
+
+
+def _enclosing_stmt(paths, node, fnode):
+    """Innermost statement (known to paths) containing node."""
+    best = None
+    for stmt in ast.walk(fnode):
+        if id(stmt) in paths and hasattr(stmt, "lineno"):
+            end = getattr(stmt, "end_lineno", stmt.lineno)
+            if stmt.lineno <= node.lineno <= end:
+                if best is None or stmt.lineno >= best.lineno:
+                    best = stmt
+    return best
+
+
+def check(project: Project, mod: ModuleSource):
+    for info in mod.functions.values():
+        fnode = info.node
+        paths = None
+        donations = []   # (stmt, call, varname)
+        for node in info.body_nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            pos = _donating_positions(project, mod, info, node)
+            if not pos:
+                continue
+            if paths is None:
+                paths = _stmt_paths(fnode)
+            stmt = _enclosing_stmt(paths, node, fnode)
+            if stmt is None or isinstance(stmt, ast.Return):
+                continue  # a donation in `return f(x)` has no 'after'
+            for p in sorted(pos):
+                if p < len(node.args) and isinstance(
+                        node.args[p], ast.Name):
+                    donations.append((stmt, node, node.args[p].id))
+        if not donations:
+            continue
+        loads, stores = [], []
+        for node in info.body_nodes():
+            if isinstance(node, ast.Name):
+                (loads if isinstance(node.ctx, ast.Load)
+                 else stores).append(node)
+        for dstmt, dcall, var in donations:
+            dp = paths[id(dstmt)]
+            killed_lines = [s.lineno for s in stores if s.id == var]
+            for ld in loads:
+                if ld.id != var:
+                    continue
+                lstmt = _enclosing_stmt(paths, ld, fnode)
+                if lstmt is None or lstmt is dstmt:
+                    continue
+                rel = _order(dp, paths[id(lstmt)])
+                if rel is None:
+                    continue
+                if rel == "after" and any(
+                        dcall.lineno <= k <= ld.lineno
+                        for k in killed_lines):
+                    continue  # reassigned between donation and read
+                if rel == "loop" and killed_lines:
+                    continue  # refreshed somewhere in the loop
+                how = ("read after donation" if rel == "after" else
+                       "read on the next loop iteration after donation")
+                yield Finding(
+                    RULE, mod.path, mod.rel, ld.lineno, ld.col_offset,
+                    f"'{var}' {how} to "
+                    f"'{ast.unparse(dcall.func)}' (line "
+                    f"{dcall.lineno}) — the buffer is invalid on "
+                    "accelerators once the donated call is dispatched",
+                    info.qualname, mod.line_text(ld.lineno))
+                break  # one finding per donation is enough

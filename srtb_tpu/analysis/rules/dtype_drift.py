@@ -1,0 +1,98 @@
+"""dtype-drift: 64-bit float/complex entering device code.
+
+TPUs have no f64 ALU: the chirp's precision comes from the hand-built
+df64 (two-float) path in ops/df64.py, and JAX silently truncates f64 to
+f32 unless x64 mode is enabled (which this codebase never does —
+enabling it globally would *change the numerics of every op*).  A
+``jnp.float64`` or an f64 dtype inside a jit-traced function therefore
+either truncates silently or diverges between CPU CI and TPU — the
+exact drift class that corrupted the chirp precision the df64 chain
+exists to protect (SURVEY.md §3.2).
+
+Flagged:
+- ``jnp.float64`` / ``jnp.complex128`` anywhere in ops/parallel code;
+- numpy f64/c128 dtype references *inside jit-traced functions* (host
+  f64 precompute outside traces — window tables, twiddles — is the
+  sanctioned pattern and stays clean);
+- string dtypes ``"float64"`` / ``"complex128"`` inside jit bodies;
+- ``jax.config.update("jax_enable_x64", ...)`` in library code.
+
+Intentional trace-time host-constant folding (e.g. the hi/lo splits in
+ops/dedisperse.py computing ``np.float64(dm) - np.float32(dm)`` on
+*Python scalars*) belongs in the baseline with a note, keeping the rule
+hot for genuine drift.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from srtb_tpu.analysis.core import Finding, ModuleSource, Project
+
+RULE = "dtype-drift"
+DOC = "f64/c128 dtype reaching device code (breaks TPU df64 paths)"
+
+_JNP_64 = {"jax.numpy.float64", "jax.numpy.complex128",
+           "jax.numpy.float128"}
+_NP_64 = {"numpy.float64", "numpy.complex128", "numpy.float128",
+          "numpy.longdouble"}
+_STR_64 = {"float64", "complex128", "float128"}
+
+# device-code directories (rel-path fragments)
+_DEVICE_DIRS = ("ops/", "parallel/", "pipeline/")
+
+
+def _is_device_module(mod: ModuleSource) -> bool:
+    return any(d in mod.rel for d in _DEVICE_DIRS)
+
+
+def _f(mod, node, msg, qual):
+    return Finding(RULE, mod.path, mod.rel, node.lineno,
+                   node.col_offset, msg, qual,
+                   mod.line_text(node.lineno))
+
+
+def check(project: Project, mod: ModuleSource):
+    jit_here = {info for info in project.jit_bodies
+                if info.module is mod}
+
+    def in_jit(node):
+        info = mod.enclosing_function(node)
+        return info if info in jit_here else None
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            dotted = mod.dotted_name(node.func)
+            if dotted == "jax.config.update" and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and node.args[0].value == "jax_enable_x64":
+                info = mod.enclosing_function(node)
+                yield _f(mod, node,
+                         "jax_enable_x64 toggled in library code — "
+                         "changes the numerics of every op globally; "
+                         "use the df64 two-float path instead",
+                         info.qualname if info else "<module>")
+        if isinstance(node, ast.Attribute):
+            dotted = mod.dotted_name(node)
+            if dotted in _JNP_64 and _is_device_module(mod):
+                info = mod.enclosing_function(node)
+                yield _f(mod, node,
+                         f"{dotted.replace('jax.numpy', 'jnp')} in "
+                         "device code — TPUs truncate to f32 without "
+                         "x64 mode; use the ops/df64 two-float path",
+                         info.qualname if info else "<module>")
+            elif dotted in _NP_64:
+                info = in_jit(node)
+                if info is not None:
+                    yield _f(mod, node,
+                             f"np.{node.attr} inside jit-traced "
+                             f"'{info.name}' — f64 host constants "
+                             "fold into an f32 trace (silent "
+                             "truncation on TPU)", info.qualname)
+        if isinstance(node, ast.Constant) and node.value in _STR_64:
+            info = in_jit(node)
+            if info is not None:
+                yield _f(mod, node,
+                         f'dtype string "{node.value}" inside '
+                         f"jit-traced '{info.name}' — silently "
+                         "truncates to f32 on TPU", info.qualname)

@@ -1,0 +1,116 @@
+"""sync-hot-path: host synchronization reachable from the dispatch
+window or inside a jit-traced body.
+
+The async engine's overlap win exists only while the dispatch side
+(ingest -> H2D staging -> program enqueue) never blocks on the device:
+one ``np.asarray`` / ``.item()`` / ``.block_until_ready()`` on that
+path serializes the stream exactly like the hidden syncs that erased
+AstroAccelerate's CUDA-stream overlap (arXiv:2101.00941).  Inside a
+jit body the same calls either break tracing or silently force a
+host round trip per call.
+
+Hot zones:
+- the dispatch-window functions of pipeline/runtime.py and the device
+  entry points of pipeline/segment.py (``HOT_ROOTS``), plus everything
+  reachable from them through the project call graph;
+- every function reachable from a ``jax.jit`` root anywhere in the
+  scanned tree.
+
+The sanctioned sync points (the fetch/drain side, sinks) are *not*
+rooted here, so an explicit ``jax.device_get`` in a drain function is
+clean while the same call inside ``fill_window`` is a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from srtb_tpu.analysis.core import Finding, ModuleSource, Project
+
+RULE = "sync-hot-path"
+DOC = ("host sync (np.asarray/.item()/block_until_ready/device_get) "
+       "reachable from the dispatch window or a jit body")
+
+# dispatch-window roots: (rel-path suffix, function names)
+HOT_ROOTS = (
+    ("pipeline/runtime.py", {
+        "_dispatch_segment", "_dispatch_micro_batch", "_result_ready",
+        "_timed_ingest", "fill_window", "ingest_one"}),
+    ("pipeline/segment.py", {"stage_input", "run_device"}),
+)
+
+_SYNC_FUNCS = {
+    "numpy.asarray": "np.asarray forces a device->host transfer",
+    "numpy.array": "np.array forces a device->host copy",
+    "jax.device_get": "device_get blocks on device completion",
+    "jax.block_until_ready": "block_until_ready stalls dispatch",
+}
+_SYNC_METHODS = {
+    "item": ".item() is a blocking device->host scalar fetch",
+    "block_until_ready": ".block_until_ready() stalls dispatch",
+    "tolist": ".tolist() is a blocking device->host fetch",
+}
+
+
+def _hot_sets(project: Project):
+    """(dispatch-window closure, jit-body closure), memoized on the
+    project (rules run once per module)."""
+    cached = getattr(project, "_sync_hot_cache", None)
+    if cached is not None:
+        return cached
+    roots = set()
+    for mod in project.modules:
+        for suffix, names in HOT_ROOTS:
+            if mod.rel.endswith(suffix):
+                roots.update(info for info in mod.functions.values()
+                             if info.name in names)
+    dispatch = project.reachable(roots)
+    cached = (dispatch, project.jit_bodies)
+    project._sync_hot_cache = cached
+    return cached
+
+
+def _scan(info, mod: ModuleSource, zone: str):
+    for node in info.body_nodes():
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = mod.dotted_name(node.func)
+        msg = _SYNC_FUNCS.get(dotted or "")
+        if msg is None and isinstance(node.func, ast.Attribute) \
+                and not node.args and node.func.attr in _SYNC_METHODS:
+            msg = _SYNC_METHODS[node.func.attr]
+        if msg is None and zone == "jit body" \
+                and isinstance(node.func, ast.Name) \
+                and node.func.id in ("float", "int", "bool") \
+                and len(node.args) == 1 \
+                and isinstance(node.args[0], ast.Name) \
+                and node.args[0].id in _params(info.node):
+            msg = (f"{node.func.id}() on a traced argument forces "
+                   "concretization (host sync or trace error)")
+        if msg is not None:
+            yield Finding(
+                RULE, mod.path, mod.rel, node.lineno, node.col_offset,
+                f"{msg} — keep host syncs off the {zone} "
+                "(move to the drain/sink side or use async staging)",
+                info.qualname, mod.line_text(node.lineno))
+
+
+def _params(fnode) -> set[str]:
+    a = fnode.args
+    return {p.arg for p in
+            list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)}
+
+
+def check(project: Project, mod: ModuleSource):
+    dispatch, jit_bodies = _hot_sets(project)
+    seen = set()
+    for info in dispatch:
+        if info.module is mod:
+            for f in _scan(info, mod, "dispatch window"):
+                seen.add((f.line, f.col))
+                yield f
+    for info in jit_bodies:
+        if info.module is mod:
+            for f in _scan(info, mod, "jit body"):
+                if (f.line, f.col) not in seen:
+                    yield f

@@ -1,0 +1,100 @@
+"""recompile-hazard: jit construction patterns that retrace or
+recompile per call.
+
+``jax.jit`` caches compiled executables keyed on the *identity* of the
+wrapped callable.  Three spellings defeat that cache:
+
+- ``jax.jit(f)`` inside a loop: a fresh wrapper per iteration;
+- ``jax.jit(f)(x)`` immediately invoked inside a function that runs
+  per segment: a fresh wrapper per call;
+- ``jax.jit(self.method)`` / ``jax.jit(lambda ...)`` outside
+  ``__init__``: bound methods and lambdas are new objects on every
+  evaluation, so even a cached-looking spelling recompiles every call.
+
+At the 2^30 production segment shape one recompile costs minutes of
+XLA time (PERF.md), so "it still returns the right numbers" hides an
+outage-grade regression.  Construction in ``__init__`` or at module
+scope is exempt (one-time cost by construction), as is a jit result
+cached onto a ``self`` attribute (the lazy-build pattern).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from srtb_tpu.analysis.core import (Finding, ModuleSource, Project,
+                                    _assign_parent, _jit_callee)
+
+RULE = "recompile-hazard"
+DOC = ("jax.jit construction in a loop / immediately invoked / of a "
+      "bound method or lambda outside __init__")
+
+_EXEMPT_FUNCS = {"__init__", "__post_init__"}
+
+
+def _in_loop(mod: ModuleSource, call: ast.Call, fnode) -> bool:
+    scope = fnode if fnode is not None else mod.tree
+    for node in ast.walk(scope):
+        if isinstance(node, (ast.For, ast.While)):
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= call.lineno <= end:
+                return True
+    return False
+
+
+def check(project: Project, mod: ModuleSource):
+    immediate_jits = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Call) and _jit_callee(node.func, mod):
+            immediate_jits.add(id(node.func))
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call) and _jit_callee(node, mod)):
+            continue
+        info = mod.enclosing_function(node)
+        qual = info.qualname if info else "<module>"
+        fname = info.name if info else "<module>"
+        fnode = info.node if info else None
+        exempt = info is None or fname in _EXEMPT_FUNCS
+        if _in_loop(mod, node, fnode):
+            yield Finding(
+                RULE, mod.path, mod.rel, node.lineno, node.col_offset,
+                "jax.jit constructed inside a loop — a fresh wrapper "
+                "(and compile-cache key) per iteration; hoist the jit "
+                "out of the loop", qual, mod.line_text(node.lineno))
+            continue
+        if exempt:
+            continue
+        if id(node) in immediate_jits:
+            yield Finding(
+                RULE, mod.path, mod.rel, node.lineno, node.col_offset,
+                "jax.jit(...)(...) immediately invoked — a fresh "
+                "wrapper per call retraces and recompiles every time; "
+                "build the jit once in __init__ and reuse it",
+                qual, mod.line_text(node.lineno))
+            continue
+        wrapped = node.args[0] if node.args else None
+        bound = (isinstance(wrapped, ast.Attribute)
+                 and isinstance(wrapped.value, ast.Name)
+                 and wrapped.value.id == "self")
+        lam = isinstance(wrapped, ast.Lambda)
+        if not (bound or lam):
+            continue
+        assign = _assign_parent(mod.tree, node)
+        cached_on_self = False
+        if assign is not None:
+            targets = (assign.targets if isinstance(assign, ast.Assign)
+                       else [assign.target])
+            cached_on_self = any(
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self" for t in targets)
+        if cached_on_self:
+            continue
+        what = "a lambda" if lam else f"bound method 'self.{wrapped.attr}'"
+        yield Finding(
+            RULE, mod.path, mod.rel, node.lineno, node.col_offset,
+            f"jax.jit of {what} outside __init__ — the wrapped object "
+            "is new on every evaluation, so the jit cache misses and "
+            "recompiles per call; cache the wrapper on self",
+            qual, mod.line_text(node.lineno))

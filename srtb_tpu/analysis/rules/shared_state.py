@@ -1,0 +1,200 @@
+"""unguarded-shared-state: the same attribute mutated from a spawned
+thread and from other code without a lock.
+
+The engine is deliberately multi-threaded — the sink Pipe, UDP
+receivers, the backpressure pump, writer pools — and every shared
+mutable touched from two threads needs a lock (or a documented
+exclusivity argument recorded in the baseline).  This rule finds, per
+class (and per closure scope for nested functions), attributes and
+closure containers that are mutated both inside thread-entry code
+(functions handed to ``threading.Thread``/``Timer`` or the framework's
+``start_pipe``, plus everything they call) and outside it, where at
+least one mutation site is not inside a ``with <...lock/cv...>:``
+block.
+
+Mutation means assignment/augmented assignment to ``self.X...`` or a
+closure container, and calls of known mutating methods
+(``append``/``popleft``/``update``/...).  ``__init__`` is excluded
+(it runs before any thread exists).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from srtb_tpu.analysis.core import Finding, ModuleSource, Project
+
+RULE = "unguarded-shared-state"
+DOC = ("attribute mutated both on a spawned thread and outside it "
+       "without a lock")
+
+_SPAWN_THREAD = {"threading.Thread", "threading.Timer"}
+_MUTATORS = {"append", "appendleft", "extend", "add", "remove",
+             "discard", "pop", "popleft", "popitem", "clear", "insert",
+             "update", "setdefault", "put", "put_nowait"}
+_LOCKISH = ("lock", "_cv", "cv", "cond", "mutex", "_mu")
+_EXEMPT = {"__init__", "__post_init__", "__del__"}
+
+
+def _entry_functions(project: Project, mod: ModuleSource):
+    """Functions handed to Thread/Timer/start_pipe in this module."""
+    entries = set()
+    for info in mod.functions.values():
+        for node in info.body_nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = mod.dotted_name(node.func) or ""
+            target = None
+            if dotted in _SPAWN_THREAD:
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target = kw.value
+                if target is None and dotted.endswith("Timer") \
+                        and len(node.args) >= 2:
+                    target = node.args[1]
+            elif dotted == "start_pipe" or dotted.endswith(
+                    ".start_pipe"):
+                if node.args:
+                    target = node.args[0]
+            if target is None:
+                continue
+            resolved = project.resolve_call(mod, info, target)
+            if resolved is not None:
+                entries.add(resolved)
+    return entries
+
+
+def _top_scope(mod: ModuleSource, info) -> str:
+    while info.parent:
+        info = mod.functions[info.parent]
+    return info.qualname
+
+
+def _param_names(fnode) -> set[str]:
+    a = fnode.args
+    return {p.arg for p in
+            list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)}
+
+
+def _assigned_names(fnode) -> set[str]:
+    names = set()
+    for node in ast.walk(fnode):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fnode:
+            continue
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Store):
+            names.add(node.id)
+    return names
+
+
+def _guarded(fnode, node) -> bool:
+    """Is ``node`` lexically inside a with-block over a lock-ish
+    object?"""
+    for w in ast.walk(fnode):
+        if not isinstance(w, ast.With):
+            continue
+        end = getattr(w, "end_lineno", w.lineno)
+        if not (w.lineno <= node.lineno <= end):
+            continue
+        for item in w.items:
+            text = ast.unparse(item.context_expr).lower()
+            if any(tok in text for tok in _LOCKISH):
+                return True
+    return False
+
+
+def _mutations(mod: ModuleSource, info):
+    """Yield (key, node, guarded).  Keys: "Class.self.attr" for
+    attribute state, "scope:name" for closure containers."""
+    fnode = info.node
+    params_ = _param_names(fnode)
+    locals_ = _assigned_names(fnode)
+
+    def attr_key(target):
+        # self.a.b.c -> first attribute after self
+        chain = []
+        t = target
+        while isinstance(t, ast.Attribute):
+            chain.append(t.attr)
+            t = t.value
+        if isinstance(t, ast.Name) and t.id == "self" and chain:
+            cls = info.class_name or "<no-class>"
+            return f"{cls}.self.{chain[-1]}"
+        return None
+
+    def closure_key(name_node):
+        # containers shared between a scope and its nested thread
+        # functions: keyed by the top enclosing scope, so the same
+        # name in unrelated functions never collides.  Params are the
+        # callee's own view (tracked at the caller); imported
+        # singletons (metrics, log) own their locking.
+        if not isinstance(name_node, ast.Name):
+            return None
+        n = name_node.id
+        if n in params_ or n in mod.import_alias or n == "self":
+            return None
+        if info.parent is None and n not in locals_:
+            return None  # module global mutation: out of scope here
+        return f"{_top_scope(mod, info)}:{n}"
+
+    for node in info.body_nodes():
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            key = None
+            if isinstance(t, ast.Attribute):
+                key = attr_key(t)
+            elif isinstance(t, ast.Subscript):
+                key = (attr_key(t.value)
+                       or closure_key(t.value))
+            if key is not None:
+                yield key, node, _guarded(fnode, node)
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS:
+            recv = node.func.value
+            key = None
+            if isinstance(recv, ast.Attribute):
+                key = attr_key(recv)
+            elif isinstance(recv, ast.Name):
+                key = closure_key(recv)
+            if key is not None:
+                yield key, node, _guarded(fnode, node)
+
+
+def check(project: Project, mod: ModuleSource):
+    entries = _entry_functions(project, mod)
+    if not entries:
+        return
+    entry_closure = {f for f in project.reachable(entries)
+                     if f.module is mod}
+    entry_muts: dict[str, list] = {}
+    other_muts: dict[str, list] = {}
+    for info in mod.functions.values():
+        if info.name in _EXEMPT:
+            continue
+        side = (entry_muts if info in entry_closure else other_muts)
+        for key, node, guarded in _mutations(mod, info):
+            side.setdefault(key, []).append((info, node, guarded))
+    for key in sorted(set(entry_muts) & set(other_muts)):
+        sites = entry_muts[key] + other_muts[key]
+        unguarded = [s for s in sites if not s[2]]
+        if not unguarded:
+            continue
+        info, node, _ = min(
+            unguarded, key=lambda s: (s[1].lineno, s[1].col_offset))
+        e_names = sorted({s[0].qualname for s in entry_muts[key]})
+        o_names = sorted({s[0].qualname for s in other_muts[key]})
+        state = key.split(":", 1)[-1].replace(".self.", ".")
+        yield Finding(
+            RULE, mod.path, mod.rel, node.lineno, node.col_offset,
+            f"'{state}' is mutated on a spawned thread "
+            f"({', '.join(e_names)}) and outside it "
+            f"({', '.join(o_names)}) with at least one unlocked "
+            "site — guard with a lock or record the exclusivity "
+            "argument in the baseline",
+            info.qualname, mod.line_text(node.lineno))

@@ -1,0 +1,277 @@
+"""Opt-in runtime sanitizer for the pipeline (``Config.sanitize``).
+
+The linter (analysis/lint.py) catches hazard *spellings*; this module
+catches hazard *behavior* on a live run, trading throughput for
+trapping, with zero cost when disabled (the pipeline holds ``None``
+and never calls in here):
+
+- **implicit device->host transfers**: ``np.asarray``/``np.array`` on
+  a ``jax.Array`` raises :class:`SanitizerError`; explicit
+  ``jax.device_get`` stays allowed.  Two mechanisms, because
+  ``jax.transfer_guard`` is a no-op on the CPU backend (host==device,
+  nothing crosses a boundary): the guard config is set globally for
+  real accelerators, and the numpy entry points are wrapped for the
+  call-pattern check that CPU CI can enforce.  Process-wide, so sink
+  Pipe / writer threads are covered too.
+- **use-after-donate**: after a donated dispatch completes, the input
+  buffer is explicitly ``delete()``-d, so a later read raises
+  ("Array has been deleted") even on CPU where donation itself is a
+  no-op and the bug would otherwise ship silently to the TPU.
+- **NaN/Inf tripwires** at segment-plan boundaries
+  (:func:`check_finite`), and **shape/dtype contract asserts** between
+  stages (:func:`check_contract`).
+- **thread-ownership guards**: engine state names are claimed by the
+  first accessing thread and asserted on every subsequent access
+  (:meth:`Sanitizer.assert_owner`).
+- **leaked-thread check**: a run must end with every thread it spawned
+  joined (utils/termination.leaked_threads).
+
+Sanitized dispatches serialize (the donation expiry blocks on the
+result), so ``Config.sanitize`` is a debugging mode, not a production
+mode — PERF.md documents the A/B showing zero overhead when off.
+"""
+
+from __future__ import annotations
+
+# srtb-lint: disable-file=sync-hot-path (every sync in this module IS
+# the sanitizer doing its job: sanitize mode serializes by design)
+
+import contextlib
+import threading
+
+import numpy as np
+
+from srtb_tpu.utils.logging import log
+
+
+class SanitizerError(AssertionError):
+    """A sanitizer tripwire fired.  Message includes the stage/state
+    name and what to do about it."""
+
+
+# ------------------------------------------------------------------
+# implicit-transfer tripwire (module-level: installed refcounted so
+# nested sanitized pipelines compose; thread-local allowance so
+# jax.device_get stays the sanctioned spelling)
+# ------------------------------------------------------------------
+
+_tls = threading.local()
+_install_lock = threading.Lock()
+_install_count = 0
+_saved = {}
+
+
+def _allowed() -> bool:
+    return getattr(_tls, "allow_transfers", 0) > 0
+
+
+@contextlib.contextmanager
+def allow_transfers():
+    """Mark the current thread as performing a sanctioned explicit
+    transfer (used by the wrapped ``jax.device_get``)."""
+    prev = getattr(_tls, "allow_transfers", 0)
+    _tls.allow_transfers = prev + 1
+    try:
+        yield
+    finally:
+        _tls.allow_transfers = prev
+
+
+def _wrap_np(orig, name):
+    def wrapped(a, *args, **kwargs):
+        import jax
+        if isinstance(a, jax.Array) and not _allowed():
+            raise SanitizerError(
+                f"[sanitize] implicit device->host transfer: "
+                f"np.{name}() on a jax.Array of shape {a.shape} "
+                f"dtype {a.dtype} — use jax.device_get(...) at a "
+                "sanctioned sync point (drain/sink side), never on "
+                "the dispatch hot path (srtb-lint: sync-hot-path)")
+        return orig(a, *args, **kwargs)
+    wrapped.__name__ = name
+    wrapped._srtb_sanitize_orig = orig
+    return wrapped
+
+
+def _install_tripwire() -> None:
+    global _install_count
+    with _install_lock:
+        _install_count += 1
+        if _install_count > 1:
+            return
+        import jax
+        _saved["asarray"] = np.asarray
+        _saved["array"] = np.array
+        np.asarray = _wrap_np(np.asarray, "asarray")
+        np.array = _wrap_np(np.array, "array")
+        _saved["device_get"] = jax.device_get
+
+        def device_get(x):
+            with allow_transfers():
+                return _saved["device_get"](x)
+        jax.device_get = device_get
+        # real accelerators also get JAX's own guard (no-op on CPU);
+        # host->device stays permissive: implicit H2D is a perf wart
+        # the linter covers, not a stream-serializing sync
+        try:
+            _saved["guard"] = jax.config.jax_transfer_guard_device_to_host
+            jax.config.update("jax_transfer_guard_device_to_host",
+                              "disallow")
+        except Exception:  # config knob absent on this jax
+            _saved["guard"] = None
+            log.warning("[sanitize] jax transfer-guard config "
+                        "unavailable; numpy tripwire only")
+
+
+def _uninstall_tripwire() -> None:
+    global _install_count
+    with _install_lock:
+        _install_count -= 1
+        if _install_count > 0:
+            return
+        import jax
+        np.asarray = _saved.pop("asarray")
+        np.array = _saved.pop("array")
+        jax.device_get = _saved.pop("device_get")
+        guard = _saved.pop("guard", None)
+        with contextlib.suppress(Exception):
+            jax.config.update("jax_transfer_guard_device_to_host",
+                              guard if guard is not None else "allow")
+
+
+# ------------------------------------------------------------------
+# value / contract checks
+# ------------------------------------------------------------------
+
+def _float_leaves(tree):
+    import jax
+    for leaf in jax.tree_util.tree_leaves(tree):
+        dt = getattr(leaf, "dtype", None)
+        if dt is not None and np.issubdtype(dt, np.inexact):
+            yield leaf
+
+
+def check_finite(tag: str, tree) -> None:
+    """NaN/Inf tripwire over every float/complex leaf of ``tree``
+    (device leaves are reduced on device; only a scalar crosses)."""
+    import jax
+    import jax.numpy as jnp
+    for leaf in _float_leaves(tree):
+        if isinstance(leaf, jax.Array):
+            ok = bool(jax.device_get(jnp.isfinite(leaf).all()))
+        else:
+            ok = bool(np.isfinite(np.asarray(leaf)).all())
+        if not ok:
+            raise SanitizerError(
+                f"[sanitize] non-finite values at '{tag}' (shape "
+                f"{getattr(leaf, 'shape', '?')}, dtype "
+                f"{getattr(leaf, 'dtype', '?')}) — a stage upstream "
+                f"of '{tag}' produced NaN/Inf; re-run with per-stage "
+                "checks (staged plan) to bisect, and check RFI "
+                "normalization / window coefficients first")
+
+
+def check_contract(tag: str, arr, *, ndim: int | None = None,
+                   lead: int | None = None, dtype=None) -> None:
+    """Shape/dtype contract between stages: the stacked (re, im)
+    boundary representation is load-bearing (complex never crosses
+    jit boundaries on some TPU runtimes — segment.py)."""
+    if arr is None:
+        return
+    shape = getattr(arr, "shape", None)
+    adt = getattr(arr, "dtype", None)
+    if ndim is not None and len(shape) != ndim:
+        raise SanitizerError(
+            f"[sanitize] stage contract broken at '{tag}': expected "
+            f"ndim {ndim}, got shape {shape} — a plan change altered "
+            "the boundary representation without updating consumers")
+    if lead is not None and (not shape or shape[0] != lead):
+        raise SanitizerError(
+            f"[sanitize] stage contract broken at '{tag}': expected "
+            f"leading axis {lead} (stacked re/im), got shape {shape}")
+    if dtype is not None and adt != np.dtype(dtype):
+        raise SanitizerError(
+            f"[sanitize] stage contract broken at '{tag}': expected "
+            f"dtype {np.dtype(dtype)}, got {adt} — dtype drift "
+            "(srtb-lint: dtype-drift) breaks the TPU df64 path")
+
+
+def expire_donated(raw, results) -> None:
+    """Make use-after-donate loud on every backend: once the donated
+    call's ``results`` are materialized the input buffer is dead by
+    contract, so delete it — a later read raises 'Array has been
+    deleted' at the offending line instead of returning garbage on
+    the TPU only."""
+    import jax
+    jax.block_until_ready(results)
+    with contextlib.suppress(Exception):
+        raw.delete()
+
+
+# ------------------------------------------------------------------
+# the per-pipeline object
+# ------------------------------------------------------------------
+
+class Sanitizer:
+    """One pipeline run's sanitizer state (thread owners + run scope).
+
+    The pipeline holds ``None`` when ``Config.sanitize`` is off; every
+    hook site is an ``if san is not None`` — nothing else, which is
+    what makes the disabled path zero-cost.
+    """
+
+    def __init__(self):
+        self._owners: dict[str, tuple[int, str]] = {}
+        self._lock = threading.Lock()
+
+    # -- thread ownership
+
+    def assert_owner(self, name: str) -> None:
+        """Claim-on-first-use thread ownership: the first thread to
+        touch state ``name`` owns it for the run; any other thread
+        touching it afterwards is a cross-thread mutation bug."""
+        t = threading.current_thread()
+        with self._lock:
+            owner = self._owners.setdefault(name, (t.ident, t.name))
+        if owner[0] != t.ident:
+            raise SanitizerError(
+                f"[sanitize] thread-ownership violation on '{name}': "
+                f"owned by thread '{owner[1]}' but touched from "
+                f"'{t.name}' — engine window state is single-owner "
+                "by design; route cross-thread work through the sink "
+                "Pipe or add a lock (srtb-lint: "
+                "unguarded-shared-state)")
+
+    def release_owners(self) -> None:
+        with self._lock:
+            self._owners.clear()
+
+    # -- run scope
+
+    @contextlib.contextmanager
+    def run_scope(self):
+        """Arm the transfer tripwire and the leaked-thread check for
+        the duration of one pipeline run."""
+        from srtb_tpu.utils import termination
+        snapshot = termination.thread_snapshot()
+        _install_tripwire()
+        try:
+            yield self
+        finally:
+            _uninstall_tripwire()
+            self.release_owners()
+            leaked = termination.leaked_threads(snapshot)
+            if leaked:
+                names = ", ".join(
+                    f"'{t.name}'" for t in leaked)
+                raise SanitizerError(
+                    f"[sanitize] leaked thread(s) after run: {names} "
+                    "— every thread spawned during a run must be "
+                    "joined on shutdown (see the join audit in "
+                    "utils/termination.py)")
+
+    # -- per-segment checks (module functions re-exported for hooks)
+
+    check_finite = staticmethod(check_finite)
+    check_contract = staticmethod(check_contract)
+    expire_donated = staticmethod(expire_donated)

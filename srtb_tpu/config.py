@@ -147,6 +147,13 @@ class Config:
     # tunnel RTT (~60 ms per host sync, PERF.md) over B segments.
     # 1 = off; >1 requires the fused plan (not staged)
     micro_batch_segments: int = 1
+    # opt-in runtime sanitizer (analysis/sanitizer.py): traps implicit
+    # device->host transfers, NaN/Inf at segment-plan boundaries,
+    # stage shape/dtype contract breaks, wrong-thread access to engine
+    # window state, leaked threads, and makes use-after-donate loud on
+    # every backend.  Serializes dispatch — a debugging mode with zero
+    # cost when off.  A/B methodology: PERF.md "Sanitizer".
+    sanitize: bool = False
     # fail-fast watchdog on the per-segment device sync (seconds,
     # 0 = disabled): a wedged accelerator runtime otherwise hangs the
     # observation silently — on expiry the process aborts through the
@@ -220,7 +227,7 @@ class Config:
     })
     _BOOL_FIELDS = frozenset({
         "baseband_reserve_sample", "baseband_write_all", "gui_enable",
-        "use_emulated_fp64", "use_pallas", "use_pallas_sk",
+        "use_emulated_fp64", "use_pallas", "use_pallas_sk", "sanitize",
     })
     _LIST_FIELDS = frozenset({
         "udp_receiver_address", "udp_receiver_port",

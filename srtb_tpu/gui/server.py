@@ -281,3 +281,8 @@ class WaterfallHTTPServer:
     def stop(self):
         self._httpd.shutdown()
         self._httpd.server_close()
+        # join the serve_forever thread: shutdown() only signals it,
+        # and an unjoined (if daemon) thread is exactly the leak the
+        # sanitizer's thread check exists to catch
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)

@@ -24,6 +24,9 @@ from srtb_tpu.config import Config
 from srtb_tpu.ops import spectrum as sp
 
 
+from srtb_tpu.utils.platform import to_host as _to_host
+
+
 class WaterfallRenderer:
     """Owns the jitted resample+normalize+colormap function for one
     waterfall geometry."""
@@ -32,6 +35,10 @@ class WaterfallRenderer:
         self.w_freq = jnp.asarray(sp.freq_area_weights(in_freq, out_h))
         self.w_time = jnp.asarray(sp.time_interp_weights(in_time, out_w))
         self._render = jax.jit(self._render_impl)
+        # built here, NOT per render_power call: jax.jit of a bound
+        # method evaluated per call recompiles every time (srtb-lint
+        # recompile-hazard found the old spelling doing exactly that)
+        self._render_power = jax.jit(self._render_power_impl)
 
     def _render_impl(self, wf_ri: jnp.ndarray) -> jnp.ndarray:
         """wf_ri [2, F, T] (re, im) -> ARGB32 [out_h, out_w] uint32."""
@@ -44,10 +51,10 @@ class WaterfallRenderer:
         return sp.generate_pixmap(img)
 
     def render(self, wf_ri) -> np.ndarray:
-        return np.asarray(self._render(jnp.asarray(wf_ri)))
+        return jax.device_get(self._render(jnp.asarray(wf_ri)))
 
     def render_power(self, power) -> np.ndarray:
-        return np.asarray(jax.jit(self._render_power_impl)(
+        return jax.device_get(self._render_power(
             jnp.asarray(power, dtype=jnp.float32)))
 
 
@@ -156,10 +163,10 @@ class ScrollingWaterfall:
         overflow color."""
         filled = min(self.lines_total, self.height)
         if filled == 0:
-            return np.asarray(sp.generate_pixmap(jnp.asarray(self._img)))
+            return _to_host(sp.generate_pixmap(jnp.asarray(self._img)))
         avg = float(self._img[:filled].mean())
         coeff = 1.0 / (2.0 * avg) if avg > np.finfo(np.float32).eps else 1.0
-        return np.asarray(sp.generate_pixmap(
+        return _to_host(sp.generate_pixmap(
             jnp.asarray(self._img * np.float32(coeff))))
 
 
@@ -219,7 +226,7 @@ class WaterfallService:
         return self._scrollers[stream]
 
     def _push_scroll(self, wf_ri, stream: int) -> None:
-        wf = _stream_slice(np.asarray(wf_ri), stream)
+        wf = _stream_slice(_to_host(wf_ri), stream)
         power = wf[0] ** 2 + wf[1] ** 2          # [F, T]
         k = min(self.scroll_lines, power.shape[-1])
         chunks = np.array_split(power, k, axis=-1)
@@ -233,7 +240,7 @@ class WaterfallService:
             self._push_scroll(wf_ri, data_stream_id)
             return
         if self.sum_count > 1:
-            wf = _stream_slice(np.asarray(wf_ri), data_stream_id)
+            wf = _stream_slice(_to_host(wf_ri), data_stream_id)
             power = wf[0] ** 2 + wf[1] ** 2
             n, acc = self._accum.get(data_stream_id, (0, 0.0))
             n, acc = n + 1, acc + power
@@ -265,7 +272,7 @@ class WaterfallService:
             return None
         wf_ri, stream = self._pending
         self._pending = None
-        wf = _stream_slice(np.asarray(wf_ri), stream)
+        wf = _stream_slice(_to_host(wf_ri), stream)
         if wf.ndim == 2:  # pre-summed power frame
             pix = self.renderer.render_power(wf)
         else:

@@ -134,7 +134,12 @@ class WriteSignalSink:
 
         npy_paths = []
         if work.waterfall is not None:
-            wf = np.asarray(work.waterfall)
+            # the waterfall may still be device-resident (lazy sink-side
+            # transfer): fetch via the explicit D2H spelling so the
+            # sanitizer's transfer tripwire stays quiet on this
+            # sanctioned sync
+            from srtb_tpu.utils.platform import to_host
+            wf = to_host(work.waterfall)
             if wf.ndim == 4:  # stacked (re, im) boundary representation
                 wf = (wf[0] + 1j * wf[1]).astype(np.complex64)
             if wf.ndim == 2:

@@ -164,7 +164,11 @@ class _DeadlineArray:
     def __array__(self, dtype=None, copy=None):
         if not self._fetched:
             dev = self._arr
-            self._arr = self._sync(lambda: np.asarray(dev))
+            # explicit device_get, not np.asarray: the lazy waterfall
+            # transfer is a *sanctioned* D2H (sink side), and the
+            # sanitizer's transfer tripwire only exempts the explicit
+            # spelling (srtb-lint sync-hot-path true positive, PR 3)
+            self._arr = self._sync(lambda: jax.device_get(dev))
             self._fetched = True  # drop the device handle; memoize host
         a = self._arr
         if dtype is not None and np.dtype(dtype) != a.dtype:
@@ -220,6 +224,12 @@ class Pipeline:
         self.sinks = sinks
         self.keep_waterfall = keep_waterfall
         self.stats = PipelineStats()
+        # opt-in runtime sanitizer: None when off, so every hook site
+        # below is a single `is not None` check (zero-cost disabled)
+        self.sanitizer = None
+        if getattr(cfg, "sanitize", False):
+            from srtb_tpu.analysis.sanitizer import Sanitizer
+            self.sanitizer = Sanitizer()
         # every completed host-stage timing also lands in a bounded
         # histogram, so /metrics carries live p50/p95/p99 per stage
         self.stage_timer = StageTimer(
@@ -369,6 +379,13 @@ class Pipeline:
         path), inline in serial mode."""
         cfg = self.cfg
         seg, wf, det_res, offset_after, span, hidden, depth, live = item
+        san = self.sanitizer
+        if san is not None:
+            # the sink side is single-owner too: either the sink pipe
+            # thread (overlapped) or the main thread (serial), never
+            # both within one run
+            san.assert_owner("sink_drain")
+            self._sanitize_check(wf, det_res)
         positive = has_signal(
             cfg, det_res,
             frequency_bin_count=(wf.shape[-2] if wf is not None
@@ -405,7 +422,19 @@ class Pipeline:
         ``inflight_segments = 1`` this degenerates to the fully serial
         reference loop; the default window of 2 reproduces the
         reference's queue-capacity-2 pipe graph with sink work off the
-        critical path."""
+        critical path.
+
+        With ``Config.sanitize`` the whole run executes inside the
+        sanitizer scope: implicit-transfer tripwire armed, thread
+        owners tracked, and a leaked-thread check after the sink pipe
+        joins."""
+        if self.sanitizer is None:
+            return self._run_engine(max_segments)
+        with self.sanitizer.run_scope():
+            return self._run_engine(max_segments)
+
+    def _run_engine(self, max_segments: int | None = None) \
+            -> PipelineStats:
         from srtb_tpu.pipeline import framework as fw
 
         cfg = self.cfg
@@ -514,7 +543,13 @@ class Pipeline:
         # in-flight depth never exceeds inflight_segments
         unit = batch
 
+        san = self.sanitizer
+
         def fill_window() -> None:
+            if san is not None:
+                # dispatch-window state (pending deque, dispatch
+                # counters) is owned by the run() thread
+                san.assert_owner("inflight_window")
             while live_count() + unit <= window and want_more() \
                     and sink_alive():
                 if batch > 1:
@@ -551,6 +586,8 @@ class Pipeline:
                     self.stats.samples += n_samples_per_seg
 
         def drain_oldest() -> bool:
+            if san is not None:
+                san.assert_owner("inflight_window")
             # journaled depths, both captured AT drain time including
             # the item being drained (a full window journals as W, not
             # a perpetual W-1): queue_depth = dispatched-not-yet-
@@ -608,6 +645,16 @@ class Pipeline:
                  f"{self.stats.msamples_per_sec:.1f} Msamples/s")
         return self.stats
 
+    def _sanitize_check(self, wf, det_res) -> None:
+        """Per-segment sanitizer checks at the drain boundary: NaN/Inf
+        tripwires plus the stacked-(re, im) waterfall contract."""
+        from srtb_tpu.analysis import sanitizer as S
+        S.check_finite("detect result", det_res)
+        if wf is not None:
+            S.check_contract("drained waterfall", wf, ndim=4, lead=2,
+                             dtype=np.float32)
+            S.check_finite("drained waterfall", wf)
+
     # overridable for tests; the default aborts through the installed
     # signal/termination handlers for a loud stacktrace (the reference's
     # fail-fast philosophy, ref: util/termination_handler.hpp:38-113)
@@ -653,8 +700,11 @@ class Pipeline:
         waterfall transfer lands in the consuming sink's time."""
         seg, wf, det_res, offset_after, span = item
         with self._stage("fetch"):
+            # explicit D2H (device_get) — this is the engine's one
+            # sanctioned blocking fetch; implicit np.asarray here
+            # would trip the sanitizer's transfer guard
             det_res = self._sync_with_deadline(
-                lambda: jax.tree_util.tree_map(np.asarray, det_res))
+                lambda: jax.device_get(det_res))
         span["fetch"] = self.stage_timer.last["fetch"]
         if wf is not None and self.cfg.segment_deadline_s > 0:
             wf = _DeadlineArray(wf, self._sync_with_deadline)
@@ -739,9 +789,9 @@ class DMSearchPipeline:
                 # (a wedged tunnel blocks transfers, not just compute)
                 peaks, counts, zero = sync_with_deadline(
                     cfg.segment_deadline_s,
-                    lambda: (np.asarray(res.snr_peaks),
-                             np.asarray(res.signal_counts),
-                             np.asarray(res.zero_count)))
+                    lambda: (jax.device_get(res.snr_peaks),
+                             jax.device_get(res.signal_counts),
+                             jax.device_get(res.zero_count)))
                 peaks = peaks.reshape(n_dm, -1)
                 counts = counts.reshape(n_dm, -1)
                 zero = zero.reshape(n_dm, -1).max(axis=-1)
@@ -788,6 +838,17 @@ class ThreadedPipeline(Pipeline):
     """
 
     def run(self, max_segments: int | None = None) -> PipelineStats:
+        # Config.sanitize arms the same run scope as Pipeline.run
+        # (transfer tripwire + leaked-thread check); the per-stage
+        # thread-ownership guards don't apply to this engine — every
+        # stage owning its own thread IS the design here
+        if self.sanitizer is None:
+            return self._run_threaded(max_segments)
+        with self.sanitizer.run_scope():
+            return self._run_threaded(max_segments)
+
+    def _run_threaded(self, max_segments: int | None = None) \
+            -> PipelineStats:
         from srtb_tpu.pipeline import framework as fw
 
         cfg = self.cfg
@@ -823,6 +884,8 @@ class ThreadedPipeline(Pipeline):
 
         def _drain_body(stop_token, item):
             seg, wf, det_res, offset_after, span = item
+            if self.sanitizer is not None:
+                self._sanitize_check(wf, det_res)
             positive = has_signal(
                 cfg, det_res,
                 frequency_bin_count=(wf.shape[-2] if wf is not None

@@ -164,6 +164,11 @@ class SegmentProcessor:
         # reuse one device-resident input across calls, which donation
         # would invalidate.
         self._donate_input = bool(donate_input)
+        # runtime sanitizer (Config.sanitize): per-stage NaN tripwires
+        # + boundary contracts + explicit expiry of donated inputs.
+        # Not part of plan_signature: it changes call sequencing only,
+        # never the traced programs.
+        self._sanitize = bool(getattr(cfg, "sanitize", False))
         in_donate = (0,) if self._donate_input else ()
         self._jit_process = jax.jit(self._process, donate_argnums=in_donate)
         self._jit_process_batch = None  # built lazily (micro-batch mode)
@@ -548,6 +553,18 @@ class SegmentProcessor:
         self.aot_active = True
         return True
 
+    @staticmethod
+    def _as_device_bytes(raw) -> jnp.ndarray:
+        """Host bytes -> device uint8 via *explicit* ``device_put``
+        (``jnp.asarray`` on host data is an implicit H2D transfer; the
+        explicit spelling keeps every pipeline transfer visible to
+        ``jax.transfer_guard`` and the runtime sanitizer)."""
+        if isinstance(raw, jax.Array):
+            return raw if raw.dtype == jnp.uint8 \
+                else jnp.asarray(raw, dtype=jnp.uint8)
+        return jax.device_put(
+            np.ascontiguousarray(np.asarray(raw), dtype=np.uint8))
+
     def stage_input(self, raw) -> jnp.ndarray:
         """Start the async host->device transfer of one segment's raw
         bytes and return the device handle immediately (H2D staging).
@@ -571,7 +588,7 @@ class SegmentProcessor:
             raise ValueError(
                 "micro_batch_segments > 1 requires the fused plan "
                 "(staged segments are already dispatch-amortized)")
-        raw = jnp.asarray(raws, dtype=jnp.uint8)
+        raw = self._as_device_bytes(raws)
         expected = self.cfg.segment_bytes(self.fmt.data_stream_count)
         if raw.ndim != 2 or raw.shape[1] != expected:
             raise ValueError(
@@ -581,7 +598,13 @@ class SegmentProcessor:
             self._jit_process_batch = jax.jit(
                 jax.vmap(self._process, in_axes=(0, None)),
                 donate_argnums=in_donate)
-        return self._jit_process_batch(raw, self.chirp)
+        out = self._jit_process_batch(raw, self.chirp)
+        if self._sanitize and self._donate_input:
+            from srtb_tpu.analysis import sanitizer as S
+            # the sanitizer is the sanctioned holder of the donated
+            # buffer (it deletes it)  # srtb-lint: disable=use-after-donate
+            S.expire_donated(raw, out)
+        return out
 
     def process(self, raw) -> tuple[jnp.ndarray, det.DetectResult]:
         """Run one segment. ``raw`` is the uint8 byte array of the segment
@@ -591,7 +614,7 @@ class SegmentProcessor:
         [2, S, F, T] float32 (re, im); use :func:`waterfall_to_numpy` to
         assemble a complex host array.
         """
-        raw = jnp.asarray(raw, dtype=jnp.uint8)
+        raw = self._as_device_bytes(raw)
         expected = self.cfg.segment_bytes(self.fmt.data_stream_count)
         if raw.shape != (expected,):
             raise ValueError(
@@ -600,10 +623,39 @@ class SegmentProcessor:
 
     def run_device(self, raw: jnp.ndarray):
         """Run one segment on an already-device-resident byte array,
-        dispatching between the fused and staged execution plans."""
+        dispatching between the fused and staged execution plans.
+
+        Under ``Config.sanitize`` every plan boundary gets a NaN/Inf
+        tripwire + a stacked-(re, im) float32 contract assert, and the
+        donated input buffer is explicitly expired once consumed so a
+        use-after-donate raises on CPU CI too (donation there is a
+        no-op and the bug would otherwise only corrupt on the TPU).
+        This serializes dispatch — sanitize is a debugging mode."""
         if not self.staged:
-            return self._jit_process(raw, self.chirp)
-        return self._jit_stage_c(self._jit_stage_b(self._jit_stage_a(raw)))
+            out = self._jit_process(raw, self.chirp)
+            if self._sanitize and self._donate_input:
+                from srtb_tpu.analysis import sanitizer as S
+                # sanctioned holder: expiry deletes the donated
+                # buffer  # srtb-lint: disable=use-after-donate
+                S.expire_donated(raw, out)
+            return out
+        if not self._sanitize:
+            return self._jit_stage_c(
+                self._jit_stage_b(self._jit_stage_a(raw)))
+        from srtb_tpu.analysis import sanitizer as S
+        a = self._jit_stage_a(raw)
+        S.check_contract("stage_a boundary", a, lead=2,
+                         dtype=jnp.float32)
+        S.check_finite("stage_a boundary", a)
+        if self._donate_input:
+            # sanctioned holder: expiry deletes the donated
+            # buffer  # srtb-lint: disable=use-after-donate
+            S.expire_donated(raw, a)
+        b = self._jit_stage_b(a)  # donates a (checked above, by value)
+        S.check_contract("stage_b boundary", b, lead=2,
+                         dtype=jnp.float32)
+        S.check_finite("stage_b boundary", b)
+        return self._jit_stage_c(b)
 
     @property
     def data_stream_count(self) -> int:
@@ -611,6 +663,11 @@ class SegmentProcessor:
 
 
 def waterfall_to_numpy(wf_ri) -> np.ndarray:
-    """[2, S, F, T] float32 (re, im) -> [S, F, T] complex64 on host."""
-    a = np.asarray(wf_ri)
+    """[2, S, F, T] float32 (re, im) -> [S, F, T] complex64 on host.
+
+    Uses the explicit D2H spelling (utils/platform.to_host) so sinks
+    fetching a still-device waterfall stay visible to the transfer
+    guard / sanitizer."""
+    from srtb_tpu.utils.platform import to_host
+    a = to_host(wf_ri)
     return (a[0] + 1j * a[1]).astype(np.complex64)

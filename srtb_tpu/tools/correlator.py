@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import os
 import sys
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -17,6 +18,20 @@ from srtb_tpu.utils.logging import log
 from srtb_tpu.utils.platform import apply_platform_env
 
 
+# module-level jit (srtb-lint recompile-hazard caught the old
+# per-call jax.jit(nested_fn)(...) spelling, which recompiled the FFT
+# pair on every correlate() call); complex_count is static, the norm
+# coefficient rides along as a traced scalar
+@partial(jax.jit, static_argnums=(2,))
+def _corr(a, b, complex_count, norm_coeff):
+    fa = jnp.fft.rfft(a.astype(jnp.float32))[:complex_count]
+    fb = jnp.fft.rfft(b.astype(jnp.float32))[:complex_count]
+    prod = (norm_coeff * fa) * jnp.conj(fb)
+    # unnormalized backward C2C, like the reference's BACKWARD plan
+    corr = jnp.fft.ifft(prod, norm="forward")
+    return jnp.abs(corr)
+
+
 def correlate(x1: np.ndarray, x2: np.ndarray) -> np.ndarray:
     """Cross-correlation magnitude of two 8-bit sample streams
     (ref: correlator.cpp:109-140).  Returns float32 [n/2]."""
@@ -24,18 +39,10 @@ def correlate(x1: np.ndarray, x2: np.ndarray) -> np.ndarray:
     complex_count = input_size // 2
     real_count = complex_count * 2
     norm_coeff = np.float32(input_size ** -1.5)
-
-    def _corr(a, b):
-        fa = jnp.fft.rfft(a.astype(jnp.float32))[:complex_count]
-        fb = jnp.fft.rfft(b.astype(jnp.float32))[:complex_count]
-        prod = (norm_coeff * fa) * jnp.conj(fb)
-        # unnormalized backward C2C, like the reference's BACKWARD plan
-        corr = jnp.fft.ifft(prod, norm="forward")
-        return jnp.abs(corr)
-
-    out = jax.jit(_corr)(jnp.asarray(x1[:real_count]),
-                         jnp.asarray(x2[:real_count]))
-    return np.asarray(out)
+    out = _corr(jnp.asarray(x1[:real_count]),
+                jnp.asarray(x2[:real_count]),
+                complex_count, norm_coeff)
+    return jax.device_get(out)
 
 
 def main(argv=None) -> int:

@@ -9,6 +9,8 @@ new hardware.
 Usage: python -m srtb_tpu.tools.fft_bench [min_log2 [max_log2 [strategies]]]
 (strategies: comma list from monolithic,four_step,mxu,pallas,pallas2)
 """
+# srtb-lint: disable-file=recompile-hazard (bench harness: each (size,
+# strategy) case jits one lambda once, then times steady-state repeats)
 
 from __future__ import annotations
 

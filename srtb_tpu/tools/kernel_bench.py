@@ -13,7 +13,14 @@ Usage:
 
 Prints one JSON line per kernel:
     {"kernel": ..., "ms": ..., "shape": ..., "gsamples_per_s": ...}
+
+Each bench case intentionally builds a fresh jitted lambda: the case IS
+the compile+run cycle being measured, and every lambda is jitted once
+then timed over repeats — the per-call-recompile hazard srtb-lint
+flags does not apply to this harness.
 """
+# srtb-lint: disable-file=recompile-hazard (bench harness: one jit per
+# case by design, see docstring)
 
 from __future__ import annotations
 

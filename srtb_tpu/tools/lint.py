@@ -1,0 +1,12 @@
+"""CLI entry point: ``python -m srtb_tpu.tools.lint srtb_tpu/``.
+
+Thin wrapper over :mod:`srtb_tpu.analysis.lint` (kept under tools/ so
+the operator-facing commands all live in one namespace).  See
+``--list-rules`` for the rule set and ``srtb_tpu/analysis/__init__.py``
+for pragma / baseline syntax.
+"""
+
+from srtb_tpu.analysis.lint import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -26,6 +26,20 @@ def apply_platform_env() -> None:
         pass
 
 
+def to_host(x):
+    """Explicit device->host fetch for possibly-device arrays — the
+    single home of the sanctioned D2H spelling.  ``np.asarray`` on a
+    ``jax.Array`` is an *implicit* transfer (srtb-lint sync-hot-path;
+    the runtime sanitizer's tripwire raises on it), so every sink/GUI
+    fetch funnels through here."""
+    import jax
+    import numpy as np
+
+    if isinstance(x, jax.Array):
+        return jax.device_get(x)
+    return np.asarray(x)
+
+
 def on_accelerator() -> bool:
     """Whether the default JAX backend is real TPU hardware (directly or
     via the axon relay) — the single home of the backend set that gates

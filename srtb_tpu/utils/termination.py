@@ -12,11 +12,58 @@ from __future__ import annotations
 import faulthandler
 import signal
 import sys
+import threading
+import time
 import traceback
 
 from srtb_tpu.utils.logging import log
 
 _installed = False
+
+# Thread-join audit (PR 3 satellite): every thread the runtime spawns
+# and where it is joined on shutdown —
+# - pipeline sink pipe ("sink_drain"): joined in Pipeline.run finally;
+# - ThreadedPipeline pipes ("source"/"device"/"drain"): joined by
+#   framework.on_exit;
+# - AsyncWriterPool workers: joined by pool.close() / GC finalizer
+#   (Pipeline.close closes an owned pool);
+# - DropOldestSegmentBuffer pump: joined (5 s) in close();
+# - UDP receiver threads: joined in the receivers' close();
+# - WaterfallHTTPServer: joined in stop() (leak fixed in PR 3);
+# - sync_with_deadline watchdog Timers: daemon, cancelled in finally.
+# The helpers below let the sanitizer assert this list stays true.
+
+# pools that legitimately outlive one pipeline run (owned by objects
+# with their own close()): lazily-spawned worker threads of these
+# prefixes are not "leaks" of the run that first used them.
+# "srtb-writer": the Python-fallback AsyncWriterPool spawns workers on
+# first submit (mid-run) and joins them at Pipeline.close(), after run()
+LEAK_ALLOW_PREFIXES = ("ThreadPoolExecutor", "srtb-writer", "pydevd",
+                       "asyncio_")
+
+
+def thread_snapshot() -> set[int]:
+    """Idents of currently-live threads (leak-check baseline)."""
+    return {t.ident for t in threading.enumerate()}
+
+
+def leaked_threads(snapshot: set[int], grace_s: float = 1.0,
+                   allow_prefixes=LEAK_ALLOW_PREFIXES) -> list:
+    """Threads alive now that were not in ``snapshot``, after giving
+    stragglers ``grace_s`` to finish joining.  Used by the runtime
+    sanitizer to assert a pipeline run cleans up every thread it
+    spawned (a leaked sink/pump thread keeps buffers and file handles
+    pinned for the rest of the process)."""
+    deadline = time.monotonic() + max(0.0, grace_s)
+    while True:
+        leaked = [
+            t for t in threading.enumerate()
+            if t.ident not in snapshot and t.is_alive()
+            and t is not threading.current_thread()
+            and not any(t.name.startswith(p) for p in allow_prefixes)]
+        if not leaked or time.monotonic() >= deadline:
+            return leaked
+        time.sleep(0.02)
 
 
 def install_termination_handler() -> None:

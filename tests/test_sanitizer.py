@@ -1,0 +1,318 @@
+"""Runtime sanitizer tests (analysis/sanitizer.py + Config.sanitize).
+
+Covers the acceptance criteria: a sanitized pipeline run passes on
+clean synth input (serial and overlapped), while seeded violations —
+a NaN, an implicit device->host transfer, a use-after-donate, a
+wrong-thread touch, a leaked thread — are each trapped with an
+actionable message.  Plus the zero-cost-off contract: with
+``sanitize=False`` the pipeline holds no sanitizer and numpy stays
+unpatched.
+"""
+
+import threading
+import time
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from srtb_tpu.analysis import sanitizer as S
+from srtb_tpu.analysis.sanitizer import Sanitizer, SanitizerError
+from srtb_tpu.config import Config
+from srtb_tpu.io.synth import make_dispersed_baseband
+from srtb_tpu.pipeline.runtime import Pipeline
+from srtb_tpu.pipeline.segment import SegmentProcessor
+from srtb_tpu.pipeline.work import SegmentWork
+
+# ------------------------------------------------------------ fixtures
+
+
+@pytest.fixture(scope="module")
+def synth_file(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("sanitize")
+    n = 1 << 14
+    data = make_dispersed_baseband(n * 3, 1405.0, 64.0, 0.0,
+                                   pulse_positions=n, nbits=8)
+    path = str(tmp / "bb.bin")
+    data.tofile(path)
+    return path, n
+
+
+def _cfg(path, n, tmp_path, tag, **extra):
+    return Config(
+        baseband_input_count=n,
+        baseband_input_bits=8,
+        baseband_freq_low=1405.0,
+        baseband_bandwidth=64.0,
+        baseband_sample_rate=128e6,
+        input_file_path=path,
+        baseband_output_file_prefix=str(tmp_path / f"{tag}_"),
+        spectrum_channel_count=1 << 7,
+        mitigate_rfi_average_method_threshold=100.0,
+        mitigate_rfi_spectral_kurtosis_threshold=2.0,
+        baseband_reserve_sample=False,
+        writer_thread_count=0,
+        sanitize=True,
+        **extra)
+
+
+class _StubDetect(NamedTuple):
+    signal_counts: np.ndarray
+    zero_count: np.ndarray
+    time_series: np.ndarray
+
+
+def _stub_det(counts=0.0, nan=False):
+    ts = np.zeros(8, np.float32)
+    if nan:
+        ts[3] = np.nan
+    return _StubDetect(
+        signal_counts=np.full((1, 4), counts, np.float32),
+        zero_count=np.asarray(0), time_series=ts)
+
+
+class _StubProcessor:
+    def __init__(self, nan=False):
+        self.nan = nan
+
+    def process(self, raw):
+        return None, _stub_det(nan=self.nan)
+
+
+class _Source:
+    def __init__(self, n=3, seg_bytes=64):
+        self._it = iter(
+            SegmentWork(data=np.zeros(seg_bytes, np.uint8),
+                        timestamp=i + 1) for i in range(n))
+
+    def __iter__(self):
+        return self._it
+
+
+# ------------------------------------------------- acceptance: clean
+
+
+@pytest.mark.parametrize("window", [1, 3])
+def test_sanitized_pipeline_passes_on_clean_input(
+        synth_file, tmp_path, window):
+    path, n = synth_file
+    cfg = _cfg(path, n, tmp_path, f"ok{window}",
+               inflight_segments=window)
+    with Pipeline(cfg, sinks=[]) as pipe:
+        stats = pipe.run()
+    assert stats.segments == 3
+    # tripwire uninstalled: numpy is pristine again
+    assert not hasattr(np.asarray, "_srtb_sanitize_orig")
+    assert not hasattr(np.array, "_srtb_sanitize_orig")
+
+
+def test_sanitize_off_is_zero_cost(synth_file, tmp_path):
+    path, n = synth_file
+    cfg = _cfg(path, n, tmp_path, "off").replace(sanitize=False)
+    pipe = Pipeline(cfg, sinks=[])
+    assert pipe.sanitizer is None
+    with pipe:
+        assert pipe.run().segments == 3
+    assert not hasattr(np.asarray, "_srtb_sanitize_orig")
+
+
+# ------------------------------------------------------ NaN tripwire
+
+
+def test_seeded_nan_is_trapped(tmp_path):
+    cfg = Config(baseband_input_count=64, sanitize=True,
+                 baseband_output_file_prefix=str(tmp_path / "nan_"),
+                 inflight_segments=1)
+    pipe = Pipeline(cfg, source=_Source(), sinks=[],
+                    processor=_StubProcessor(nan=True))
+    with pytest.raises(SanitizerError, match="non-finite.*detect"):
+        pipe.run()
+
+
+def test_seeded_nan_trapped_through_sink_pipe(tmp_path):
+    # overlapped mode: the tripwire fires on the sink thread and must
+    # still fail the run loudly
+    cfg = Config(baseband_input_count=64, sanitize=True,
+                 baseband_output_file_prefix=str(tmp_path / "nan2_"),
+                 inflight_segments=2)
+    pipe = Pipeline(cfg, source=_Source(), sinks=[],
+                    processor=_StubProcessor(nan=True))
+    with pytest.raises(SanitizerError, match="non-finite"):
+        pipe.run()
+
+
+def test_check_finite_device_and_contract_units():
+    with pytest.raises(SanitizerError, match="stage_x"):
+        S.check_finite("stage_x", jnp.asarray([1.0, jnp.inf]))
+    S.check_finite("ok", jnp.arange(4.0))            # clean
+    S.check_finite("ints", np.arange(4))             # non-float leaf
+    wf = jnp.zeros((2, 1, 4, 4), jnp.float32)
+    S.check_contract("wf", wf, ndim=4, lead=2, dtype=np.float32)
+    with pytest.raises(SanitizerError, match="leading axis 2"):
+        S.check_contract("wf", wf[0], lead=2)
+    with pytest.raises(SanitizerError, match="expected ndim 4"):
+        S.check_contract("wf", wf[0], ndim=4)
+    with pytest.raises(SanitizerError, match="dtype drift"):
+        S.check_contract("wf", wf.astype(jnp.int32), dtype=np.float32)
+
+
+# ----------------------------------------- implicit-transfer tripwire
+
+
+def test_implicit_transfer_trapped_direct():
+    san = Sanitizer()
+    x = jnp.arange(8.0)
+    with san.run_scope():
+        with pytest.raises(SanitizerError, match="implicit.*transfer"):
+            np.asarray(x)
+        with pytest.raises(SanitizerError, match="implicit"):
+            np.array(x)
+        # the sanctioned explicit spelling stays allowed
+        assert jax.device_get(x)[3] == 3.0
+        # host data is unaffected
+        assert np.asarray([1, 2]).sum() == 3
+    # restored after the scope
+    assert np.asarray(x)[1] == 1.0
+
+
+def test_implicit_transfer_in_sink_trapped(synth_file, tmp_path):
+    path, n = synth_file
+
+    class BadSink:
+        wants_waterfall = True
+
+        def push(self, work, positive):
+            np.asarray(work.waterfall)  # implicit D2H on a device wf
+
+    cfg = _cfg(path, n, tmp_path, "bad", inflight_segments=2)
+    pipe = Pipeline(cfg, sinks=[BadSink()])
+    with pytest.raises(SanitizerError, match="device_get"):
+        pipe.run()
+    assert not hasattr(np.asarray, "_srtb_sanitize_orig")
+
+
+def test_nested_scopes_refcount():
+    a, b = Sanitizer(), Sanitizer()
+    x = jnp.arange(4.0)
+    with a.run_scope():
+        with b.run_scope():
+            with pytest.raises(SanitizerError):
+                np.asarray(x)
+        # still armed: outer scope alive
+        with pytest.raises(SanitizerError):
+            np.asarray(x)
+    assert np.asarray(x)[0] == 0.0
+
+
+# ------------------------------------------------- use-after-donate
+
+
+def _small_cfg(tmp_path, **extra):
+    return Config(baseband_input_count=1 << 12,
+                  baseband_input_bits=8,
+                  baseband_freq_low=1405.0, baseband_bandwidth=64.0,
+                  baseband_sample_rate=128e6,
+                  spectrum_channel_count=1 << 6,
+                  baseband_reserve_sample=False,
+                  baseband_output_file_prefix=str(tmp_path / "d_"),
+                  **extra)
+
+
+def test_use_after_donate_trapped(tmp_path):
+    cfg = _small_cfg(tmp_path, sanitize=True)
+    proc = SegmentProcessor(cfg, donate_input=True)
+    raw = proc.stage_input(
+        np.random.default_rng(0).integers(
+            0, 255, cfg.baseband_input_count, dtype=np.uint8))
+    wf, det = proc.run_device(raw)
+    assert np.isfinite(jax.device_get(det.time_series)).all()
+    # the donated input is now expired: any read raises loudly, on
+    # CPU too (where donation itself is a no-op)
+    with pytest.raises(RuntimeError, match="deleted"):
+        jax.device_get(raw)
+
+
+def test_no_expiry_without_sanitize(tmp_path):
+    cfg = _small_cfg(tmp_path, sanitize=False)
+    proc = SegmentProcessor(cfg, donate_input=True)
+    raw = proc.stage_input(
+        np.zeros(cfg.baseband_input_count, dtype=np.uint8))
+    proc.run_device(raw)
+    jax.device_get(raw)  # CPU donation is a no-op; nothing expired
+
+
+def test_staged_boundary_checks_run(tmp_path):
+    cfg = _small_cfg(tmp_path, sanitize=True)
+    proc = SegmentProcessor(cfg, staged=True, donate_input=True)
+    raw = proc.stage_input(
+        np.random.default_rng(1).integers(
+            0, 255, cfg.baseband_input_count, dtype=np.uint8))
+    wf, det = proc.run_device(raw)   # contracts + finite per boundary
+    assert wf.shape[0] == 2
+    with pytest.raises(RuntimeError, match="deleted"):
+        jax.device_get(raw)
+
+
+# ------------------------------------------------- thread ownership
+
+
+def test_thread_ownership_guard():
+    san = Sanitizer()
+    san.assert_owner("inflight_window")      # main claims
+    san.assert_owner("inflight_window")      # same thread: fine
+    err = []
+
+    def intruder():
+        try:
+            san.assert_owner("inflight_window")
+        except SanitizerError as e:
+            err.append(e)
+
+    t = threading.Thread(target=intruder)
+    t.start()
+    t.join()
+    assert err and "thread-ownership violation" in str(err[0])
+    san.release_owners()
+    # after release the state is claimable again
+    san.assert_owner("inflight_window")
+
+
+# ------------------------------------------------ leaked-thread check
+
+
+def test_leaked_thread_trapped():
+    san = Sanitizer()
+    stop = threading.Event()
+    leaker = threading.Thread(target=stop.wait, name="leaky_sink",
+                              daemon=True)
+    try:
+        with pytest.raises(SanitizerError, match="leaky_sink"):
+            with san.run_scope():
+                leaker.start()
+    finally:
+        stop.set()
+        leaker.join()
+
+
+def test_joined_thread_is_clean():
+    san = Sanitizer()
+    with san.run_scope():
+        t = threading.Thread(target=lambda: time.sleep(0.01))
+        t.start()
+        t.join()
+
+
+def test_leaked_threads_helper_allows_pools():
+    from srtb_tpu.utils import termination
+    snap = termination.thread_snapshot()
+    done = threading.Event()
+    t = threading.Thread(target=done.wait,
+                         name="ThreadPoolExecutor-9_0", daemon=True)
+    t.start()
+    try:
+        assert termination.leaked_threads(snap, grace_s=0.0) == []
+    finally:
+        done.set()
+        t.join()
