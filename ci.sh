@@ -24,13 +24,13 @@ if [ "${1:-}" = "--hardware" ]; then
   exit 0
 fi
 
-echo "== [1/8] native build =="
+echo "== [1/9] native build =="
 make -C srtb_tpu/native
 
-echo "== [2/8] native sanitizer harness (ASan/UBSan) =="
+echo "== [2/9] native sanitizer harness (ASan/UBSan) =="
 make -C srtb_tpu/native check
 
-echo "== [3/8] static checks (compile + import) =="
+echo "== [3/9] static checks (compile + import) =="
 python -m compileall -q srtb_tpu tests bench.py __graft_entry__.py
 python - <<'EOF'
 import importlib, pkgutil
@@ -45,12 +45,12 @@ assert not bad, bad
 print(f"all srtb_tpu modules import cleanly")
 EOF
 
-echo "== [4/8] srtb-lint (static analysis vs baseline) =="
+echo "== [4/9] srtb-lint (static analysis vs baseline) =="
 # fails on findings not in srtb_tpu/analysis/baseline.json; accept an
 # intentional finding with --write-baseline + a note, or a pragma
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.lint srtb_tpu/
 
-echo "== [5/8] pytest (8-device CPU mesh) =="
+echo "== [5/9] pytest (8-device CPU mesh) =="
 FAST_ARGS=()
 if [ "${1:-}" = "--fast" ]; then
   # one source of truth for what "slow" means: the pytest marker
@@ -59,10 +59,10 @@ if [ "${1:-}" = "--fast" ]; then
 fi
 python -m pytest tests/ -q "${FAST_ARGS[@]}"
 
-echo "== [6/8] bench smoke =="
+echo "== [6/9] bench smoke =="
 JAX_PLATFORMS=cpu SRTB_BENCH_LOG2N=16 python bench.py | tail -1
 
-echo "== [7/8] telemetry + sanitizer smoke (journal + report + /metrics + /healthz + Config.sanitize) =="
+echo "== [7/9] telemetry + sanitizer smoke (journal + report + /metrics + /healthz + Config.sanitize) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import json, os, tempfile, urllib.request
 
@@ -94,10 +94,12 @@ assert stats.segments >= 2, stats
 # journal non-empty and parseable by telemetry_report
 recs = TR.load(journal)
 assert recs, "telemetry journal is empty"
-# schema-v2 span fields (async engine) parse on every record
+# schema-v3 span fields (async engine + resilience) on every record
 for rec in recs:
-    assert rec["v"] == 2, rec
+    assert rec["v"] == 3, rec
     assert "overlap_hidden_ms" in rec and rec["inflight_depth"] >= 1, rec
+    for key in ("degrade_level", "retries", "requeues", "restarts"):
+        assert key in rec, (key, rec)
 rep = TR.report(journal)
 for stage in ("ingest", "dispatch", "fetch", "sink", "overlap"):
     assert rep["stages"][stage]["count"] == stats.segments, (stage, rep)
@@ -117,7 +119,7 @@ try:
 finally:
     srv.stop()
 print(f"telemetry smoke OK: {stats.segments} segments, "
-      f"{len(recs)} v2 spans, overlap stage live, "
+      f"{len(recs)} v3 spans, overlap stage live, "
       "/metrics + /healthz live")
 
 # one short pipeline with the runtime sanitizer armed: transfer
@@ -136,7 +138,85 @@ print(f"sanitizer smoke OK: {stats_s.segments} segments with "
       "Config.sanitize on, tripwire restored")
 EOF
 
-echo "== [8/8] multichip dryrun (8 virtual devices) =="
+echo "== [8/9] fault-injection smoke (one transient fault at every site -> recovery + v3 telemetry) =="
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json, os, tempfile
+
+import numpy as np
+
+from srtb_tpu.config import Config
+from srtb_tpu.io.synth import make_dispersed_baseband
+from srtb_tpu.pipeline.runtime import Pipeline
+from srtb_tpu.pipeline.segment import SegmentProcessor
+from srtb_tpu.tools import telemetry_report as TR
+from srtb_tpu.utils.metrics import metrics
+
+tmp = tempfile.mkdtemp(prefix="srtb_ci_fault_")
+n = 1 << 14
+make_dispersed_baseband(n * 4, 1405.0, 64.0, 0.0, pulse_positions=n,
+                        nbits=8).tofile(os.path.join(tmp, "bb.bin"))
+
+def cfg(tag, **kw):
+    return Config(baseband_input_count=n, baseband_input_bits=8,
+                  baseband_freq_low=1405.0, baseband_bandwidth=64.0,
+                  baseband_sample_rate=128e6,
+                  input_file_path=os.path.join(tmp, "bb.bin"),
+                  baseband_output_file_prefix=os.path.join(tmp, tag),
+                  spectrum_channel_count=1 << 8,
+                  mitigate_rfi_average_method_threshold=100.0,
+                  mitigate_rfi_spectral_kurtosis_threshold=2.0,
+                  baseband_reserve_sample=False, writer_thread_count=0,
+                  inflight_segments=2, retry_backoff_base_s=0.001, **kw)
+
+class Cap:
+    def __init__(self): self.out = []
+    def push(self, w, p):
+        d = w.detect
+        self.out.append((np.asarray(d.signal_counts).copy(),
+                         np.asarray(d.zero_count).copy()))
+
+proc = SegmentProcessor(cfg("p_"))
+metrics.reset()
+clean = Cap()
+with Pipeline(cfg("clean_"), sinks=[clean], processor=proc) as pipe:
+    st0 = pipe.run()
+
+metrics.reset()
+plan = ("ingest:raise@1,h2d:raise@1,dispatch:raise@2,fetch:raise@2,"
+        "sink_write:raise@3,checkpoint:raise@3")
+faulted = Cap()
+journal = os.path.join(tmp, "faults.jsonl")
+with Pipeline(cfg("fault_", fault_plan=plan,
+                  checkpoint_path=os.path.join(tmp, "ck.json"),
+                  telemetry_journal_path=journal),
+              sinks=[faulted], processor=proc) as pipe:
+    st1 = pipe.run()
+    assert pipe.faults.unfired() == [], pipe.faults.unfired()
+
+# recovery: same segment count, bit-identical detections, no loss
+assert st1.segments == st0.segments, (st1, st0)
+for (a, b), (c, d) in zip(clean.out, faulted.out):
+    np.testing.assert_array_equal(a, c)
+    np.testing.assert_array_equal(b, d)
+assert metrics.get("retries_total") == 6, metrics.get("retries_total")
+assert metrics.get("segments_dropped") == 0
+prom = metrics.prometheus()
+assert "srtb_retries_total 6" in prom, prom[:400]
+assert "srtb_faults_injected 6" in prom
+# v3 journal fields + report resilience section
+recs = TR.load(journal)
+assert recs and all(r["v"] == 3 for r in recs)
+# the checkpoint-site retry of the last segment lands after that
+# segment's journal write: the final record carries 5 of the 6
+assert recs[-1]["retries"] == 5 and recs[-1]["requeues"] == 0
+rep = TR.report(journal)
+assert rep["resilience"]["retries"] == 5, rep["resilience"]
+print(f"fault-injection smoke OK: {st1.segments} segments recovered "
+      "bit-identical through 6 injected faults, retries accounted in "
+      "/metrics + v3 journal")
+EOF
+
+echo "== [9/9] multichip dryrun (8 virtual devices) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

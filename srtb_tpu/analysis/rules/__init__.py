@@ -6,8 +6,10 @@ the baseline), ``DOC`` (one line for ``--list-rules``) and
 """
 
 from srtb_tpu.analysis.rules import (donate, dtype_drift, host_sync,
-                                     recompile, shared_state)
+                                     recompile, shared_state,
+                                     swallowed_except)
 
-ALL_RULES = (host_sync, donate, recompile, dtype_drift, shared_state)
+ALL_RULES = (host_sync, donate, recompile, dtype_drift, shared_state,
+             swallowed_except)
 
 RULE_IDS = tuple(r.RULE for r in ALL_RULES)

@@ -1,0 +1,102 @@
+"""swallowed-except: a bare/overbroad except in pipeline/io code that
+drops the exception without logging, re-raising, or recording it.
+
+The streaming runtime's whole resilience story rests on failures being
+*classified and accounted* (resilience/errors.py): a handler that
+catches ``Exception``/``BaseException`` (or everything) and silently
+discards it removes a failure from the taxonomy entirely — it can
+never be retried, escalated, or even seen on /metrics.  Narrow
+catches (``OSError``, ``queue.Empty``, ...) are out of scope: a named
+exception type is itself a documented decision.
+
+A handler counts as *handling* the exception when its body re-raises
+(any ``raise``), calls a logging-ish function (``log.*``,
+``logging.*``, ``logger.*``, ``warnings.warn``), or reads the bound
+exception name (storing it, formatting it, returning it).  Scope is
+restricted to pipeline/ and io/ modules — the hot path where a
+swallowed failure becomes silent data loss; elsewhere (GUI taps,
+best-effort telemetry) broad swallows can be a deliberate
+availability choice.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from srtb_tpu.analysis.core import Finding
+
+RULE = "swallowed-except"
+DOC = ("bare/overbroad except in pipeline/io code that drops the "
+       "exception without logging or re-raising")
+
+_SCOPES = ("pipeline/", "io/")
+_BROAD = {"Exception", "BaseException"}
+_LOGGISH = ("log", "logging", "logger", "warnings")
+
+
+def _in_scope(rel: str) -> bool:
+    rel = rel.replace("\\", "/")
+    return any(f"/{s}" in f"/{rel}" for s in _SCOPES)
+
+
+def _is_broad(type_node) -> bool:
+    """Bare except, Exception/BaseException (possibly dotted), or a
+    tuple containing one."""
+    if type_node is None:
+        return True
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(e) for e in type_node.elts)
+    name = None
+    if isinstance(type_node, ast.Name):
+        name = type_node.id
+    elif isinstance(type_node, ast.Attribute):
+        name = type_node.attr
+    return name in _BROAD
+
+
+def _handles(handler: ast.ExceptHandler) -> bool:
+    bound = handler.name
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Name) and node.id == bound \
+                and isinstance(node.ctx, ast.Load):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            root = f
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id in _LOGGISH:
+                return True
+    return False
+
+
+def check(project, mod):
+    if not _in_scope(mod.rel):
+        return
+    # map line -> enclosing function qualname for finding context
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad(node.type):
+            continue
+        if _handles(node):
+            continue
+        enclosing = None
+        for info in mod.functions.values():
+            f = info.node
+            end = getattr(f, "end_lineno", f.lineno)
+            if f.lineno <= node.lineno <= end and (
+                    enclosing is None
+                    or f.lineno > enclosing.node.lineno):
+                enclosing = info  # innermost = latest-starting
+        context = enclosing.qualname if enclosing else "<module>"
+        caught = ("everything" if node.type is None
+                  else ast.unparse(node.type))
+        yield Finding(
+            RULE, mod.path, mod.rel, node.lineno, node.col_offset,
+            f"catches {caught} and drops the exception (no raise, no "
+            "logging, bound name unused) — classify it "
+            "(resilience/errors.py), log it, or narrow the except",
+            context, mod.line_text(node.lineno))

@@ -160,6 +160,60 @@ class Config:
     # termination handler (loud stacktrace), matching the reference's
     # fail-loudly philosophy (ref: util/termination_handler.hpp)
     segment_deadline_s: float = 0.0
+    # ---- resilience (srtb_tpu/resilience/) ----
+    # retry budget for the pipeline's guarded operations (ingest read,
+    # H2D staging, dispatch, fetch, sink write, checkpoint flush);
+    # includes the first attempt, <= 1 disables retries entirely
+    # (zero-cost-off, like the sanitizer).  Only failures classified
+    # transient/data-loss by resilience/errors.py are retried.
+    retry_max_attempts: int = 3
+    # exponential backoff: base * 2^(attempt-1), capped, with
+    # deterministic +/-25% jitter (hash of site+attempt, not random)
+    retry_backoff_base_s: float = 0.05
+    retry_backoff_max_s: float = 2.0
+    # total wall-clock budget of one guarded operation including its
+    # backoff sleeps (0 = unbounded): bounds worst-case added latency
+    retry_deadline_s: float = 0.0
+    # segment watchdog: with segment_deadline_s > 0, an in-flight
+    # segment whose fetch never becomes ready within the deadline is
+    # cancelled and re-dispatched up to this many times before the
+    # run escalates (0 keeps the legacy abort-on-deadline behavior).
+    # Scope: the requeue covers the drain-head COMPUTE wedge (results
+    # never materialize).  A wedge inside a blocking D2H transfer that
+    # already started (the sink's lazy multi-GB waterfall fetch) is
+    # uninterruptible from Python and still takes the legacy fail-fast
+    # abort after segment_deadline_s — loud exit over a silent hang.
+    segment_watchdog_requeues: int = 0
+    # bounded restarts for crashed workers (sink drain pipe, GUI
+    # server): this many restarts within supervisor_window_s, then
+    # escalation to clean shutdown; 0 disables supervision (every
+    # crash propagates immediately, the pre-resilience behavior)
+    supervisor_max_restarts: int = 3
+    supervisor_window_s: float = 60.0
+    # graceful-degradation ladder (resilience/degrade.py): under
+    # sustained sink backlog or accounted loss, shed waterfall dumps,
+    # then baseband dumps, then name whole-segment loss.  Hysteresis:
+    # step after degrade_hold_segments consecutive drains above
+    # degrade_queue_high occupancy; recover below degrade_queue_low.
+    degrade_enable: bool = True
+    degrade_queue_high: float = 0.9
+    degrade_queue_low: float = 0.25
+    degrade_hold_segments: int = 3
+    # deterministic fault injection (resilience/faults.py):
+    # "site:action@index,..." with sites ingest|h2d|dispatch|fetch|
+    # sink_write|checkpoint and actions raise|fatal|corrupt|
+    # stall=SECONDS; "" = off (zero cost)
+    fault_plan: str = ""
+    # bounded join of worker threads at shutdown (pipeline sink pipe,
+    # ThreadedPipeline drain): on expiry the wedged thread is reported
+    # (name + stack) via utils/termination, still-queued segments are
+    # accounted as segments_dropped, and shutdown proceeds WITHOUT
+    # flushing the wedged sink's writer pools.  0 (default) waits
+    # forever: a slow-but-healthy final flush of a multi-GB waterfall
+    # must not be cut short and silently lose dumps — arm this only
+    # for real-time deployments that prefer bounded exit over
+    # completeness (recommended 120-300 there).
+    shutdown_join_timeout_s: float = 0.0
     # segment-span telemetry journal: one JSONL record per processed
     # segment (per-stage wall clock, queue depth, loss counters,
     # detection count, dump decision — utils/telemetry.py); "" disables.
@@ -215,7 +269,9 @@ class Config:
         "writer_thread_count", "distributed_num_processes",
         "distributed_process_id", "gui_scroll_lines",
         "telemetry_journal_max_bytes", "inflight_segments",
-        "micro_batch_segments",
+        "micro_batch_segments", "retry_max_attempts",
+        "segment_watchdog_requeues", "supervisor_max_restarts",
+        "degrade_hold_segments",
     })
     _FLOAT_FIELDS = frozenset({
         "baseband_freq_low", "baseband_bandwidth", "baseband_sample_rate",
@@ -223,11 +279,15 @@ class Config:
         "mitigate_rfi_spectral_kurtosis_threshold",
         "signal_detect_signal_noise_threshold",
         "signal_detect_channel_threshold", "segment_deadline_s",
-        "health_stale_after_s",
+        "health_stale_after_s", "retry_backoff_base_s",
+        "retry_backoff_max_s", "retry_deadline_s",
+        "supervisor_window_s", "degrade_queue_high",
+        "degrade_queue_low", "shutdown_join_timeout_s",
     })
     _BOOL_FIELDS = frozenset({
         "baseband_reserve_sample", "baseband_write_all", "gui_enable",
         "use_emulated_fp64", "use_pallas", "use_pallas_sk", "sanitize",
+        "degrade_enable",
     })
     _LIST_FIELDS = frozenset({
         "udp_receiver_address", "udp_receiver_port",

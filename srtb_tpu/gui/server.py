@@ -259,19 +259,46 @@ class _Handler(http.server.BaseHTTPRequestHandler):
 
 
 class WaterfallHTTPServer:
-    """Serve the waterfall PNG directory on a background thread."""
+    """Serve the waterfall PNG directory on a background thread.
+
+    The serve thread is supervised (resilience/supervisor.py): if
+    ``serve_forever`` dies — a momentary OS-level failure of the
+    accept loop — it is restarted with a bounded budget instead of
+    silently leaving the observation without its live view.  The GUI
+    is best-effort, so the supervisor restarts regardless of the
+    error's classification; an exhausted budget logs and gives up
+    (never takes the pipeline down)."""
 
     def __init__(self, directory: str, port: int = 0,
                  address: str = "127.0.0.1",
-                 health_stale_after_s: float = 30.0):
+                 health_stale_after_s: float = 30.0,
+                 supervisor=None):
         handler = type("Handler", (_Handler,), {
             "directory": directory,
             "health_stale_after_s": health_stale_after_s})
         self._httpd = http.server.ThreadingHTTPServer((address, port),
                                                       handler)
         self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
+        if supervisor is None:
+            from srtb_tpu.resilience.supervisor import Supervisor
+            supervisor = Supervisor("gui_server", max_restarts=3,
+                                    restart_fatal=True)
+        self._supervisor = supervisor
+        self._stopping = False
+        self._thread = threading.Thread(target=self._serve,
+                                        name="srtb-gui-server",
                                         daemon=True)
+
+    def _serve(self):
+        while True:
+            try:
+                self._httpd.serve_forever()
+                return  # shutdown() was called: clean exit
+            except Exception as e:  # noqa: BLE001 - supervised restart
+                if self._stopping or \
+                        not self._supervisor.should_restart(e):
+                    log.error(f"[gui] server thread giving up: {e!r}")
+                    return
 
     def start(self) -> "WaterfallHTTPServer":
         self._thread.start()
@@ -279,6 +306,7 @@ class WaterfallHTTPServer:
         return self
 
     def stop(self):
+        self._stopping = True
         self._httpd.shutdown()
         self._httpd.server_close()
         # join the serve_forever thread: shutdown() only signals it,

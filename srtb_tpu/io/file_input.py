@@ -57,7 +57,15 @@ class BasebandFileReader:
         if self._exhausted:
             raise StopIteration
         buf = self.pool.acquire(self.segment_bytes)
-        chunk = self._file.read(self.segment_bytes)
+        try:
+            chunk = self._file.read(self.segment_bytes)
+        except BaseException:
+            # a failed read may be retried by the pipeline's ingest
+            # guard, which calls __next__ again and acquires a fresh
+            # buffer — this one must go back or every retried
+            # transient strands a segment-sized block in the pool
+            self.pool.release(buf)
+            raise
         if len(chunk) == 0:
             self.pool.release(buf)
             log.info(f"[read_file] {self.cfg.input_file_path} has been read")

@@ -2,7 +2,7 @@
 
 Python-side interface over the native C++ writer-thread pool
 (``srtb_tpu/native/file_writer.cpp``, built to ``libsrtb_writer.so``), with
-a pure-Python ``ThreadPoolExecutor`` fallback implementing the same
+a pure-Python daemon-thread pool fallback implementing the same
 (path, bytes, fsync) job semantics.
 
 The reference writes candidates asynchronously from two
@@ -18,11 +18,14 @@ from __future__ import annotations
 
 import ctypes
 import os
+import queue
 import threading
 import weakref
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import Future
 
 import numpy as np
+
+from srtb_tpu.utils.logging import log
 
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "..", "native",
                          "libsrtb_writer.so")
@@ -52,6 +55,67 @@ def _load_native():
 _NATIVE = _load_native()
 
 
+class _DaemonWriterPool:
+    """Minimal Future-based thread pool with DAEMON workers, lazily
+    spawned on first submit (like the executor it replaces).
+
+    ``concurrent.futures`` executors use non-daemon threads, which
+    ``threading._shutdown`` joins at interpreter exit no matter what —
+    dropping them from that module's own exit registry only skips *its*
+    join, so a wedged write abandoned by ``close(drain=False)`` would
+    still hang process exit.  Daemon workers actually die with the
+    process; a ``weakref.finalize`` in ``AsyncWriterPool`` (mirroring
+    the native pool's) keeps the flush-at-exit behavior for pools that
+    are never explicitly closed."""
+
+    def __init__(self, n_threads: int, name_prefix: str = "srtb-writer"):
+        self.n_threads = n_threads
+        self.name_prefix = name_prefix
+        self._jobs: queue.SimpleQueue = queue.SimpleQueue()
+        self._threads: list[threading.Thread] = []
+
+    def _work(self):
+        while True:
+            job = self._jobs.get()
+            if job is None:
+                return
+            fut, fn, args = job
+            if not fut.set_running_or_notify_cancel():
+                continue  # cancelled while still queued
+            try:
+                fut.set_result(fn(*args))
+            except BaseException as e:  # noqa: BLE001 - delivered via result()
+                fut.set_exception(e)
+
+    def submit(self, fn, *args) -> Future:
+        if not self._threads:  # lazy spawn; callers serialize submits
+            self._threads = [
+                threading.Thread(target=self._work, daemon=True,
+                                 name=f"{self.name_prefix}_{i}")
+                for i in range(self.n_threads)]
+            for t in self._threads:
+                t.start()
+        fut = Future()
+        self._jobs.put((fut, fn, args))
+        return fut
+
+    def shutdown(self, wait: bool = True,
+                 cancel_futures: bool = False) -> None:
+        if cancel_futures:
+            while True:
+                try:
+                    job = self._jobs.get_nowait()
+                except queue.Empty:
+                    break
+                if job is not None:
+                    job[0].cancel()
+        for _ in self._threads:
+            self._jobs.put(None)
+        if wait:
+            for t in self._threads:
+                t.join()
+
+
 def native_available() -> bool:
     return _NATIVE is not None
 
@@ -60,8 +124,8 @@ class AsyncWriterPool:
     """Thread-pool writer for (path, bytes, fsync, append) jobs.
 
     Uses the native C++ pool when ``libsrtb_writer.so`` is built (run
-    ``make -C srtb_tpu/native``), otherwise a Python thread pool with
-    identical semantics.
+    ``make -C srtb_tpu/native``), otherwise a Python daemon-thread pool
+    with identical semantics.
     """
 
     DEFAULT_MAX_QUEUED_BYTES = 1 << 30  # 1 GiB of queued payload copies
@@ -93,10 +157,13 @@ class AsyncWriterPool:
         else:
             self._lib = None
             self._h = None
-            self._pool = ThreadPoolExecutor(
-                max_workers=self.n_threads,
-                thread_name_prefix="srtb-writer")
+            self._pool = _DaemonWriterPool(self.n_threads)
             self._futures = []
+            # flush-at-exit / at-GC for pools never close()d, like the
+            # native pool's drain+destroy finalizer (queued jobs finish
+            # before the sentinel; daemon workers would otherwise die
+            # mid-queue with the process)
+            self._finalizer = weakref.finalize(self, self._pool.shutdown)
 
     @property
     def is_native(self) -> bool:
@@ -151,14 +218,27 @@ class AsyncWriterPool:
         # window shrinks permanently and later submits block forever
         ok = False
         try:
-            with open(path, "ab" if append else "wb") as f:
-                f.write(payload)
-                f.flush()
-                if fsync:
-                    os.fdatasync(f.fileno())
+            if append:
+                with open(path, "ab") as f:
+                    f.write(payload)
+                    f.flush()
+                    if fsync:
+                        os.fdatasync(f.fileno())
+            else:
+                # crash-consistent like the synchronous writer path
+                # (shared helper: temp + flush (+ fdatasync) + atomic
+                # rename, torn temp dropped on failure) so a worker
+                # dying mid-write leaves an orphan temp (swept at
+                # startup by io.writers.recover_orphan_temps), not a
+                # torn file.  Appends stay in-place by nature.
+                from srtb_tpu.io.writers import atomic_write
+                atomic_write(path, payload, fsync=fsync)
             ok = True
         except OSError:
-            pass  # counted below; surfaced via raise_new_errors()
+            # counted below; surfaced via raise_new_errors().  Anything
+            # non-OSError (MemoryError, a bad payload) propagates to
+            # the future instead.
+            pass
         finally:
             with self._space:
                 self._py_jobs += 1
@@ -204,13 +284,35 @@ class AsyncWriterPool:
                     "bytes_written": self._py_bytes,
                     "errors": self._py_errors}
 
-    def close(self) -> None:
+    def close(self, drain: bool = True) -> None:
+        """``drain=False`` abandons queued/stuck writes instead of
+        waiting for them: the bounded-shutdown path uses it when a
+        writer is known-wedged (e.g. an NFS-stalled write) — waiting
+        would hang exactly the shutdown the caller just bounded.  The
+        native pool is deliberately leaked in that case (its destroy
+        joins the stuck C++ threads); the Python pool's workers are
+        left to die with the process."""
         if self._h is not None:
-            self._finalizer()  # idempotent drain + destroy
+            if drain:
+                self._finalizer()  # idempotent drain + destroy
+            else:
+                self._finalizer.detach()
+                log.warning("[writer_pool] abandoning native pool "
+                            "without drain (wedged writes)")
             self._h = None
         elif self._pool is not None:
-            self.drain()
-            self._pool.shutdown(wait=True)
+            if drain:
+                self.drain()
+                self._finalizer()  # idempotent sentinel + join
+            else:
+                # cancel still-queued jobs (idle workers exit on the
+                # sentinel) and let the DAEMON workers die with the
+                # process: a wedged write must not hang the very
+                # shutdown this path exists to bound
+                self._finalizer.detach()
+                self._pool.shutdown(wait=False, cancel_futures=True)
+                log.warning("[writer_pool] abandoning queued writes "
+                            "without drain (wedged writes)")
             self._pool = None
 
     def __enter__(self):

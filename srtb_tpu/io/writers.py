@@ -19,6 +19,7 @@ helpers (src/plot_spectrum.py, plot_tim.py) work unmodified:
 from __future__ import annotations
 
 import os
+import time
 from collections import deque
 from dataclasses import dataclass
 
@@ -27,6 +28,79 @@ import numpy as np
 from srtb_tpu.config import Config
 from srtb_tpu.pipeline.work import (NO_UDP_PACKET_COUNTER, SegmentResultWork)
 from srtb_tpu.utils.logging import log
+from srtb_tpu.utils.metrics import metrics
+
+# crash consistency: candidate files are written to <path>.srtb_tmp
+# and atomically renamed into place, so a reader (or a restarted run)
+# never sees a torn half-written candidate; a crash between write and
+# rename leaves only an orphan temp, removed by the startup sweep
+TMP_SUFFIX = ".srtb_tmp"
+
+
+def recover_orphan_temps(prefix: str,
+                         min_age_s: float = 60.0) -> list[str]:
+    """Startup recovery sweep: remove ``<prefix>*.srtb_tmp`` orphans
+    left by a run that died between a temp write and its atomic
+    rename.  Returns the removed paths; every removal is counted
+    (``orphan_temps_removed``) and logged — an interrupted dump is a
+    data-loss event, not housekeeping.
+
+    Only temps whose mtime is older than ``min_age_s`` are swept: a
+    fresh temp may belong to a LIVE writer sharing the output prefix
+    (a concurrent pipeline process, or the previous run's async pool
+    still flushing), and unlinking it mid-write would turn that
+    healthy atomic write into a failure.  A true orphan missed by the
+    age guard (crash + restart within the window) is swept on the
+    next startup and is harmless meanwhile."""
+    d = os.path.dirname(prefix) or "."
+    base = os.path.basename(prefix)
+    removed = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return removed
+    now = time.time()
+    for name in names:
+        if name.startswith(base) and name.endswith(TMP_SUFFIX):
+            p = os.path.join(d, name)
+            try:
+                if now - os.path.getmtime(p) < min_age_s:
+                    log.warning(f"[recover] leaving fresh temp {p} "
+                                "(possibly a live writer's)")
+                    continue
+                os.unlink(p)
+                removed.append(p)
+            except OSError as e:
+                log.warning(f"[recover] cannot remove orphan {p}: {e}")
+    if removed:
+        metrics.add("orphan_temps_removed", len(removed))
+        log.warning(f"[recover] removed {len(removed)} orphaned temp "
+                    f"file(s) from an interrupted run: "
+                    f"{[os.path.basename(p) for p in removed]}")
+    return removed
+
+
+def atomic_write(path: str, payload, *, fsync: bool = False) -> None:
+    """Crash-consistent write: temp + flush (+ optional fdatasync) +
+    atomic rename.  A crash mid-write leaves only the orphan temp for
+    the startup sweep; a *failed* write from a live run drops its temp
+    so it cannot read as an interrupted-run orphan next startup.  The
+    native C++ pool implements the same sequence with the same suffix
+    (native/file_writer.cpp)."""
+    tmp = path + TMP_SUFFIX
+    try:
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            if fsync:
+                os.fdatasync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass  # never created, or the disk is truly gone
+        raise
 
 
 def _npy_bytes(arr: np.ndarray) -> np.ndarray:
@@ -56,6 +130,10 @@ class WriteSignalSink:
     ``drain()`` before reading the files back.
     """
 
+    # degradation ladder level >= 2 skips this sink entirely (shed
+    # baseband/candidate dumps before shedding whole segments)
+    sheddable = True
+
     def __init__(self, cfg: Config, fdatasync: bool = True,
                  writer_pool=None):
         self.cfg = cfg
@@ -65,6 +143,17 @@ class WriteSignalSink:
         self.recent_positive_timestamps: deque[int] = deque()
         self.recent_negative_works: deque[SegmentResultWork] = deque()
         self.written: list[CandidateFiles] = []
+        # retry re-entry state (see push/_write): the pipeline's
+        # sink_write retry calls push() again after a transient
+        # mid-write failure, and the replay must be idempotent — no
+        # duplicated deque entries, and the partially written segment
+        # keeps its already-picked .npy paths instead of spilling the
+        # same waterfall under fresh indices.  Keyed on the SEGMENT
+        # (identity + metadata): each retry attempt wraps it in a
+        # fresh SegmentResultWork (runtime._push_sinks), so the work
+        # object itself is not stable across attempts
+        self._inflight_key: tuple | None = None
+        self._inflight_npy: dict[int, str] = {}
         # check directory writability up front (ref: write_signal_pipe.hpp:62-75)
         check_path = cfg.baseband_output_file_prefix + ".check"
         with open(check_path, "wb"):
@@ -96,22 +185,43 @@ class WriteSignalSink:
 
         to_write = None
         if has_signal:
-            self.recent_positive_timestamps.append(ts)
+            # idempotent under retry re-entry: the same segment pushed
+            # again (transient failure later in this push) must not
+            # stamp the overlap window twice
+            if not self.recent_positive_timestamps \
+                    or self.recent_positive_timestamps[-1] != ts:
+                self.recent_positive_timestamps.append(ts)
             to_write = work
         elif real_time and self._overlaps_recent_positive(ts):
             # other-polarization piggyback (ref: write_signal_pipe.hpp:102-115)
             to_write = work
         elif real_time:
-            self.recent_negative_works.append(work)
+            # segment identity, not work identity: a pipeline retry
+            # re-enters with a fresh SegmentResultWork around the SAME
+            # segment, and the piggyback deque must not hold it twice
+            if not self.recent_negative_works \
+                    or self.recent_negative_works[-1].segment \
+                    is not work.segment:
+                self.recent_negative_works.append(work)
 
-        # re-check old negatives against new positives (ref: 122-140)
+        # re-check old negatives against new positives (ref: 122-140).
+        # Peek, don't pop: a transient _write failure re-enters this
+        # push via the pipeline's sink_write retry, and a popped-but-
+        # unwritten piggyback candidate would be silently lost (the
+        # retry would pop — and mis-schedule — the NEXT negative)
+        popped_negative = False
         if real_time and to_write is None and self.recent_negative_works:
-            work_2 = self.recent_negative_works.popleft()
+            work_2 = self.recent_negative_works[0]
             if self._overlaps_recent_positive(work_2.segment.timestamp):
                 to_write = work_2
+                popped_negative = True
+            else:
+                self.recent_negative_works.popleft()
 
         if to_write is not None:
             self._write(to_write)
+            if popped_negative:
+                self.recent_negative_works.popleft()
 
         # bound the negative queue (the reference relies on deque churn; we
         # cap explicitly to one overlap window's worth of segments)
@@ -125,6 +235,18 @@ class WriteSignalSink:
         if counter == NO_UDP_PACKET_COUNTER:
             counter = work.segment.timestamp
         base = self.cfg.baseband_output_file_prefix + str(counter)
+        # a retry of this same segment (transient failure partway
+        # through) must reuse the .npy paths the first attempt picked
+        # — the find-first-free scan below would otherwise see its own
+        # partial output and assign the same waterfall a fresh index.
+        # The key is the segment's identity + metadata (each retry
+        # attempt builds a fresh work wrapper; the metadata guards the
+        # freak case of a recycled id after an abandoned failure)
+        key = (id(work.segment), work.segment.timestamp,
+               work.segment.udp_packet_counter)
+        if self._inflight_key != key:
+            self._inflight_key = key
+            self._inflight_npy = {}
         log.info(f"[write_signal] begin writing, file_counter = {counter}")
 
         bin_path = base + ".bin"
@@ -145,13 +267,17 @@ class WriteSignalSink:
             if wf.ndim == 2:
                 wf = wf[None]
             for i in range(wf.shape[0]):
-                # pick first non-existing index (ref: 230-235); with an
-                # async pool queued-but-unwritten paths count as taken
-                j = i
-                while (os.path.exists(f"{base}.{j}.npy")
-                       or f"{base}.{j}.npy" in self._assigned_paths):
-                    j += 1
-                path = f"{base}.{j}.npy"
+                path = self._inflight_npy.get(i)
+                if path is None:
+                    # pick first non-existing index (ref: 230-235);
+                    # with an async pool queued-but-unwritten paths
+                    # count as taken
+                    j = i
+                    while (os.path.exists(f"{base}.{j}.npy")
+                           or f"{base}.{j}.npy" in self._assigned_paths):
+                        j += 1
+                    path = f"{base}.{j}.npy"
+                    self._inflight_npy[i] = path
                 self._write_bytes(path, _npy_bytes(wf[i].astype(np.complex64)))
                 npy_paths.append(path)
 
@@ -178,6 +304,10 @@ class WriteSignalSink:
                         tim_paths.append(path)
 
         self.written.append(CandidateFiles(bin_path, npy_paths, tim_paths))
+        # completed: the next _write (even for a same-counter
+        # piggyback) must pick fresh indices, not reuse these
+        self._inflight_key = None
+        self._inflight_npy = {}
         log.info(f"[write_signal] finished writing, file_counter = {counter}")
 
     def _write_bytes(self, path: str, data: np.ndarray, *,
@@ -192,11 +322,9 @@ class WriteSignalSink:
             self._assigned_paths.add(path)
             self.pool.submit(path, data, fsync=fsync)
             return
-        with open(path, "wb") as f:
-            f.write(data.tobytes())
-            f.flush()
-            if fsync:
-                os.fdatasync(f.fileno())
+        # crash-consistent: a crash mid-write leaves an orphan temp
+        # (swept at startup), never a torn candidate file
+        atomic_write(path, data.tobytes(), fsync=fsync)
 
     def drain(self) -> None:
         """Wait for queued async writes to land (no-op when synchronous).
@@ -222,6 +350,8 @@ class WriteAllSink:
     in the pipe body).  Passing a **single-thread** ``writer_pool`` makes
     appends asynchronous while keeping their order.
     """
+
+    sheddable = True  # degradation ladder: baseband dumps shed at L2
 
     def __init__(self, cfg: Config, reserved_bytes: int,
                  data_stream_id: int = 0, writer_pool=None):

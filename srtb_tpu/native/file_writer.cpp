@@ -17,6 +17,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <cstdio>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -75,8 +76,15 @@ struct WriterPool {
   }
 
   bool write_one(const WriteJob& job) {
+    // crash consistency (non-append jobs): write <path>.srtb_tmp and
+    // atomically rename into place on success, so a reader — or a
+    // restarted run's orphan sweep (io/writers.recover_orphan_temps)
+    // — never sees a torn candidate file.  Appends are in-place by
+    // nature.  Mirrors the Python fallback (io/native_writer.py).
+    const std::string path =
+        job.append ? job.path : job.path + ".srtb_tmp";
     int flags = O_WRONLY | O_CREAT | (job.append ? O_APPEND : O_TRUNC);
-    int fd = open(job.path.c_str(), flags, 0644);
+    int fd = open(path.c_str(), flags, 0644);
     if (fd < 0) return false;
     const uint8_t* p = job.data.data();
     size_t left = job.data.size();
@@ -95,6 +103,15 @@ struct WriterPool {
     // survives a crash of the host (ref: write_signal_pipe.hpp:187-197)
     if (ok && job.fsync && fdatasync(fd) != 0) ok = false;
     if (close(fd) != 0) ok = false;
+    if (!job.append) {
+      if (ok) {
+        ok = std::rename(path.c_str(), job.path.c_str()) == 0;
+      }
+      // failed write OR failed rename: drop the temp, matching the
+      // Python atomic_write contract — a live-run failure must not
+      // masquerade as an interrupted-run orphan at the next startup
+      if (!ok) unlink(path.c_str());
+    }
     if (ok) bytes_written.fetch_add(job.data.size());
     return ok;
   }

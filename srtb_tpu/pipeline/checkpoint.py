@@ -21,6 +21,18 @@ class StreamCheckpoint:
     def __init__(self, path: str):
         self.path = path
         self.state = {"segments_done": 0, "file_offset_bytes": 0}
+        # recovery sweep: a crash between the temp write and the
+        # atomic rename in update() leaves a stale <path>.tmp; the
+        # durable state is whatever the rename last published, so the
+        # orphan is simply removed before resuming from it
+        tmp = path + ".tmp"
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+                log.warning(f"[checkpoint] removed orphan temp {tmp} "
+                            "from an interrupted update")
+            except OSError as e:
+                log.warning(f"[checkpoint] cannot remove {tmp}: {e}")
         if os.path.exists(path):
             try:
                 with open(path) as f:

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable
 
 from srtb_tpu.utils.logging import log
@@ -70,6 +71,14 @@ class WorkQueue:
             except queue.Empty:
                 if stop_token is not None and stop_token.stop_requested:
                     return None
+
+    def try_pop(self):
+        """Non-blocking pop; None when empty (shutdown accounting of
+        items a dead/wedged consumer will never take)."""
+        try:
+            return self._q.get_nowait()
+        except queue.Empty:
+            return None
 
     def qsize(self) -> int:
         return self._q.qsize()
@@ -132,8 +141,11 @@ class Pipe:
         self.thread.start()
         return self
 
-    def join(self, timeout=None):
+    def join(self, timeout=None) -> bool:
+        """Join the worker thread; returns True when it actually
+        stopped (False = still alive after ``timeout``)."""
         self.thread.join(timeout)
+        return not self.thread.is_alive()
 
 
 def start_pipe(functor: Callable, in_queue: WorkQueue | None,
@@ -144,14 +156,33 @@ def start_pipe(functor: Callable, in_queue: WorkQueue | None,
 
 
 def on_exit(stop_token: StopToken, pipes: list[Pipe],
-            timeout: float = 5.0) -> None:
-    """Orderly shutdown: request stop, join everything
-    (ref: framework/exit_handler.hpp:28-39)."""
+            timeout: float = 5.0) -> list[Pipe]:
+    """Orderly shutdown: request stop, join everything within ONE
+    shared ``timeout`` budget (ref: framework/exit_handler.hpp:28-39).
+    A pipe that does not stop in time must not hang shutdown behind it
+    — the remaining pipes are still joined with whatever budget is
+    left (each guaranteed at least an equal share, so one slow join
+    cannot starve its neighbors into false wedged reports; worst case
+    < 2x ``timeout`` total), and every wedged pipe is reported loudly
+    (name + current stack, via utils.termination) and returned to the
+    caller."""
     stop_token.request_stop()
+    deadline = time.monotonic() + timeout
+    share = timeout / max(1, len(pipes))
+    wedged = []
     for p in pipes:
-        p.join(timeout)
+        p.join(max(share, deadline - time.monotonic()))
         if p.thread.is_alive():
-            log.warning(f"[on_exit] pipe {p.name} did not stop in time")
+            wedged.append(p)
+    # grace re-sweep: a later pipe starved of budget by an earlier
+    # slow join may only need an instant to notice the stop token —
+    # don't stack-dump a healthy pipe for its neighbor's sins
+    wedged = [p for p in wedged if not p.join(0.1)]
+    if wedged:
+        from srtb_tpu.utils import termination
+        termination.report_wedged([p.thread for p in wedged],
+                                  f"on_exit ({timeout:g}s timeout)")
+    return wedged
 
 
 def composite(*functors: Callable) -> Callable:

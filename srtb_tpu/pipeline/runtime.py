@@ -29,6 +29,19 @@ dispatch -> blocking fetch -> sink per segment) used by the A/B
 harness.  Work accounting (ref: main.cpp:146-162
 work_in_pipeline_count) and orderly shutdown
 (ref: framework/exit_handler.hpp) carry over from the reference.
+
+Fault tolerance (srtb_tpu/resilience/, PR 4): six named fault sites —
+``ingest``, ``h2d``, ``dispatch``, ``fetch``, ``sink_write``,
+``checkpoint`` — run under a retry policy (transient failures back off
+and re-run; fatal ones escalate), an in-flight segment whose fetch
+never becomes ready within ``segment_deadline_s`` is cancelled and
+re-dispatched by the watchdog (``segment_watchdog_requeues``), a
+crashed sink pipe is restarted with a bounded budget
+(``supervisor_max_restarts``), and sustained sink backlog walks the
+graceful-degradation ladder (shed waterfall dumps, then baseband
+dumps, then accounted whole-segment loss).  Every recovery is a
+counter and a v3 journal field; ``Config.fault_plan`` injects
+deterministic faults at any site for CI.
 """
 
 from __future__ import annotations
@@ -36,6 +49,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -47,6 +61,9 @@ from srtb_tpu.io.file_input import BasebandFileReader
 from srtb_tpu.io.writers import WriteAllSink, WriteSignalSink
 from srtb_tpu.pipeline.segment import SegmentProcessor
 from srtb_tpu.pipeline.work import SegmentResultWork, SegmentWork
+from srtb_tpu.resilience.errors import WatchdogEscalation
+from srtb_tpu.resilience.faults import FaultInjector
+from srtb_tpu.resilience.retry import RetryPolicy, retry_call
 from srtb_tpu.utils import telemetry
 from srtb_tpu.utils.logging import log
 from srtb_tpu.utils.metrics import metrics
@@ -224,12 +241,45 @@ class Pipeline:
         self.sinks = sinks
         self.keep_waterfall = keep_waterfall
         self.stats = PipelineStats()
+        # set when a bounded shutdown gave up on a wedged sink: close()
+        # must then abandon the owned writer pool instead of draining
+        # it (the drain would block on the very writes that are stuck)
+        self._sink_wedged = False
         # opt-in runtime sanitizer: None when off, so every hook site
         # below is a single `is not None` check (zero-cost disabled)
         self.sanitizer = None
         if getattr(cfg, "sanitize", False):
             from srtb_tpu.analysis.sanitizer import Sanitizer
             self.sanitizer = Sanitizer()
+        # resilience hooks, each None when off (same zero-cost-disabled
+        # contract as the sanitizer): deterministic fault injection,
+        # the retry policy for the six guarded sites, and the
+        # graceful-degradation ladder
+        self.faults = FaultInjector.from_plan(
+            getattr(cfg, "fault_plan", ""))
+        self.retry = RetryPolicy.from_config(cfg)
+        # sink-side liveness heartbeat: bumped after every completed
+        # per-sink push (not per drained item), so the engine's wedge
+        # detectors see progress through a slow multi-sink flush
+        self._sink_heartbeat = 0
+        # serializes the accounted/abandoned handoff between a wedged
+        # sink worker and the bounded shutdown: _drain_body's
+        # "abandoned? else account" decision and the shutdown's
+        # "unaccounted? then abandon" decision must be atomic with
+        # respect to each other, or a worker unwedging at exactly the
+        # join expiry gets the segment BOTH drained and dropped
+        self._handoff_lock = threading.Lock()
+        self._ladder = None
+        if getattr(cfg, "degrade_enable", False):
+            from srtb_tpu.resilience.degrade import DegradationLadder
+            self._ladder = DegradationLadder.from_config(cfg)
+        # startup recovery sweep (crash consistency): a run that died
+        # between a writer's temp write and its atomic rename leaves
+        # orphaned <name>.srtb_tmp files; remove them before sinks
+        # re-open the prefix, then resume from the checkpoint (above)
+        if cfg.baseband_output_file_prefix:
+            from srtb_tpu.io.writers import recover_orphan_temps
+            recover_orphan_temps(cfg.baseband_output_file_prefix)
         # every completed host-stage timing also lands in a bounded
         # histogram, so /metrics carries live p50/p95/p99 per stage
         self.stage_timer = StageTimer(
@@ -252,14 +302,39 @@ class Pipeline:
                 self.stage_timer.stage(name):
             yield
 
-    def _timed_ingest(self, it):
+    def _op(self, site: str, index: int, fn):
+        """One guarded pipeline operation: the fault-injection hook
+        fires first (a scheduled raise/stall/corrupt at exactly
+        (site, index)), then the retry policy re-runs transient
+        failures with backoff.  With faults unarmed and retries off
+        this is a plain call — the hot path pays two attribute reads.
+        Retried operations must be idempotent at their site: an ingest
+        retry re-runs a read that never happened, a fetch retry
+        re-fetches the same device arrays, a sink retry may re-push
+        (sinks are at-least-once under recovery, like the reference's
+        piggybacked rewrites)."""
+        faults = self.faults
+        if faults is not None and faults.armed(site):
+            inner = fn
+
+            def fn():
+                faults.fire(site, index)
+                return inner()
+        if self.retry is None:
+            return fn()
+        return retry_call(fn, self.retry, site)
+
+    def _timed_ingest(self, it, index: int = 0):
         """One source read as the "ingest" stage; the terminal failed
         read (source exhausted — for a UDP source, a receive blocked
         until shutdown) is NOT recorded, so the ingest histogram holds
-        exactly one sample per segment like every other stage."""
+        exactly one sample per segment like every other stage.  The
+        read runs under the "ingest" fault site: transient receiver
+        errors (interrupted syscalls, connection churn) retry with
+        backoff instead of killing the run."""
         t0 = time.perf_counter()
         with trace_annotation("srtb:ingest"):
-            seg = next(it, None)
+            seg = self._op("ingest", index, lambda: next(it, None))
         if seg is not None:
             self.stage_timer.record("ingest", time.perf_counter() - t0)
         return seg
@@ -298,10 +373,15 @@ class Pipeline:
         """True when every device array in the detect result has
         materialized (``jax.Array.is_ready``) — the non-blocking fetch
         probe.  Objects without a readiness probe (host arrays, test
-        stubs that choose not to implement one) count as ready."""
+        stubs that choose not to implement one) count as ready.  A
+        *failing* probe also counts as ready — the blocking fetch path
+        surfaces the real error with full context — but is logged so a
+        flaky probe never degrades the engine to serial silently."""
         try:
             leaves = jax.tree_util.tree_leaves(det_res)
-        except Exception:
+        except Exception as e:
+            log.debug(f"[pipeline] readiness probe: tree_leaves failed "
+                      f"({e!r}); treating result as ready")
             return True
         for leaf in leaves:
             probe = getattr(leaf, "is_ready", None)
@@ -310,42 +390,68 @@ class Pipeline:
             try:
                 if not probe():
                     return False
-            except Exception:
+            except Exception as e:
+                log.debug(f"[pipeline] is_ready probe failed ({e!r}); "
+                          "deferring to the blocking fetch")
                 return True
         return True
 
     def _dispatch_segment(self, seg, ingest_s: float,
-                          offset_after: int) -> tuple:
+                          offset_after: int, index: int = 0) -> tuple:
         """Stage one segment's bytes to the device (async H2D) and
-        enqueue its program; both run under the "dispatch" stage.
+        enqueue its program; both run under the "dispatch" stage, and
+        under the "h2d" / "dispatch" fault sites respectively.
         ``offset_after`` is the source's logical offset captured right
         after THIS segment's ingest (not at dispatch time — with
         batching, later ingests have already advanced the source).
-        Returns the in-flight record."""
+        Returns the in-flight record (the trailing ``index`` is the
+        dispatch-order segment index, which the watchdog uses to bound
+        requeues and the fault injector to schedule)."""
         with self._stage("dispatch"):
             stage_in = getattr(self.processor, "stage_input", None)
             if stage_in is not None:
-                wf, det_res = self.processor.run_device(
-                    stage_in(seg.data))
+                staged = self._op("h2d", index,
+                                  lambda: stage_in(seg.data))
+                first = [True]
+
+                def run_it():
+                    # a donated plan consumes the staged buffer the
+                    # moment the first attempt dispatches, so a RETRY
+                    # must re-stage from the retained host bytes —
+                    # reusing the donated handle would fail "deleted"
+                    if first[0]:
+                        first[0] = False
+                        return self.processor.run_device(staged)
+                    return self.processor.run_device(
+                        stage_in(seg.data))
+
+                wf, det_res = self._op("dispatch", index, run_it)
             else:  # duck-typed stub processors (tests)
-                wf, det_res = self.processor.process(seg.data)
+                wf, det_res = self._op(
+                    "dispatch", index,
+                    lambda: self.processor.process(seg.data))
         span = {"ingest": ingest_s,
                 "dispatch": self.stage_timer.last["dispatch"]}
         return (seg, wf, det_res, offset_after, span,
-                time.perf_counter())
+                time.perf_counter(), index)
 
     def _dispatch_micro_batch(self, segs: list, ingests: list,
-                              offsets: list) -> list:
+                              offsets: list, first_index: int = 0) \
+            -> list:
         """Stack B ingested segments into ONE vmapped jit call; each
         segment's results are lazy device slices of the batch outputs.
         The batch dispatch cost is amortized evenly across the spans;
         each item keeps its OWN post-ingest source offset so a
         checkpoint written after a partially drained batch resumes at
-        the first undrained segment, not past the whole batch."""
+        the first undrained segment, not past the whole batch.  The
+        whole batch dispatch runs under the first segment's "dispatch"
+        fault site (one jit call = one failure domain)."""
         t0 = time.perf_counter()
         with trace_annotation("srtb:dispatch"):
             stacked = np.stack([np.asarray(s.data) for s in segs])
-            wf_b, det_b = self.processor.process_batch(stacked)
+            wf_b, det_b = self._op(
+                "dispatch", first_index,
+                lambda: self.processor.process_batch(stacked))
         per_seg = (time.perf_counter() - t0) / len(segs)
         items = []
         for i, seg in enumerate(segs):
@@ -354,7 +460,7 @@ class Pipeline:
                 lambda x, j=i: x[j], det_b)
             span = {"ingest": ingests[i], "dispatch": per_seg}
             items.append((seg, wf_b[i], det_i, offsets[i], span,
-                          time.perf_counter()))
+                          time.perf_counter(), first_index + i))
         return items
 
     def _fetch_inflight(self, item: tuple, depth: int,
@@ -364,13 +470,19 @@ class Pipeline:
         engine hid under device compute — journaled as
         ``overlap_hidden_ms`` and observed into the ``overlap`` stage
         histogram."""
-        seg, wf, det_res, offset_after, span, t_dispatched = item
+        seg, wf, det_res, offset_after, span, t_dispatched, index = item
         hidden = max(0.0, time.perf_counter() - t_dispatched)
         self.stage_timer.record("overlap", hidden)
         seg, wf, det_res, offset_after, span = self._fetch_device(
-            (seg, wf, det_res, offset_after, span))
+            (seg, wf, det_res, offset_after, span), index)
+        # the dispatch-order index rides along so the sink-side fault
+        # sites (sink_write, checkpoint) address segments in the SAME
+        # index space as ingest/h2d/dispatch/fetch — the drain counter
+        # starts at the checkpoint on resume and skips shed segments,
+        # so one fault_plan index would otherwise mean different
+        # segments at different sites
         return (seg, wf, det_res, offset_after, span, hidden, depth,
-                live_depth)
+                live_depth, index)
 
     def _drain_body(self, item: tuple, drained: list) -> None:
         """Sink-side half of one segment: detection gate, sink pushes,
@@ -378,7 +490,8 @@ class Pipeline:
         sink pipe thread in overlapped mode (off the dispatch critical
         path), inline in serial mode."""
         cfg = self.cfg
-        seg, wf, det_res, offset_after, span, hidden, depth, live = item
+        (seg, wf, det_res, offset_after, span, hidden, depth, live,
+         index, degrade_level, sinks_done) = item
         san = self.sanitizer
         if san is not None:
             # the sink side is single-owner too: either the sink pipe
@@ -390,22 +503,49 @@ class Pipeline:
             cfg, det_res,
             frequency_bin_count=(wf.shape[-2] if wf is not None
                                  else None))
-        if positive:
+        # the "stats" marker rides in sinks_done (sink entries are
+        # ints, no collision): a supervisor replay of a crashed drain
+        # re-enters this body, and the first attempt may already have
+        # counted the signal — stats must stay exactly-once too
+        if positive and "stats" not in sinks_done:
+            sinks_done.add("stats")
             self.stats.signals += 1
             # drained[0] is the index this segment journals as; the
             # dispatch counter runs ahead of the drain in overlapped
             # mode and would name the wrong segment
             log.info("[pipeline] signal detected in segment "
                      f"{drained[0]}")
+        # fault/retry sites address segments by dispatch-order index
+        # (the space ingest/h2d/dispatch/fetch already use); the
+        # JOURNAL keeps the drain counter below, which is resume-
+        # continuous across checkpointed runs
+        seg_index = index
         with self._stage("sink"):
-            self._push_sinks(seg, wf, det_res, positive)
+            # ``sinks_done`` rides with the item: a retry (or a
+            # supervisor replay) re-enters _push_sinks but skips the
+            # sinks that already succeeded — exactly-once per sink,
+            # which in-place appenders (WriteAllSink) require
+            self._op("sink_write", seg_index,
+                     lambda: self._push_sinks(seg, wf, det_res,
+                                              positive, degrade_level,
+                                              done=sinks_done))
         span["sink"] = self.stage_timer.last["sink"]
         # file mode: sinks never retain segments (no piggybank deque),
         # so the host buffer can go back to the pool for the reader
         pool = getattr(self.source, "pool", None)
         if pool is not None and cfg.input_file_path:
             pool.release(seg.data)
-        drained[0] += 1
+        with self._handoff_lock:
+            if "abandoned" in sinks_done:
+                # the bounded shutdown accounted this segment as
+                # dropped while this thread was wedged mid-push; a
+                # late completion must not also journal/count it
+                return
+            # claiming the drain count INSIDE the lock is what makes
+            # the handoff race-free: once drained advances, the
+            # shutdown's drained == progress check can no longer
+            # abandon this item
+            drained[0] += 1
         self._record_segment(drained[0] - 1, seg, det_res, positive,
                              span, queue_depth=depth,
                              n_samples=cfg.baseband_input_count,
@@ -413,9 +553,13 @@ class Pipeline:
                              inflight_depth=live)
         if self.checkpoint is not None:
             # a checkpointed segment must be durable: flush queued
-            # async candidate writes before recording it as done
-            self._drain_sinks()
-            self.checkpoint.update(drained[0], offset_after)
+            # async candidate writes before recording it as done.
+            # Both run under the "checkpoint" fault site: the flush
+            # and the atomic state rewrite are idempotent.
+            self._op("checkpoint", seg_index,
+                     lambda: (self._drain_sinks(),
+                              self.checkpoint.update(drained[0],
+                                                     offset_after)))
 
     def run(self, max_segments: int | None = None) -> PipelineStats:
         """The async in-flight engine (see module docstring).  With
@@ -469,7 +613,6 @@ class Pipeline:
         # Without sink accounting, fetched-but-unsunk items in the
         # queue would stack up to ~2W waterfalls — an HBM regression
         # at multi-GB waterfall sizes the old 2-deep loop never risked.
-        import threading
         live_lock = threading.Lock()
         live = [0]
 
@@ -482,11 +625,34 @@ class Pipeline:
                 live[0] += n
                 metrics.set("inflight_depth", live[0])
 
+        # bounded-restart supervision of the sink pipe: a transient
+        # crash restarts the worker (the failed item is replayed
+        # inline first, preserving journal order); fatal crashes and
+        # exhausted budgets escalate exactly like today.  Disabled
+        # under the sanitizer (its claim-on-first-use thread-ownership
+        # guard is incompatible with a replacement sink thread).
+        supervisor = None
+        if use_sink_pipe and self.sanitizer is None \
+                and int(getattr(cfg, "supervisor_max_restarts", 0)) > 0:
+            from srtb_tpu.resilience.supervisor import Supervisor
+            supervisor = Supervisor(
+                "sink_drain",
+                max_restarts=cfg.supervisor_max_restarts,
+                window_s=getattr(cfg, "supervisor_window_s", 60.0))
+        current = [None]   # item the sink worker is processing
+        progress = [0]     # drained[0] when that item started
+
         def sink_f(_stop, item):
+            current[0] = item
+            progress[0] = drained[0]
             try:
                 self._drain_body(item, drained)
             finally:
-                live_add(-1)
+                # an item abandoned by the bounded shutdown had its
+                # live slot released (and the drop counted) there
+                if "abandoned" not in item[-1]:
+                    live_add(-1)
+            current[0] = None
 
         sink_pipe = None
         if use_sink_pipe:
@@ -494,7 +660,69 @@ class Pipeline:
                                       "sink_drain")
 
         def sink_alive() -> bool:
-            return sink_pipe is None or sink_pipe.exception is None
+            """True while the sink side can make progress; restarts a
+            supervised crashed pipe as a side effect."""
+            nonlocal sink_pipe
+            if sink_pipe is None or sink_pipe.exception is None:
+                return True
+            if supervisor is None or \
+                    not supervisor.should_restart(sink_pipe.exception):
+                return False
+            failed, current[0] = current[0], None
+            if failed is not None and failed is not fw.SENTINEL:
+                if drained[0] == progress[0]:
+                    # the crash hit BEFORE the item was accounted:
+                    # replay it inline BEFORE the new pipe starts
+                    # popping, preserving journal order (its live slot
+                    # was already released by sink_f's finally; sink
+                    # pushes are at-least-once under recovery); a
+                    # second failure here propagates = escalation
+                    self._drain_body(failed, drained)
+                else:
+                    # the crash hit AFTER accounting (e.g. in the
+                    # checkpoint flush): the segment is already
+                    # counted, journaled and pushed — replaying
+                    # _drain_body would double-count it.  A missed
+                    # checkpoint update self-heals: update() writes
+                    # absolute state, so the next segment's
+                    # checkpoint covers this one.
+                    log.warning(
+                        "[supervisor] sink_drain crashed after its "
+                        "segment was accounted; skipping replay (the "
+                        "next checkpoint covers it)")
+            sink_pipe = fw.start_pipe(sink_f, q_sink, None, stop,
+                                      "sink_drain")
+            return True
+
+        watchdog_max = int(getattr(cfg, "segment_watchdog_requeues",
+                                   0) or 0)
+        deadline_s = float(cfg.segment_deadline_s or 0.0)
+        watchdog = watchdog_max > 0 and deadline_s > 0
+        # ladder pressure flag: the engine waited on the sink since
+        # the last emit (set by push_sink and the parked-window wait)
+        sink_wait = [False]
+
+        # shedding (watchdog shed + degradation ladder) is a LIVENESS
+        # mechanism: it only applies to a real-time source (UDP), where
+        # a stalled engine turns into receiver loss.  A file-mode run
+        # throttles losslessly by design — backpressure on the reader
+        # is the correct outcome, not a reason to drop science output —
+        # so there a slow or even wedged sink stalls (bounded by
+        # shutdown_join_timeout_s / the fetch deadline), never sheds.
+        real_time = not cfg.input_file_path
+
+        def shed_segment(seg_data, in_flight: bool) -> None:
+            """Account one shed segment as explicit loss (counter +
+            loss window) and return its host buffer to the reader pool
+            (file mode — sinks never retained it); ``in_flight`` frees
+            the window slot the sink will never release."""
+            metrics.add("segments_dropped")
+            metrics.window("segments_dropped").add(1)
+            if in_flight:
+                live_add(-1)
+            pool = getattr(self.source, "pool", None)
+            if pool is not None and cfg.input_file_path:
+                pool.release(seg_data)
 
         def push_sink(item) -> bool:
             """Bounded push to the sink pipe: blocks while the queue is
@@ -502,14 +730,63 @@ class Pipeline:
             behind transitively stalls ingest, which a lossy source
             surfaces as accounted loss), but bails out if the sink
             thread crashed while the queue was full — WorkQueue.push's
-            stop-token loop cannot see a dead consumer."""
+            stop-token loop cannot see a dead consumer.  With the
+            watchdog armed, a sink pipe *wedged* (alive but stuck, ZERO
+            drain progress) past the segment deadline sheds this
+            segment as accounted loss instead of stalling the engine
+            forever (the ladder's whole-segment rung).  Drain progress
+            resets the clock, at per-sink-push granularity (the
+            heartbeat), not per drained item: a slow-but-healthy
+            multi-sink flush keeps showing progress, and only a SINGLE
+            write stalled past the deadline reads as a wedge — size
+            ``segment_deadline_s`` above the largest expected single
+            flush.  Same rule as the parked-window wait below."""
+            t0 = time.perf_counter()
+            progress0 = (drained[0], self._sink_heartbeat)
             while not q_sink.push_lossy(item):
+                sink_wait[0] = True
                 if not sink_alive() or stop.stop_requested:
                     return False
+                if watchdog and real_time and item is not fw.SENTINEL:
+                    cur = (drained[0], self._sink_heartbeat)
+                    if cur != progress0:
+                        t0, progress0 = time.perf_counter(), cur
+                    elif time.perf_counter() - t0 > deadline_s:
+                        log.error(
+                            "[watchdog] sink pipe wedged past "
+                            f"{deadline_s:g}s with no drain progress: "
+                            "shedding segment as accounted loss")
+                        # sink_f will never see this item
+                        shed_segment(item[0].data, in_flight=True)
+                        return True
                 time.sleep(0.002)
             return True
 
         def emit(fetched) -> bool:
+            # graceful degradation: one ladder observation per emitted
+            # segment, on the ENGINE side.  The pressure signal is
+            # "the engine had to wait on the sink since the last emit"
+            # (a full queue at push, or the whole window parked in the
+            # sink backlog) — queue size alone reads 0 the instant the
+            # sink pops, hiding a sink-bound pipeline — plus whether
+            # accounted segment loss is currently happening.  The
+            # level rides with the item so the sink side sheds
+            # consistently with what was observed.
+            level = 0
+            if self._ladder is not None:
+                if not real_time:
+                    occupancy = 0.0
+                elif sink_wait[0]:
+                    occupancy = 1.0
+                else:
+                    occupancy = (q_sink.qsize() / window
+                                 if sink_pipe is not None else 0.0)
+                sink_wait[0] = False
+                level = self._ladder.observe(
+                    occupancy,
+                    metrics.window("segments_dropped").sum() > 0)
+            # level + the per-item sinks-done set (see _drain_body)
+            fetched = fetched + (level, set())
             if sink_pipe is None:
                 try:
                     self._drain_body(fetched, drained)
@@ -528,10 +805,10 @@ class Pipeline:
                     and (max_segments is None
                          or dispatched[0] < max_segments))
 
-        def ingest_one():
+        def ingest_one(index: int):
             """One source read; returns (seg, ingest_seconds,
             offset_after_this_segment) or None when exhausted."""
-            seg = self._timed_ingest(it)
+            seg = self._timed_ingest(it, index)
             if seg is None:
                 exhausted[0] = True
                 return None
@@ -557,7 +834,7 @@ class Pipeline:
                         min(batch, max_segments - dispatched[0])
                     got = []
                     while len(got) < budget:
-                        one = ingest_one()
+                        one = ingest_one(dispatched[0] + len(got))
                         if one is None:
                             break
                         got.append(one)
@@ -566,28 +843,80 @@ class Pipeline:
                     segs, ingests, offsets = map(list, zip(*got))
                     if len(segs) == batch:
                         items = self._dispatch_micro_batch(
-                            segs, ingests, offsets)
+                            segs, ingests, offsets, dispatched[0])
                     else:  # tail shorter than B: single-segment plan
-                        items = [self._dispatch_segment(s, dt, off)
-                                 for s, dt, off in got]
+                        items = [self._dispatch_segment(
+                                     s, dt, off, dispatched[0] + i)
+                                 for i, (s, dt, off) in enumerate(got)]
                     pending.extend(items)
                     live_add(len(segs))
                     dispatched[0] += len(segs)
                     self.stats.segments += len(segs)
                     self.stats.samples += n_samples_per_seg * len(segs)
                 else:
-                    one = ingest_one()
+                    one = ingest_one(dispatched[0])
                     if one is None:
                         return
-                    pending.append(self._dispatch_segment(*one))
+                    pending.append(
+                        self._dispatch_segment(*one,
+                                               index=dispatched[0]))
                     live_add(1)
                     dispatched[0] += 1
                     self.stats.segments += 1
                     self.stats.samples += n_samples_per_seg
 
+        requeue_counts: dict[int, int] = {}
+
+        def watchdog_wait() -> bool:
+            """Segment watchdog: poll the oldest in-flight segment's
+            readiness up to the deadline, measured from when the
+            engine starts WAITING on it here (becoming the drain
+            head) — not from its dispatch: with a deep window or a
+            micro-batch, a segment healthily queues behind earlier
+            in-flight work for several compute times, and charging
+            that queue wait against the deadline would fire spurious
+            requeues (and eventually escalate) on a perfectly healthy
+            device.  On expiry, cancel it (drop the device handles —
+            JAX cannot abort an enqueued program, but the results are
+            never read) and re-dispatch from the retained host
+            buffer, up to ``segment_watchdog_requeues`` times, then
+            escalate.  Every requeue is accounted
+            (``watchdog_requeues``).  Returns False when the sink
+            died while waiting."""
+            item = pending[0]
+            waited_since = time.perf_counter()
+            while not self._result_ready(item[2]):
+                if not sink_alive() or stop.stop_requested:
+                    return False
+                if time.perf_counter() - waited_since >= deadline_s:
+                    index = item[6]
+                    used = requeue_counts.get(index, 0)
+                    if used >= watchdog_max:
+                        raise WatchdogEscalation(
+                            f"segment {index} fetch still not ready "
+                            f"after {deadline_s:g}s at the drain head "
+                            f"and {used} requeue(s): device wedged")
+                    requeue_counts[index] = used + 1
+                    metrics.add("watchdog_requeues")
+                    log.warning(
+                        f"[watchdog] segment {index} in-flight past "
+                        f"{deadline_s:g}s (fetch never ready): "
+                        f"cancelling and re-dispatching "
+                        f"({used + 1}/{watchdog_max})")
+                    seg, _wf, _det, offset_after, span, _t0, _i = item
+                    item = self._dispatch_segment(
+                        seg, span["ingest"], offset_after, index)
+                    pending[0] = item
+                    waited_since = time.perf_counter()
+                else:
+                    time.sleep(min(0.005, deadline_s / 20))
+            return True
+
         def drain_oldest() -> bool:
             if san is not None:
                 san.assert_owner("inflight_window")
+            if watchdog and not watchdog_wait():
+                return False
             # journaled depths, both captured AT drain time including
             # the item being drained (a full window journals as W, not
             # a perpetual W-1): queue_depth = dispatched-not-yet-
@@ -599,16 +928,58 @@ class Pipeline:
             item = pending.popleft()
             return emit(self._fetch_inflight(item, depth, live_now))
 
+        # watchdog state for a fully-parked window: [since, progress
+        # marker] — same per-sink-push progress rule as push_sink
+        parked = [None, (drained[0], self._sink_heartbeat)]
+
+        def shed_ingest() -> bool:
+            """Wedged sink with the whole window parked: keep draining
+            the source (the never-stall-on-loss property) and account
+            each undispatched segment as loss.  False = source done.
+
+            The shed segment still consumes its dispatch index: a
+            ``max_segments``-bounded run (soak harness, tests) must
+            terminate even while shedding, and an indexed fault plan
+            must keep addressing later segments — only the window
+            slot and the stats/samples counters (it was never
+            processed) are skipped."""
+            one = ingest_one(dispatched[0])
+            if one is None:
+                return False
+            dispatched[0] += 1
+            log.error("[watchdog] sink wedged with a full in-flight "
+                      "window: shedding ingested segment as accounted "
+                      "loss")
+            # never dispatched, so it holds no window slot
+            shed_segment(one[0].data, in_flight=False)
+            return True
+
+        sink_wedged = False
         try:
             while sink_alive():
                 fill_window()
                 if not pending:
                     if want_more() and live_count() > 0 and sink_alive():
                         # the whole window is parked in the sink
-                        # backlog: wait for the sink to free a slot
+                        # backlog: wait for the sink to free a slot —
+                        # bounded by the watchdog (when armed): zero
+                        # drain progress past the deadline means a
+                        # wedged sink, and the source must keep
+                        # draining with accounted loss, never stall
+                        sink_wait[0] = True
+                        if watchdog and real_time:
+                            now = time.perf_counter()
+                            cur = (drained[0], self._sink_heartbeat)
+                            if parked[0] is None or cur != parked[1]:
+                                parked[0], parked[1] = now, cur
+                            elif now - parked[0] > deadline_s:
+                                if not shed_ingest():
+                                    break
+                                continue
                         time.sleep(0.002)
                         continue
                     break
+                parked[0] = None
                 # non-blocking drain: everything already materialized
                 # goes straight to the sink side, in order
                 while pending and sink_alive() \
@@ -628,17 +999,92 @@ class Pipeline:
                     break
         finally:
             if sink_pipe is not None:
-                push_sink(fw.SENTINEL)
-                # unbounded: the sink may legitimately be flushing a
-                # multi-GB waterfall (same contract as _drain_sinks);
-                # a *crashed* sink thread has already exited, so this
-                # returns immediately in every failure path
-                sink_pipe.join()
+                # bounded sentinel push: a sink wedged with a full
+                # queue can never accept the sentinel — give up after
+                # the join budget instead of hanging shutdown on it
+                join_s = float(getattr(cfg, "shutdown_join_timeout_s",
+                                       0) or 0)
+                t_sent = time.perf_counter()
+                while not q_sink.push_lossy(fw.SENTINEL):
+                    if not sink_alive() or stop.stop_requested:
+                        break
+                    if join_s > 0 and \
+                            time.perf_counter() - t_sent > join_s:
+                        break
+                    time.sleep(0.002)
+                # bounded join: the sink may legitimately be flushing
+                # a multi-GB waterfall (hence a generous default), but
+                # a *wedged* pipe must not hang shutdown forever — on
+                # expiry the thread is reported (name + stack) via
+                # utils.termination and shutdown proceeds (it is a
+                # daemon thread).  A *crashed* sink thread has already
+                # exited, so this returns immediately in every failure
+                # path.  0 keeps the legacy wait-forever behavior.
+                sink_pipe.join(join_s if join_s > 0 else None)
+                if sink_pipe.thread.is_alive():
+                    sink_wedged = True
+                    # flagged HERE, inside the finally: an exception
+                    # escaping run() (fatal fault, watchdog
+                    # escalation) still reaches close(), which must
+                    # skip the wedged pool's drain or shutdown hangs
+                    # on the very writes the bounded join gave up on
+                    self._sink_wedged = True
+                    from srtb_tpu.utils import termination
+                    termination.report_wedged(
+                        [sink_pipe.thread],
+                        f"pipeline shutdown ({join_s:g}s join timeout)")
+                    # items still parked on the sink queue will never
+                    # reach a sink: account them as dropped (not
+                    # silent loss) and return their host buffers
+                    while True:
+                        leftover = q_sink.try_pop()
+                        if leftover is None:
+                            break
+                        if leftover is fw.SENTINEL:
+                            continue
+                        shed_segment(leftover[0].data, in_flight=True)
+                    # the item the wedged worker holds mid-drain is
+                    # loss too if it never reached accounting
+                    # (sink_f's finally never runs): count it, or it
+                    # vanishes — dispatched but neither journaled nor
+                    # dropped.  Same already-accounted rule as the
+                    # supervisor replay; its host buffer stays with
+                    # the wedged thread, never back to the pool.  The
+                    # "abandoned" marker in its sinks-done set hands
+                    # the accounting over: should the worker unwedge
+                    # during teardown and finish the drain, it must
+                    # not ALSO journal/count the segment (and sink_f's
+                    # finally must not re-release the live slot).
+                    held = current[0]
+                    if held is not None and held is not fw.SENTINEL:
+                        # atomic with _drain_body's accounted/abandoned
+                        # decision (self._handoff_lock): a worker
+                        # unwedging at exactly this moment either
+                        # claims the drain count first (drained moves
+                        # past progress — no abandonment here) or sees
+                        # the marker and skips its own accounting —
+                        # never both
+                        with self._handoff_lock:
+                            if drained[0] == progress[0]:
+                                held[-1].add("abandoned")
+                                metrics.add("segments_dropped")
+                                metrics.window("segments_dropped").add(1)
+                                live_add(-1)
+                    log.error("[pipeline] wedged sink: still-queued "
+                              "segments accounted as segments_dropped")
                 stop.request_stop()
             metrics.set("inflight_depth", 0)
         if sink_pipe is not None and sink_pipe.exception is not None:
             raise sink_pipe.exception
-        self._drain_sinks()
+        if sink_wedged:
+            # the bounded join already gave up on the wedged sink —
+            # draining its writer pools would block on the very writes
+            # that are stuck, hanging shutdown after promising not to
+            # (self._sink_wedged was flagged in the finally above)
+            log.error("[pipeline] skipping sink drain: sink pipe "
+                      "wedged (queued async writes were NOT flushed)")
+        else:
+            self._drain_sinks()
         self.stats.elapsed_s = time.perf_counter() - start
         self.stats.extras["stages"] = self.stage_timer.summary()
         log.info(f"[pipeline] {self.stats.segments} segments, "
@@ -658,21 +1104,55 @@ class Pipeline:
     # overridable for tests; the default aborts through the installed
     # signal/termination handlers for a loud stacktrace (the reference's
     # fail-fast philosophy, ref: util/termination_handler.hpp:38-113)
-    def _push_sinks(self, seg, wf, det_res, positive) -> None:
+    def _push_sinks(self, seg, wf, det_res, positive,
+                    degrade_level: int = 0,
+                    done: set | None = None) -> None:
         """Push to every sink, handing the waterfall only to sinks
         entitled to it: all of them under ``keep_waterfall``, else only
         sinks declaring ``wants_waterfall`` (a lossy GUI tap must not
         make every OTHER sink — e.g. the candidate writer, which dumps
         a multi-GB .npy per positive segment — start seeing
-        waterfalls the plan chose not to keep)."""
+        waterfalls the plan chose not to keep).
+
+        Degradation ladder: at level >= 1 the waterfall is withheld
+        from every sink (the multi-GB dumps and GUI frames go first);
+        at level >= 2 sinks marked ``sheddable`` (the candidate /
+        baseband writers) are skipped entirely.  Both sheds are
+        counted — degraded output must be visible on /metrics, never
+        silent.
+
+        ``done`` (when given) records the indices of sinks that
+        already received this segment, and completed ones are skipped
+        on re-entry: a retried or replayed push is exactly-once per
+        sink, never a duplicate — an in-place appender
+        (``WriteAllSink``) would otherwise corrupt its stream."""
+        if degrade_level >= 1 and wf is not None:
+            wf = None
+            # the "wf" marker in ``done`` (sink entries are ints, no
+            # collision) keeps the counter exactly-once when a retried
+            # or replayed push re-enters with the original waterfall
+            if done is None or "wf" not in done:
+                metrics.add("shed_waterfalls")
+                if done is not None:
+                    done.add("wf")
         full = SegmentResultWork(segment=seg, waterfall=wf,
                                  detect=det_res)
         light = full if self.keep_waterfall else SegmentResultWork(
             segment=seg, waterfall=None, detect=det_res)
-        for sink in self.sinks:
+        for i, sink in enumerate(self.sinks):
+            if done is not None and i in done:
+                continue
+            if degrade_level >= 2 and getattr(sink, "sheddable", False):
+                metrics.add("shed_baseband")
+                if done is not None:
+                    done.add(i)
+                continue
             give = self.keep_waterfall or getattr(
                 sink, "wants_waterfall", False)
             sink.push(full if give else light, positive)
+            self._sink_heartbeat += 1
+            if done is not None:
+                done.add(i)
 
     def _on_segment_deadline(self) -> None:  # pragma: no cover - aborts
         _abort_on_deadline(self.cfg.segment_deadline_s)
@@ -682,7 +1162,7 @@ class Pipeline:
         return sync_with_deadline(self.cfg.segment_deadline_s, fn,
                                   self._on_segment_deadline)
 
-    def _fetch_device(self, item):
+    def _fetch_device(self, item, index: int = 0):
         """Resolve one (seg, wf, det_res, offset) drain item's device
         handles to host data, with the fail-fast deadline scoped to the
         *device fetches only*: those are what a wedged accelerator tunnel
@@ -702,9 +1182,13 @@ class Pipeline:
         with self._stage("fetch"):
             # explicit D2H (device_get) — this is the engine's one
             # sanctioned blocking fetch; implicit np.asarray here
-            # would trip the sanitizer's transfer guard
-            det_res = self._sync_with_deadline(
-                lambda: jax.device_get(det_res))
+            # would trip the sanitizer's transfer guard.  Under the
+            # "fetch" fault site: device_get of the same handles is
+            # idempotent, so a transient failure simply re-fetches.
+            det_res = self._op(
+                "fetch", index,
+                lambda: self._sync_with_deadline(
+                    lambda: jax.device_get(det_res)))
         span["fetch"] = self.stage_timer.last["fetch"]
         if wf is not None and self.cfg.segment_deadline_s > 0:
             wf = _DeadlineArray(wf, self._sync_with_deadline)
@@ -718,9 +1202,11 @@ class Pipeline:
     def close(self) -> None:
         """Release runtime resources (the owned writer-pool threads).
         The pool also self-finalizes at GC, so forgetting this leaks
-        nothing — but explicit close gives deterministic shutdown."""
+        nothing — but explicit close gives deterministic shutdown.
+        After a bounded shutdown gave up on a wedged sink, the pool is
+        abandoned instead of drained (same bounded-exit contract)."""
         if self._owned_writer_pool is not None:
-            self._owned_writer_pool.close()
+            self._owned_writer_pool.close(drain=not self._sink_wedged)
             self._owned_writer_pool = None
         if self.journal is not None:
             self.journal.close()
@@ -860,29 +1346,43 @@ class ThreadedPipeline(Pipeline):
         def source_f(stop_token, _):
             if max_segments is not None and count[0] >= max_segments:
                 raise StopIteration
-            seg = self._timed_ingest(it)
+            seg = self._timed_ingest(it, count[0])
             if seg is None:
                 raise StopIteration
             count[0] += 1
-            # carry the ingest time with the work item: the span is
-            # assembled across three threads
-            return (seg, self.stage_timer.last["ingest"])
+            # carry the ingest time AND the ingest-order index with
+            # the work item: the span is assembled across three
+            # threads, and every fault/retry site downstream must
+            # address this segment by the same index ingest used
+            return (seg, self.stage_timer.last["ingest"], count[0] - 1)
 
         def device_f(stop_token, item):
-            seg, ingest_dt = item
+            seg, ingest_dt, index = item
             with self._stage("dispatch"):
-                wf, det_res = self.processor.process(seg.data)
+                wf, det_res = self._op(
+                    "dispatch", index,
+                    lambda: self.processor.process(seg.data))
             span = {"ingest": ingest_dt,
                     "dispatch": self.stage_timer.last["dispatch"]}
             self.stats.segments += 1
             self.stats.samples += cfg.baseband_input_count
             return (seg, wf, det_res,
-                    getattr(self.source, "logical_offset", 0), span)
+                    getattr(self.source, "logical_offset", 0), span,
+                    index)
+
+        drain_busy = [False]
 
         def drain_f(stop_token, item):
-            return _drain_body(stop_token, self._fetch_device(item))
+            drain_busy[0] = True
+            index = item[-1]
+            try:
+                return _drain_body(
+                    stop_token,
+                    self._fetch_device(item[:-1], index), index)
+            finally:
+                drain_busy[0] = False
 
-        def _drain_body(stop_token, item):
+        def _drain_body(stop_token, item, index):
             seg, wf, det_res, offset_after, span = item
             if self.sanitizer is not None:
                 self._sanitize_check(wf, det_res)
@@ -892,8 +1392,15 @@ class ThreadedPipeline(Pipeline):
                                      else None))
             if positive:
                 self.stats.signals += 1
+            # ingest-order index for the fault/retry sites (the drain
+            # counter below stays the journal's resume-continuous
+            # numbering, same split as the async engine)
+            seg_index = index
+            done = set()  # retries stay exactly-once per sink
             with self._stage("sink"):
-                self._push_sinks(seg, wf, det_res, positive)
+                self._op("sink_write", seg_index,
+                         lambda: self._push_sinks(seg, wf, det_res,
+                                                  positive, done=done))
             span["sink"] = self.stage_timer.last["sink"]
             pool = getattr(self.source, "pool", None)
             if pool is not None and cfg.input_file_path:
@@ -905,8 +1412,10 @@ class ThreadedPipeline(Pipeline):
                                  span, queue_depth=q_res.qsize() + 1,
                                  n_samples=cfg.baseband_input_count)
             if self.checkpoint is not None:
-                self._drain_sinks()  # durability before recording done
-                self.checkpoint.update(drained[0], offset_after)
+                self._op("checkpoint", seg_index,
+                         lambda: (self._drain_sinks(),
+                                  self.checkpoint.update(drained[0],
+                                                         offset_after)))
             return None
 
         stop = fw.StopToken()
@@ -917,13 +1426,47 @@ class ThreadedPipeline(Pipeline):
             fw.start_pipe(device_f, q_seg, q_res, stop, "device"),
             fw.start_pipe(drain_f, q_res, None, stop, "drain"),
         ]
-        # wait for the drain pipe to see the sentinel
-        pipes[2].join()
-        fw.on_exit(stop, pipes)
+        # wait for the drain pipe to see the sentinel.  This is the
+        # COMPLETION wait — it lasts the whole observation, so it must
+        # not itself be bounded by shutdown_join_timeout_s (that would
+        # silently truncate any healthy run longer than the timeout).
+        # The bound applies only to a WEDGE: the drain worker busy on
+        # one item with zero per-sink-push progress (the heartbeat,
+        # same rule as the async engine) for the whole budget.  An
+        # idle drain waiting on a quiet source is healthy and waits
+        # forever; a crashed source/device pipe propagates a sentinel
+        # from its finally, so the drain still exits.
+        join_s = float(getattr(cfg, "shutdown_join_timeout_s", 0) or 0)
+        if join_s <= 0:
+            pipes[2].join(None)
+        else:
+            last = (drained[0], self._sink_heartbeat)
+            t0 = time.perf_counter()
+            while not pipes[2].join(min(0.1, join_s / 10)):
+                cur = (drained[0], self._sink_heartbeat)
+                if not drain_busy[0] or cur != last:
+                    last, t0 = cur, time.perf_counter()
+                elif time.perf_counter() - t0 > join_s:
+                    break
+        wedged = fw.on_exit(stop, pipes)
+        if pipes[2] in wedged:
+            # same contract as the async engine: the wedged DRAIN
+            # pipe's writer pools would block the final drain on the
+            # stuck writes.  Only the drain pipe owns sink/writer
+            # work — a wedged source or device (on_exit reported it)
+            # must not cost the healthy sink side its final flush.
+            # Flagged BEFORE the exception re-raise below so close()
+            # still skips the wedged pool's drain when another pipe
+            # crashed the run.
+            self._sink_wedged = True
+            log.error("[pipeline threaded] skipping sink drain: "
+                      f"{[p.name for p in wedged]} wedged (queued "
+                      "async writes were NOT flushed)")
         for p in pipes:
             if p.exception is not None:
                 raise p.exception
-        self._drain_sinks()
+        if not self._sink_wedged:
+            self._drain_sinks()
         self.stats.elapsed_s = time.perf_counter() - start_t
         self.stats.extras["stages"] = self.stage_timer.summary()
         log.info(f"[pipeline threaded] {self.stats.segments} segments, "

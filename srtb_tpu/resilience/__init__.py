@@ -1,0 +1,34 @@
+"""Fault tolerance for the streaming runtime (PR 4).
+
+A transient-search backend is only useful if it survives a night of
+observing: the reference keeps its SYCL pipeline alive across packet
+loss and slow consumers, and streamed GPU pipelines treat continuity
+under stalls as a first-class design constraint (PAPERS.md:
+arXiv:2101.00941 CUDA-streams AstroAccelerate; arXiv:1806.01556
+always-on FPGA modules).  This package gives the srtb_tpu runtime the
+same property, in five composable pieces:
+
+- :mod:`errors` — the typed taxonomy every other piece dispatches on:
+  *transient* (retryable), *fatal* (escalate to clean shutdown), and
+  *data-loss* (retryable, but the occurrence is accounted);
+- :mod:`retry` — configurable retry with exponential backoff,
+  deterministic jitter and deadlines, applied by the pipeline to
+  ingest reads, H2D staging, dispatch, fetch, sink writes, and
+  checkpoint flushes;
+- :mod:`supervisor` — bounded restarts for crashed workers (the sink
+  drain Pipe, the GUI server thread) with escalation to clean
+  shutdown when the budget is exhausted;
+- :mod:`degrade` — the graceful-degradation ladder: under sustained
+  sink backlog or accounted loss, shed waterfall dumps first, then
+  baseband dumps, then whole segments (the existing
+  ``DropOldestSegmentBuffer``), every step counted;
+- :mod:`faults` — deterministic fault injection (``Config.fault_plan``)
+  arming named sites to raise/stall/corrupt on scheduled segment
+  indices, zero-cost when off (the same None-hook pattern as the
+  runtime sanitizer), so every recovery path above is testable on CPU
+  CI.
+
+Everything is surfaced: retries, requeues, restarts, shed dumps and
+the degradation level are Prometheus counters/gauges and journal
+fields (telemetry schema v3).
+"""

@@ -1,0 +1,103 @@
+"""Graceful-degradation ladder: shed work before shedding data.
+
+When the sink side cannot keep up (a slow disk, a flooded writer
+pool), the reference's answer is lossy visualization taps and kernel
+packet drops — the excess surfaces as loss at the *edges*.  The ladder
+makes the middle of the pipeline degrade in a chosen order instead of
+an arbitrary one:
+
+- level 0 ``full``            everything runs;
+- level 1 ``shed_waterfall``  waterfall dumps are withheld from sinks
+  (the multi-GB .npy writes and GUI frames go first — diagnostics,
+  not science data);
+- level 2 ``shed_baseband``   sinks marked ``sheddable`` (the
+  candidate/baseband writers) are skipped entirely;
+- level 3 ``shed_segments``   whole segments are being dropped — the
+  accounted drop-oldest loss of ``io.backpressure`` — and the ladder
+  names the state.
+
+Escalation is driven by the signals the engine already measures: sink
+pressure (the engine had to *wait* on the sink — a full queue at push
+or the whole in-flight window parked in the sink backlog — observed
+as occupancy 1.0; a raw queue fraction otherwise) and whether
+accounted segment loss is currently happening.  Sink pressure counts
+only for real-time sources: degradation exists to preserve
+*liveness*, and a file-mode run that throttles its reader losslessly
+is behaving, not drowning (the engine passes occupancy 0 there).
+Active loss escalates regardless of sink
+occupancy — deliberately: segments_dropped only moves on engine-level
+overload (drop-oldest or watchdog sheds, never receiver packet loss),
+whole-segment loss is strictly worse than any shed dump, and withheld
+waterfall/candidate output also frees the D2H transfer and writer
+capacity every bottleneck shares; recovery likewise waits for loss to
+stop, because un-degrading while segments are still being dropped
+would trade science data for diagnostics.  Hysteresis (``hold``
+consecutive observations above ``high`` / below ``low``) keeps one
+slow flush from thrashing the ladder.  Every transition and every shed dump is a
+Prometheus counter and a v3 journal field — graceful degradation that
+is not accounted is just silent loss with better marketing.
+"""
+
+from __future__ import annotations
+
+from srtb_tpu.utils.logging import log
+from srtb_tpu.utils.metrics import metrics
+
+LEVELS = ("full", "shed_waterfall", "shed_baseband", "shed_segments")
+
+
+class DegradationLadder:
+    """Hysteretic escalation over ``LEVELS`` driven by per-drain
+    observations of sink backlog and loss state."""
+
+    def __init__(self, high: float = 0.9, low: float = 0.25,
+                 hold: int = 3):
+        if not 0.0 <= low < high <= 1.0:
+            raise ValueError(f"need 0 <= low < high <= 1, got "
+                             f"low={low} high={high}")
+        self.high = float(high)
+        self.low = float(low)
+        self.hold = max(1, int(hold))
+        self.level = 0
+        self._above = 0
+        self._below = 0
+        metrics.set("degrade_level", 0)
+
+    @classmethod
+    def from_config(cls, cfg) -> "DegradationLadder":
+        return cls(high=float(getattr(cfg, "degrade_queue_high", 0.9)),
+                   low=float(getattr(cfg, "degrade_queue_low", 0.25)),
+                   hold=int(getattr(cfg, "degrade_hold_segments", 3)))
+
+    def observe(self, occupancy: float, loss_active: bool) -> int:
+        """One per-drained-segment observation; returns the (possibly
+        updated) level.  ``occupancy`` is the sink backlog fraction;
+        ``loss_active`` is whether accounted segment loss happened in
+        the recent window (level 3's defining signal)."""
+        pressure = occupancy >= self.high or loss_active
+        relief = occupancy <= self.low and not loss_active
+        if pressure:
+            self._above += 1
+            self._below = 0
+        elif relief:
+            self._below += 1
+            self._above = 0
+        else:
+            # between the thresholds: hold the current level
+            self._above = self._below = 0
+        if self._above >= self.hold and self.level < len(LEVELS) - 1:
+            self.level += 1
+            self._above = 0
+            metrics.add("degrade_steps")
+            log.warning(
+                f"[degrade] sustained pressure (occupancy "
+                f"{occupancy:.2f}, loss={loss_active}): stepping up to "
+                f"level {self.level} ({LEVELS[self.level]})")
+        elif self._below >= self.hold and self.level > 0:
+            self.level -= 1
+            self._below = 0
+            metrics.add("degrade_recoveries")
+            log.info(f"[degrade] pressure cleared: recovering to level "
+                     f"{self.level} ({LEVELS[self.level]})")
+        metrics.set("degrade_level", self.level)
+        return self.level

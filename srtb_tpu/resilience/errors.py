@@ -1,0 +1,96 @@
+"""Typed error taxonomy for the streaming runtime.
+
+Every recovery decision in this package dispatches on one question:
+*what kind of failure is this?*  Three categories cover the pipeline:
+
+- ``TRANSIENT`` — the operation may succeed if simply re-run (an
+  interrupted read, a momentarily unavailable socket, a stalled device
+  fetch).  Retried with backoff by :mod:`srtb_tpu.resilience.retry`.
+- ``DATA_LOSS`` — the operation can be re-run, but something was lost
+  or corrupted on the way (a torn packet block, a corrupted buffer).
+  Retried like a transient, and additionally accounted in the
+  ``data_loss_total`` counter: loss must never be silent.
+- ``FATAL`` — retrying cannot help (programming errors, resource
+  exhaustion, explicit escalations).  Propagates to a clean shutdown.
+
+Unknown exceptions default to FATAL: retrying an unclassified failure
+hides bugs, and the reference's fail-loudly philosophy
+(ref: util/termination_handler.hpp) applies whenever we cannot argue
+the retry is safe.
+"""
+
+from __future__ import annotations
+
+import errno
+
+TRANSIENT = "transient"
+FATAL = "fatal"
+DATA_LOSS = "data_loss"
+
+
+class PipelineError(Exception):
+    """Base of the typed taxonomy; ``category`` drives every retry /
+    restart / escalation decision."""
+
+    category = FATAL
+
+
+class TransientError(PipelineError):
+    """Retryable: re-running the operation may succeed."""
+
+    category = TRANSIENT
+
+
+class FatalError(PipelineError):
+    """Not retryable: escalate to a clean shutdown."""
+
+    category = FATAL
+
+
+class DataLossError(PipelineError):
+    """Retryable, but data was lost/corrupted — the occurrence is
+    accounted (``data_loss_total``) even when the retry succeeds."""
+
+    category = DATA_LOSS
+
+
+class SegmentTimeout(TransientError):
+    """An in-flight segment exceeded the deadline (fetch never became
+    ready); the watchdog cancels and re-dispatches it."""
+
+
+class WatchdogEscalation(FatalError):
+    """A segment stayed wedged through every allowed requeue."""
+
+
+class RestartBudgetExceeded(FatalError):
+    """A supervised worker crashed more times than its restart budget
+    allows within the window."""
+
+
+# errnos that indicate a momentary condition, not a broken system
+_TRANSIENT_ERRNOS = frozenset(
+    e for e in (
+        getattr(errno, name, None)
+        for name in ("EINTR", "EAGAIN", "EWOULDBLOCK", "EBUSY",
+                     "ENOBUFS", "ETIMEDOUT", "ECONNRESET",
+                     "ECONNREFUSED", "ENETUNREACH", "EHOSTUNREACH"))
+    if e is not None)
+
+
+def classify(exc: BaseException) -> str:
+    """Map any exception to a taxonomy category.
+
+    Typed :class:`PipelineError` subclasses carry their own category;
+    the stdlib's momentary-condition types (timeouts, interrupted
+    syscalls, connection churn) are transient; everything else —
+    including plain programming errors — is FATAL, because retrying an
+    unclassified failure hides bugs instead of surviving faults."""
+    if isinstance(exc, PipelineError):
+        return exc.category
+    if isinstance(exc, (TimeoutError, InterruptedError,
+                        BlockingIOError, ConnectionError)):
+        return TRANSIENT
+    if isinstance(exc, OSError) and exc.errno in _TRANSIENT_ERRNOS:
+        return TRANSIENT
+    return FATAL

@@ -1,0 +1,161 @@
+"""Deterministic fault injection (``Config.fault_plan``).
+
+Recovery code that is never executed is broken code waiting for a bad
+night at the telescope.  This module arms the pipeline's named fault
+sites to fail *on schedule*, so every retry / watchdog / supervisor /
+degradation path runs deterministically on CPU CI and can be soaked
+with ``tools/udp_soak.py --fault-plan``.
+
+Plan syntax (comma-separated entries)::
+
+    site:action@index
+
+- ``site``    one of ``ingest``, ``h2d``, ``dispatch``, ``fetch``,
+              ``sink_write``, ``checkpoint`` — the hook points wired
+              through pipeline/runtime.py;
+- ``action``  ``raise`` (transient :class:`InjectedFault`),
+              ``fatal`` (:class:`InjectedFatal`, escalates),
+              ``corrupt`` (:class:`InjectedCorruption`, a data-loss
+              fault: retried AND accounted), or
+              ``stall=SECONDS`` (sleeps — long enough trips the
+              segment watchdog);
+- ``index``   the segment index the fault fires on — dispatch-order
+              within the run, 0-based, the SAME space at every site
+              (a resumed run's journal numbering continues from the
+              checkpoint, but fault indices always count from this
+              run's first ingested segment).
+
+Example: ``ingest:raise@1,fetch:stall=0.5@2,sink_write:corrupt@3``.
+
+Each armed fault fires exactly once, so "transient fault retries to
+success" is the deterministic outcome.  When ``Config.fault_plan`` is
+empty the injector is ``None`` and the pipeline never calls in here —
+the same zero-cost-off None-hook pattern as the runtime sanitizer.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from srtb_tpu.resilience.errors import (DataLossError, FatalError,
+                                        TransientError)
+from srtb_tpu.utils.logging import log
+from srtb_tpu.utils.metrics import metrics
+
+SITES = ("ingest", "h2d", "dispatch", "fetch", "sink_write",
+         "checkpoint")
+ACTIONS = ("raise", "fatal", "corrupt", "stall")
+
+
+class InjectedFault(TransientError):
+    """A scheduled transient fault."""
+
+
+class InjectedFatal(FatalError):
+    """A scheduled fatal fault."""
+
+
+class InjectedCorruption(DataLossError):
+    """A scheduled data-loss fault."""
+
+
+@dataclass
+class FaultSpec:
+    site: str
+    action: str
+    index: int
+    arg: float = 0.0     # stall duration
+    fired: bool = field(default=False, compare=False)
+
+    def __str__(self) -> str:
+        a = (f"{self.action}={self.arg:g}" if self.action == "stall"
+             else self.action)
+        return f"{self.site}:{a}@{self.index}"
+
+
+def parse_plan(text: str) -> list[FaultSpec]:
+    """Parse the plan syntax above; raises ``ValueError`` with the
+    offending entry on any malformed piece (a fault plan with a typo
+    must fail the run at startup, not silently never fire)."""
+    specs = []
+    for entry in (e.strip() for e in text.split(",")):
+        if not entry:
+            continue
+        try:
+            site, rest = entry.split(":", 1)
+            action, idx = rest.rsplit("@", 1)
+            arg = 0.0
+            if "=" in action:
+                action, arg_s = action.split("=", 1)
+                arg = float(arg_s)
+            site, action = site.strip(), action.strip()
+            index = int(idx)
+        except ValueError as e:
+            raise ValueError(
+                f"fault_plan entry {entry!r}: expected "
+                "'site:action@index' with action raise|fatal|corrupt|"
+                f"stall=SECONDS ({e})") from e
+        if site not in SITES:
+            raise ValueError(f"fault_plan entry {entry!r}: unknown site "
+                             f"{site!r} (sites: {', '.join(SITES)})")
+        if action not in ACTIONS:
+            raise ValueError(
+                f"fault_plan entry {entry!r}: unknown action {action!r} "
+                f"(actions: {', '.join(ACTIONS)})")
+        if action == "stall" and arg <= 0:
+            raise ValueError(f"fault_plan entry {entry!r}: stall needs "
+                             "a positive duration (stall=SECONDS)")
+        specs.append(FaultSpec(site, action, index, arg))
+    return specs
+
+
+class FaultInjector:
+    """Armed fault sites; ``fire`` is the per-site hook the pipeline
+    calls with the current segment index."""
+
+    def __init__(self, specs: list[FaultSpec]):
+        self._by_site: dict[str, dict[int, FaultSpec]] = {}
+        for s in specs:
+            site = self._by_site.setdefault(s.site, {})
+            if s.index in site:
+                # overwriting would silently never fire the first spec
+                # — the fail-at-startup contract of parse_plan applies
+                raise ValueError(
+                    f"fault_plan: duplicate entry for {s.site}@"
+                    f"{s.index} ({site[s.index]} vs {s})")
+            site[s.index] = s
+
+    @classmethod
+    def from_plan(cls, text: str) -> "FaultInjector | None":
+        """None (zero-cost off) for an empty plan."""
+        if not text or not text.strip():
+            return None
+        return cls(parse_plan(text))
+
+    def armed(self, site: str) -> bool:
+        return site in self._by_site
+
+    def fire(self, site: str, index: int) -> None:
+        """Raise/stall if a fault is scheduled at (site, index) and has
+        not fired yet.  Counted per fire (``faults_injected``)."""
+        spec = self._by_site.get(site, {}).get(index)
+        if spec is None or spec.fired:
+            return
+        spec.fired = True
+        metrics.add("faults_injected")
+        log.warning(f"[faults] firing {spec}")
+        if spec.action == "stall":
+            time.sleep(spec.arg)
+            return
+        if spec.action == "fatal":
+            raise InjectedFatal(f"injected fatal fault at {spec}")
+        if spec.action == "corrupt":
+            raise InjectedCorruption(f"injected corruption at {spec}")
+        raise InjectedFault(f"injected transient fault at {spec}")
+
+    def unfired(self) -> list[FaultSpec]:
+        """Specs that never fired (a test asserting full plan coverage
+        calls this at the end of a run)."""
+        return [s for site in self._by_site.values()
+                for s in site.values() if not s.fired]

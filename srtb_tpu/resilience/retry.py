@@ -1,0 +1,107 @@
+"""Retry with exponential backoff, deterministic jitter and deadlines.
+
+Applied by the pipeline to its six fault sites (ingest, h2d, dispatch,
+fetch, sink_write, checkpoint).  Only failures classified TRANSIENT or
+DATA_LOSS by :func:`srtb_tpu.resilience.errors.classify` are retried;
+FATAL failures and exhausted budgets propagate, which is how a retry
+escalates to the supervisor / clean shutdown.
+
+Jitter is *deterministic* (a hash of site and attempt, not
+``random``): a replayed run with a fault plan backs off identically,
+so recovery tests and soak reproductions are bit-stable in their
+scheduling too.  Every retry is accounted (``retries_total`` plus a
+per-site counter) — recovery that happens silently cannot be
+distinguished from a pipeline that never faults.
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from dataclasses import dataclass
+
+from srtb_tpu.resilience.errors import DATA_LOSS, FATAL, classify
+from srtb_tpu.utils.logging import log
+from srtb_tpu.utils.metrics import metrics
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """``max_attempts`` includes the first try; ``deadline_s`` bounds
+    the total wall clock of one guarded operation including backoff
+    sleeps (0 disables); jitter is a +/- fraction of each backoff."""
+
+    max_attempts: int = 3
+    backoff_base_s: float = 0.05
+    backoff_max_s: float = 2.0
+    jitter: float = 0.25
+    deadline_s: float = 0.0
+
+    @classmethod
+    def from_config(cls, cfg) -> "RetryPolicy | None":
+        """None when retries are configured off (``retry_max_attempts
+        <= 1``) — the pipeline then calls operations directly, the
+        zero-cost-disabled pattern shared with the sanitizer."""
+        attempts = int(getattr(cfg, "retry_max_attempts", 0) or 0)
+        if attempts <= 1:
+            return None
+        return cls(
+            max_attempts=attempts,
+            backoff_base_s=float(getattr(cfg, "retry_backoff_base_s",
+                                         0.05)),
+            backoff_max_s=float(getattr(cfg, "retry_backoff_max_s",
+                                        2.0)),
+            deadline_s=float(getattr(cfg, "retry_deadline_s", 0.0)))
+
+    def backoff(self, site: str, attempt: int) -> float:
+        """Exponential backoff for the given (site, attempt), with
+        deterministic jitter so replayed runs schedule identically."""
+        base = min(self.backoff_max_s,
+                   self.backoff_base_s * (2 ** (attempt - 1)))
+        h = zlib.crc32(f"{site}:{attempt}".encode()) / 0xFFFFFFFF
+        return base * (1.0 + self.jitter * (2.0 * h - 1.0))
+
+
+def retry_call(fn, policy: RetryPolicy, site: str, sleep=time.sleep):
+    """Run ``fn`` under ``policy``; the site name labels counters and
+    log lines.  Raises the last failure when FATAL, when attempts are
+    exhausted, or when the next backoff would cross the deadline.
+
+    The no-failure path is one try/except around ``fn`` — no clocks,
+    no allocations — so wrapping every hot-path operation costs
+    nothing measurable until something actually fails."""
+    try:
+        return fn()
+    except BaseException as e:  # noqa: BLE001 - classified below
+        exc = e
+    t0 = time.monotonic()  # failure path only
+    attempt = 1
+    while True:
+        cat = classify(exc)
+        if cat == FATAL:
+            raise exc
+        if cat == DATA_LOSS:
+            # the retry may succeed, but the loss itself happened
+            metrics.add("data_loss_total")
+        if attempt >= policy.max_attempts:
+            log.error(f"[resilience] {site}: {exc!r} — retry budget "
+                      f"({policy.max_attempts} attempts) exhausted")
+            raise exc
+        delay = policy.backoff(site, attempt)
+        if policy.deadline_s > 0 and \
+                time.monotonic() - t0 + delay > policy.deadline_s:
+            log.error(f"[resilience] {site}: {exc!r} — retry deadline "
+                      f"{policy.deadline_s}s would be exceeded")
+            raise exc
+        metrics.add("retries_total")
+        metrics.add(f"retries_{site}")
+        log.warning(
+            f"[resilience] {site}: {cat} {exc!r}; retrying "
+            f"({attempt}/{policy.max_attempts - 1}) in "
+            f"{delay * 1e3:.0f} ms")
+        sleep(delay)
+        attempt += 1
+        try:
+            return fn()
+        except BaseException as e:  # noqa: BLE001
+            exc = e

@@ -1,0 +1,75 @@
+"""Bounded-restart supervision for crashed workers.
+
+Today a crashed ``framework.Pipe`` worker (the sink drain, the GUI
+server thread) propagates its exception and kills the run — correct
+for bugs, wasteful for a momentary failure eight hours into an
+observation.  A :class:`Supervisor` gives each supervised component a
+restart budget: crashes classified transient (or data-loss) are
+restarted while the budget inside the sliding window lasts; fatal
+crashes and exhausted budgets escalate to the clean-shutdown path the
+runtime already has.
+
+Every restart is accounted: ``worker_restarts`` plus a per-component
+counter, and the journal's v3 ``restarts`` field — a pipeline that is
+quietly restarting its sink every minute must be visible on /metrics.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+
+from srtb_tpu.resilience.errors import FATAL, classify
+from srtb_tpu.utils.logging import log
+from srtb_tpu.utils.metrics import metrics
+
+
+class Supervisor:
+    """Restart-budget bookkeeping for one named component.
+
+    ``should_restart(exc)`` is the whole protocol: the owner of the
+    worker calls it when the worker dies; True means "spawn a
+    replacement" (the restart is counted), False means "escalate"
+    (fatal crash, or budget exhausted within ``window_s``).
+
+    ``restart_fatal=True`` restarts regardless of classification —
+    for best-effort components like the GUI server whose death must
+    never take the observation down with it.
+    """
+
+    def __init__(self, name: str, max_restarts: int = 3,
+                 window_s: float = 60.0, restart_fatal: bool = False,
+                 clock=time.monotonic):
+        self.name = name
+        self.max_restarts = int(max_restarts)
+        self.window_s = float(window_s)
+        self.restart_fatal = restart_fatal
+        self._clock = clock
+        self._restarts: collections.deque[float] = collections.deque()
+
+    @property
+    def restarts(self) -> int:
+        return len(self._restarts)
+
+    def should_restart(self, exc: BaseException) -> bool:
+        if not self.restart_fatal and classify(exc) == FATAL:
+            log.error(f"[supervisor] {self.name}: fatal {exc!r}; "
+                      "escalating (not restartable)")
+            return False
+        now = self._clock()
+        while self._restarts and now - self._restarts[0] > self.window_s:
+            self._restarts.popleft()
+        if len(self._restarts) >= self.max_restarts:
+            log.error(
+                f"[supervisor] {self.name}: {exc!r} — restart budget "
+                f"exhausted ({self.max_restarts} in {self.window_s:g}s);"
+                " escalating to clean shutdown")
+            return False
+        self._restarts.append(now)
+        metrics.add("worker_restarts")
+        metrics.add(f"worker_restarts_{self.name}")
+        log.warning(
+            f"[supervisor] {self.name}: crashed with {exc!r}; "
+            f"restarting ({len(self._restarts)}/{self.max_restarts} "
+            f"in window)")
+        return True

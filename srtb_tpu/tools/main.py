@@ -55,9 +55,19 @@ def main(argv=None) -> int:
             cfg, in_freq=nchan, in_time=n_spec // nchan, out_dir=out_dir)
         if cfg.gui_http_port:
             from srtb_tpu.gui.server import WaterfallHTTPServer
+            from srtb_tpu.resilience.supervisor import Supervisor
             gui_server = WaterfallHTTPServer(
                 out_dir, port=cfg.gui_http_port,
-                health_stale_after_s=cfg.health_stale_after_s).start()
+                health_stale_after_s=cfg.health_stale_after_s,
+                # the configured restart budget covers the GUI server
+                # too (config.py: supervisor_max_restarts, 0 = give up
+                # on the first crash); best-effort, so fatal crashes
+                # restart as well — GUI death never ends the run
+                supervisor=Supervisor(
+                    "gui_server",
+                    max_restarts=cfg.supervisor_max_restarts,
+                    window_s=cfg.supervisor_window_s,
+                    restart_fatal=True)).start()
 
     if cfg.input_file_path and os.path.exists(cfg.input_file_path):
         source = None  # Pipeline builds the file reader

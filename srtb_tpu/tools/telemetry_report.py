@@ -12,10 +12,14 @@ pipeline writes (one record per segment) and reports
   dominant pass" loop of PERF.md, runnable on any past observation;
 - overlap efficiency of the async engine (schema-v2 spans): how much
   host/transfer time hid under device compute vs how much device wait
-  blocked the drain loop, plus in-flight depth statistics.
+  blocked the drain loop, plus in-flight depth statistics;
+- resilience activity (schema-v3 spans): cumulative retry / watchdog-
+  requeue / worker-restart counts, shed dumps and the degradation-
+  level profile — how hard the run had to fight to stay alive.
 
-Mixed v1/v2 journals (rotation can leave a v1 tail after an upgrade)
-are summarized tolerantly: records simply lack the newer fields.
+Mixed v1/v2/v3 journals (rotation can leave an older-schema tail
+after an upgrade) are summarized tolerantly: records simply lack the
+newer fields and drop out of the sections that need them.
 
 Usage: python -m srtb_tpu.tools.telemetry_report JOURNAL.jsonl
            [--bin SECONDS] [--format json|md]
@@ -202,6 +206,29 @@ def overlap_stats(records: list[dict]) -> dict:
     return out
 
 
+def resilience_stats(records: list[dict]) -> dict:
+    """Resilience activity from v3 spans.  The counters are cumulative
+    registry values (like ``segments_dropped``), so the LAST record
+    carries the run totals; the per-record degradation level gives the
+    time-at-degraded profile.  v1/v2 records (no resilience fields)
+    are skipped; empty dict when none qualify."""
+    v3 = [r for r in records if "degrade_level" in r or "retries" in r]
+    if not v3:
+        return {}
+    last = v3[-1]
+    levels = [int(r.get("degrade_level", 0)) for r in v3]
+    return {
+        "records": len(v3),
+        "retries": int(last.get("retries", 0)),
+        "requeues": int(last.get("requeues", 0)),
+        "restarts": int(last.get("restarts", 0)),
+        "shed_waterfalls": int(last.get("shed_waterfalls", 0)),
+        "shed_baseband": int(last.get("shed_baseband", 0)),
+        "degrade_level_max": max(levels),
+        "segments_degraded": sum(1 for lv in levels if lv > 0),
+    }
+
+
 def report(path: str, bin_s: float = 10.0) -> dict:
     records = load(path)
     return {
@@ -209,6 +236,7 @@ def report(path: str, bin_s: float = 10.0) -> dict:
         "records": len(records),
         "stages": stage_stats(records),
         "overlap": overlap_stats(records),
+        "resilience": resilience_stats(records),
         "timeline": timeline(records, bin_s),
     }
 
@@ -235,6 +263,17 @@ def _md(rep: dict) -> str:
             lines.append(
                 f"in-flight depth: mean {ov['inflight_depth_mean']}, "
                 f"max {ov['inflight_depth_max']}")
+    rs = rep.get("resilience") or {}
+    if rs:
+        lines += ["", "## Resilience", "",
+                  f"retries: {rs['retries']}, watchdog requeues: "
+                  f"{rs['requeues']}, worker restarts: "
+                  f"{rs['restarts']}, shed waterfalls: "
+                  f"{rs['shed_waterfalls']}, shed baseband dumps: "
+                  f"{rs['shed_baseband']}",
+                  f"degradation: max level {rs['degrade_level_max']}, "
+                  f"{rs['segments_degraded']}/{rs['records']} segments "
+                  "drained at a degraded level"]
     lines += ["", "## Throughput timeline", "",
               "| t (s) | segments | seg/s | Msamples/s | detections | "
               "dumps | pkts lost |", "|---|---|---|---|---|---|---|"]

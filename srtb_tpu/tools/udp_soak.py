@@ -10,7 +10,14 @@ reports what the receiver actually sustained.
 
 Usage:
     python -m srtb_tpu.tools.udp_soak [--packets N] \
-        [--impl native|packet_ring|python|continuous]
+        [--impl native|packet_ring|python|continuous] \
+        [--fault-plan "ingest:raise@3,..."]
+
+``--fault-plan`` arms the resilience fault injector on the receive
+loop (site ``ingest``, index = block number) and wraps each
+``receive_block`` in the default retry policy — the soak-level proof
+that ingest survives scheduled transient faults with the retries
+accounted in the output (``retries`` field).
 
 Prints one JSON line:
   {"pps": ..., "gbps": ..., "payload_bytes": ..., "received": ...,
@@ -74,10 +81,13 @@ def _sender(port: int, fmt, n_packets: int, started: threading.Event,
 
 def run_soak(n_packets: int = 20000, impl: str = "auto",
              packets_per_block: int = 64, port: int = 42100,
-             pace_gbps: float = 0.0) -> dict:
+             pace_gbps: float = 0.0, fault_plan: str = "") -> dict:
     """``pace_gbps > 0`` throttles the sender to that payload rate —
     used to demonstrate loss-free ingest at the real-time requirement;
     0 blasts at full speed to find the ceiling."""
+    from srtb_tpu.resilience.faults import FaultInjector
+    from srtb_tpu.resilience.retry import RetryPolicy, retry_call
+    from srtb_tpu.utils.metrics import metrics
     fmt = formats.FASTMB_ROACH2  # 8-byte counter header + 4096-byte payload
     if impl == "auto":
         impl = "native" if udp._NATIVE is not None else "python"
@@ -99,13 +109,23 @@ def run_soak(n_packets: int = 20000, impl: str = "auto",
                                     pace_pps))
     sender.start()
 
+    injector = FaultInjector.from_plan(fault_plan)
+    policy = RetryPolicy(backoff_base_s=0.001)
+    retries_before = metrics.get("retries_total")
+
     block = np.zeros(packets_per_block * fmt.payload_bytes, dtype=np.uint8)
     n_blocks = n_packets // packets_per_block
     started.set()
     t0 = time.perf_counter()
     received_payload_bytes = 0
-    for _ in range(n_blocks - 1):  # leave sender headroom for the tail
-        rx.receive_block(block)
+    for i in range(n_blocks - 1):  # leave sender headroom for the tail
+        if injector is None:
+            rx.receive_block(block)
+        else:
+            def guarded(index=i):
+                injector.fire("ingest", index)
+                return rx.receive_block(block)
+            retry_call(guarded, policy, "ingest")
         received_payload_bytes += block.nbytes
     dt = time.perf_counter() - t0
     sender.join()
@@ -117,6 +137,8 @@ def run_soak(n_packets: int = 20000, impl: str = "auto",
     return {
         "impl": impl,
         "pace_gbps": pace_gbps,
+        "fault_plan": fault_plan,
+        "retries": int(metrics.get("retries_total") - retries_before),
         "pps": round(pps),
         "gbps": round(gbps, 3),
         "payload_bytes": fmt.payload_bytes,
@@ -137,9 +159,13 @@ def main(argv=None) -> int:
                             "continuous"])
     p.add_argument("--port", type=int, default=42100)
     p.add_argument("--pace-gbps", type=float, default=0.0)
+    p.add_argument("--fault-plan", default="",
+                   help="resilience fault plan for the receive loop "
+                        "(site 'ingest', index = block number)")
     args = p.parse_args(argv)
     print(json.dumps(run_soak(args.packets, args.impl, port=args.port,
-                              pace_gbps=args.pace_gbps)))
+                              pace_gbps=args.pace_gbps,
+                              fault_plan=args.fault_plan)))
     return 0
 
 

@@ -27,9 +27,16 @@ from srtb_tpu.utils.metrics import metrics
 # v2 (async overlap engine): adds ``overlap_hidden_ms`` (host/transfer
 # time hidden under device compute for this segment) and
 # ``inflight_depth`` (dispatched-not-yet-drained segments at drain
-# time).  Readers must tolerate a mixed v1/v2 journal: rotation can
-# leave a v1 tail in ``<path>.1`` after an upgrade.
-SPAN_SCHEMA_VERSION = 2
+# time).
+# v3 (resilience): adds the degradation state at drain
+# (``degrade_level``) and the cumulative recovery counters
+# ``retries`` / ``requeues`` / ``restarts`` / ``shed_waterfalls`` /
+# ``shed_baseband`` (same cumulative convention as
+# ``segments_dropped``: deltas between consecutive records localize a
+# recovery burst to a segment).  Readers must tolerate mixed
+# v1/v2/v3 journals: rotation can leave an older-schema tail in
+# ``<path>.1`` after an upgrade.
+SPAN_SCHEMA_VERSION = 3
 
 # gauge names shared between the pipeline (writer) and health() (reader)
 LAST_SEGMENT_MONOTONIC = "last_segment_monotonic"
@@ -137,6 +144,13 @@ def segment_span(segment: int, stages_s: dict, queue_depth: int,
         "packets_total": metrics.get("packets_total"),
         "packets_lost": metrics.get("packets_lost"),
         "segments_dropped": metrics.get("segments_dropped"),
+        # v3 resilience fields (cumulative registry values at drain)
+        "degrade_level": int(metrics.get("degrade_level")),
+        "retries": int(metrics.get("retries_total")),
+        "requeues": int(metrics.get("watchdog_requeues")),
+        "restarts": int(metrics.get("worker_restarts")),
+        "shed_waterfalls": int(metrics.get("shed_waterfalls")),
+        "shed_baseband": int(metrics.get("shed_baseband")),
     }
     if overlap_hidden_s is not None:
         rec["overlap_hidden_ms"] = round(

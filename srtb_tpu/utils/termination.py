@@ -66,6 +66,40 @@ def leaked_threads(snapshot: set[int], grace_s: float = 1.0,
         time.sleep(0.02)
 
 
+def format_thread_stacks(threads) -> str:
+    """Current stack of each given thread (via ``sys._current_frames``)
+    — what a wedged pipe was doing when the bounded join gave up."""
+    frames = sys._current_frames()
+    parts = []
+    for t in threads:
+        header = (f"--- thread {t.name!r} (ident {t.ident}, "
+                  f"daemon={t.daemon}) ---")
+        frame = frames.get(t.ident)
+        if frame is None:
+            parts.append(header + "\n  <no frame: already exiting>")
+        else:
+            parts.append(header + "\n"
+                         + "".join(traceback.format_stack(frame)))
+    return "\n".join(parts)
+
+
+def report_wedged(threads, context: str) -> None:
+    """Leaked/wedged-thread report for bounded shutdown paths
+    (framework.on_exit, the pipeline's bounded sink join): one loud
+    log block with each thread's name and current stack, plus the
+    ``wedged_threads`` counter so a quietly-wedging deployment shows
+    on /metrics."""
+    threads = [t for t in threads if t.is_alive()]
+    if not threads:
+        return
+    from srtb_tpu.utils.metrics import metrics
+    metrics.add("wedged_threads", len(threads))
+    log.error(f"[termination] {len(threads)} thread(s) still alive "
+              f"after {context}:")
+    for line in format_thread_stacks(threads).splitlines():
+        log.error(line)
+
+
 def install_termination_handler() -> None:
     global _installed
     if _installed:

@@ -374,6 +374,91 @@ class TestUnguardedSharedState:
         assert _rules(fs) == ["unguarded-shared-state"]
 
 
+# ------------------------------------------------ swallowed-except
+
+
+class TestSwallowedExcept:
+    def test_bare_except_pass_positive(self, tmp_path):
+        _write(tmp_path, "io/reader.py", """
+            def read(f):
+                try:
+                    return f.read()
+                except:
+                    pass
+        """)
+        fs = _run(tmp_path)
+        assert _rules(fs) == ["swallowed-except"]
+        assert "everything" in fs[0].message
+        assert fs[0].context == "read"
+
+    def test_broad_except_dropped_positive(self, tmp_path):
+        _write(tmp_path, "pipeline/engine.py", """
+            def drain(item):
+                try:
+                    item.flush()
+                except Exception:
+                    return None
+        """)
+        fs = _run(tmp_path)
+        assert _rules(fs) == ["swallowed-except"]
+        assert "Exception" in fs[0].message
+
+    def test_negative_logged_reraised_or_used(self, tmp_path):
+        _write(tmp_path, "pipeline/engine.py", """
+            from srtb_tpu.utils.logging import log
+
+            def a(item):
+                try:
+                    item.flush()
+                except Exception:
+                    log.warning("flush failed")
+
+            def b(item):
+                try:
+                    item.flush()
+                except Exception:
+                    raise RuntimeError("flush failed")
+
+            def c(self, item):
+                try:
+                    item.flush()
+                except BaseException as e:
+                    self.exception = e
+        """)
+        assert _run(tmp_path) == []
+
+    def test_negative_narrow_except(self, tmp_path):
+        # a named exception type is a documented decision: out of scope
+        _write(tmp_path, "io/reader.py", """
+            def read(sock):
+                try:
+                    return sock.recv(1)
+                except OSError:
+                    pass
+        """)
+        assert _run(tmp_path) == []
+
+    def test_negative_outside_pipeline_io_scope(self, tmp_path):
+        _write(tmp_path, "gui/tap.py", """
+            def tap(frame):
+                try:
+                    frame.render()
+                except Exception:
+                    pass
+        """)
+        assert _run(tmp_path) == []
+
+    def test_pragma_suppresses(self, tmp_path):
+        _write(tmp_path, "io/reader.py", """
+            def probe(x):
+                try:
+                    return x.ready()
+                except Exception:  # srtb-lint: disable=swallowed-except
+                    return True
+        """)
+        assert _run(tmp_path) == []
+
+
 # ------------------------------------------- baseline & CLI behavior
 
 

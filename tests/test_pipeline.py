@@ -518,6 +518,7 @@ def test_staged_pallas2_all_fusions_flagship(monkeypatch):
     np.testing.assert_allclose(got, base, rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.slow  # pallas2-interpret compile of the 2^26 leg: ~3-4 min
 def test_staged_pallas2_blocked_2bit_production_format(monkeypatch):
     """The staged_blocked_pallas2 queue probe's exact composition in
     miniature: 2-bit blocked planes (p = 2 packed plane pairs, the
