@@ -122,15 +122,21 @@ def pick_platform():
     return "cpu", err
 
 
-def roofline_model(n: int, channel_count: int, nbits: int):
+def roofline_model(n: int, channel_count: int, nbits: int,
+                   hbm_passes: int = 7):
     """Static FLOP / HBM-byte model of one segment (documented in PERF.md).
 
     FFT work (5 m log2 m per length-m complex FFT, m = n/2 packed C2C):
     segment R2C + per-channel backward C2C; elementwise stages modeled at
-    ~30 flops/bin.  HBM bytes: the input read plus one read+write of the
-    complex spectrum per non-fusable stage group (R2C, RFI+chirp, watfft,
-    SK+detect read) — the *minimum* traffic XLA's fusion can reach, which
-    makes achieved_gbps an honest lower-bound estimate.
+    ~30 flops/bin.  HBM bytes: the input read plus ``hbm_passes``
+    spectrum-sized sweeps — the *plan-dependent* traffic floor, taken
+    from ``SegmentProcessor.hbm_passes`` (7 for the legacy chain: R2C
+    read+write, RFI+chirp read+write, watfft read+write, SK+detect
+    read; <= 4 for the fused plans that fold RFI/chirp into the R2C's
+    final pass and SK/detect into the watfft write).  Computing the
+    model from the per-plan count keeps ``roofline_frac`` honest: a
+    fused plan is measured against its own smaller floor instead of
+    being silently flattered by the legacy 7-pass model.
     """
     m = n // 2
     wlen = max(m // channel_count, 1)
@@ -139,7 +145,7 @@ def roofline_model(n: int, channel_count: int, nbits: int):
         + 30.0 * m
     input_bytes = n * abs(nbits) / 8.0
     spectrum_bytes = 8.0 * m  # complex64
-    bytes_moved = input_bytes + spectrum_bytes * (2 + 2 + 2 + 1)
+    bytes_moved = input_bytes + spectrum_bytes * hbm_passes
     return flops, bytes_moved
 
 
@@ -162,10 +168,18 @@ def parse_args(argv=None):
 
     p = argparse.ArgumentParser()
     p.add_argument("--overlap", choices=("on", "off"), default="on")
+    # fused spectrum tail A/B legs (Config.fused_tail): "on" forces the
+    # epilogue-fused plans (requires a non-monolithic strategy, e.g.
+    # SRTB_BENCH_FFT_STRATEGY=four_step), "off" the legacy 7-pass chain,
+    # "auto" the plan's own resolution.  SRTB_BENCH_FUSED_TAIL is the
+    # env spelling the queue scripts use.
+    p.add_argument("--fused-tail", choices=("auto", "on", "off"),
+                   default=os.environ.get("SRTB_BENCH_FUSED_TAIL", "auto"))
     return p.parse_args(argv)
 
 
-def run_bench(platform_error, overlap: str = "on"):
+def run_bench(platform_error, overlap: str = "on",
+              fused_tail: str = "auto"):
     import jax
 
     from srtb_tpu.utils.platform import apply_platform_env
@@ -210,6 +224,7 @@ def run_bench(platform_error, overlap: str = "on"):
         use_pallas=bool(int(os.environ.get("SRTB_BENCH_USE_PALLAS", "0"))),
         use_pallas_sk=bool(int(os.environ.get("SRTB_BENCH_USE_PALLAS_SK",
                                               "0"))),
+        fused_tail=fused_tail,
         # AOT executable cache A/B (utils/aot_cache): run the same
         # config twice with this set — the second run's compile_s is
         # the AOT warm-restart number
@@ -286,7 +301,8 @@ def run_bench(platform_error, overlap: str = "on"):
     msamples = samples_per_sec / 1e6
     realtime_factor = samples_per_sec / cfg.baseband_sample_rate
     flops, bytes_moved = roofline_model(n, channels,
-                                        cfg.baseband_input_bits)
+                                        cfg.baseband_input_bits,
+                                        hbm_passes=proc.hbm_passes)
     out = {
         "metric": "coherent_dedispersion_pipeline_throughput",
         "value": round(msamples, 2),
@@ -301,6 +317,13 @@ def run_bench(platform_error, overlap: str = "on"):
         "model_hbm_gb": round(bytes_moved / 1e9, 3),
         "achieved_gbps": round(bytes_moved / dt / 1e9, 1),
         "overlap": overlap,
+        # per-plan traffic model inputs (spectrum-pass fusion): the plan
+        # that actually ran and its modeled spectrum-sweep count, so
+        # every artifact line is self-describing about which floor its
+        # roofline_frac was computed against
+        "plan": proc.plan_name,
+        "hbm_passes": proc.hbm_passes,
+        "fused_tail": "on" if proc.fused_tail else "off",
     }
     if cfg.aot_plan_path:
         # whether the AOT executable cache actually engaged — the
@@ -355,7 +378,7 @@ def main():
     os.environ["JAX_PLATFORMS"] = platform
     watchdog = _arm_watchdog(platform, err)
     try:
-        run_bench(err, overlap=args.overlap)
+        run_bench(err, overlap=args.overlap, fused_tail=args.fused_tail)
         # disarm before teardown: a slow runtime shutdown must not fire
         # a second, contradictory diagnostic line after the real result
         if watchdog is not None:

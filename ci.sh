@@ -24,13 +24,13 @@ if [ "${1:-}" = "--hardware" ]; then
   exit 0
 fi
 
-echo "== [1/9] native build =="
+echo "== [1/10] native build =="
 make -C srtb_tpu/native
 
-echo "== [2/9] native sanitizer harness (ASan/UBSan) =="
+echo "== [2/10] native sanitizer harness (ASan/UBSan) =="
 make -C srtb_tpu/native check
 
-echo "== [3/9] static checks (compile + import) =="
+echo "== [3/10] static checks (compile + import) =="
 python -m compileall -q srtb_tpu tests bench.py __graft_entry__.py
 python - <<'EOF'
 import importlib, pkgutil
@@ -45,12 +45,12 @@ assert not bad, bad
 print(f"all srtb_tpu modules import cleanly")
 EOF
 
-echo "== [4/9] srtb-lint (static analysis vs baseline) =="
+echo "== [4/10] srtb-lint (static analysis vs baseline) =="
 # fails on findings not in srtb_tpu/analysis/baseline.json; accept an
 # intentional finding with --write-baseline + a note, or a pragma
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.lint srtb_tpu/
 
-echo "== [5/9] pytest (8-device CPU mesh) =="
+echo "== [5/10] pytest (8-device CPU mesh) =="
 FAST_ARGS=()
 if [ "${1:-}" = "--fast" ]; then
   # one source of truth for what "slow" means: the pytest marker
@@ -59,10 +59,53 @@ if [ "${1:-}" = "--fast" ]; then
 fi
 python -m pytest tests/ -q "${FAST_ARGS[@]}"
 
-echo "== [6/9] bench smoke =="
+echo "== [6/10] bench smoke =="
 JAX_PLATFORMS=cpu SRTB_BENCH_LOG2N=16 python bench.py | tail -1
 
-echo "== [7/9] telemetry + sanitizer smoke (journal + report + /metrics + /healthz + Config.sanitize) =="
+echo "== [7/10] fused-plan parity (spectrum-pass fusion, Pallas interpret on CPU) =="
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+
+from srtb_tpu.config import Config
+from srtb_tpu.io.synth import make_dispersed_baseband
+from srtb_tpu.pipeline.segment import SegmentProcessor, waterfall_to_numpy
+
+n = 1 << 16
+base = dict(baseband_input_count=n, baseband_input_bits=2,
+            baseband_format_type="simple", baseband_freq_low=1405.0,
+            baseband_bandwidth=64.0, baseband_sample_rate=128e6, dm=30.0,
+            spectrum_channel_count=8,
+            mitigate_rfi_average_method_threshold=25.0,
+            mitigate_rfi_spectral_kurtosis_threshold=1.05,
+            signal_detect_signal_noise_threshold=5.0,
+            signal_detect_max_boxcar_length=8,
+            baseband_reserve_sample=False, fft_strategy="four_step")
+raw = make_dispersed_baseband(n, 1405.0, 64.0, 30.0,
+                              pulse_positions=n // 2, pulse_amp=30.0,
+                              nbits=2)
+
+legacy = SegmentProcessor(Config(fused_tail="off", **base))
+fused = SegmentProcessor(Config(fused_tail="on", use_pallas=True,
+                                use_pallas_sk=True, **base))
+assert legacy.hbm_passes == 7 and fused.hbm_passes == 4, (
+    legacy.hbm_passes, fused.hbm_passes)
+assert fused._skzap and fused.plan_name.endswith("+ftail+skzap")
+assert legacy.plan_signature() != fused.plan_signature()
+wf_l, res_l = legacy.process(raw)
+wf_f, res_f = fused.process(raw)
+np.testing.assert_array_equal(np.asarray(res_l.signal_counts),
+                              np.asarray(res_f.signal_counts))
+np.testing.assert_array_equal(np.asarray(res_l.zero_count),
+                              np.asarray(res_f.zero_count))
+a, b = waterfall_to_numpy(wf_l), waterfall_to_numpy(wf_f)
+scale = np.abs(a).max()
+np.testing.assert_allclose(b, a, atol=1e-3 * scale, rtol=0)
+print(f"fused-plan parity OK: plan {fused.plan_name} "
+      f"(hbm_passes {fused.hbm_passes}) matches legacy 7-pass chain, "
+      "detections bit-identical")
+EOF
+
+echo "== [8/10] telemetry + sanitizer smoke (journal + report + /metrics + /healthz + Config.sanitize) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import json, os, tempfile, urllib.request
 
@@ -138,7 +181,7 @@ print(f"sanitizer smoke OK: {stats_s.segments} segments with "
       "Config.sanitize on, tripwire restored")
 EOF
 
-echo "== [8/9] fault-injection smoke (one transient fault at every site -> recovery + v3 telemetry) =="
+echo "== [9/10] fault-injection smoke (one transient fault at every site -> recovery + v3 telemetry) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import json, os, tempfile
 
@@ -216,7 +259,7 @@ print(f"fault-injection smoke OK: {st1.segments} segments recovered "
       "/metrics + v3 journal")
 EOF
 
-echo "== [9/9] multichip dryrun (8 virtual devices) =="
+echo "== [10/10] multichip dryrun (8 virtual devices) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

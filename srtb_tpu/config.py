@@ -134,6 +134,27 @@ class Config:
     # (PERF_TPU.jsonl kernel rows) — opt-in for shapes where the 2-read
     # pass wins
     use_pallas_sk: bool = False
+    # fused spectrum tail ("auto" | "on" | "off"): fold RFI stage 1 +
+    # the dedispersion chirp into the forward FFT's final (Hermitian
+    # post-process) pass so the spectrum is written to HBM exactly once,
+    # already zapped/normalized/masked/chirped; with use_pallas +
+    # use_pallas_sk the SK zap + detection time series additionally fold
+    # into the waterfall FFT's write (ops/pallas_fft.fft_rows_skzap_ri)
+    # and the detect stage never re-reads the waterfall.  "auto" = on
+    # for every plan whose final pass can host the epilogue (four_step /
+    # mxu / pallas / pallas2 / staged), off for the monolithic XLA R2C
+    # custom call; "on" forces it (errors on monolithic); "off"
+    # restores the legacy 7-pass chain.  SegmentProcessor.hbm_passes
+    # reports the resulting modeled spectrum-pass count (bench.py
+    # roofline).
+    fused_tail: str = "auto"
+    # escape hatch: force the exact per-element df64 chirp evaluation
+    # (~3 df64 divisions/channel) instead of the anchored-Taylor fast
+    # path that is the default everywhere (segment plans, Pallas
+    # kernels, DM-grid on-device banks) — a paranoia/A-B knob; the
+    # anchored path agrees with the exact one to ~1e-9 turns
+    # (ops/dedisperse.anchored_chirp_consts error budget)
+    chirp_exact: bool = False
     # bounded window of segments dispatched to the device before the
     # oldest result is drained (pipeline/runtime.py async engine):
     # ingest + unpack + H2D staging of segment k+1..k+W-1 run while the
@@ -287,7 +308,7 @@ class Config:
     _BOOL_FIELDS = frozenset({
         "baseband_reserve_sample", "baseband_write_all", "gui_enable",
         "use_emulated_fp64", "use_pallas", "use_pallas_sk", "sanitize",
-        "degrade_enable",
+        "degrade_enable", "chirp_exact",
     })
     _LIST_FIELDS = frozenset({
         "udp_receiver_address", "udp_receiver_port",

@@ -84,16 +84,18 @@ def chirp_factor_host(n: int, f_min: float, df: float, f_c: float,
 
 def chirp_factor_df64(n: int, f_min: float, df: float, f_c: float, dm,
                       dtype=jnp.complex64, i0: int = 0,
-                      dm_lo=None) -> jnp.ndarray:
+                      dm_lo=None, exact: bool = False) -> jnp.ndarray:
     """Same chirp computed on device with two-float (df64) arithmetic —
     jittable, dm may be a traced scalar (DM-search grids).  ``i0``
     generates the block of channels starting at that global index.
+    ``exact=True`` forces the per-element df64 division chains instead
+    of the anchored-Taylor fast path (Config.chirp_exact escape hatch).
 
     Mirrors phase_factor_v3 with phase_real = dsmath::df64
     (ref: coherent_dedispersion.hpp:31-53,134-150).
     """
     delta_phi = _chirp_phase_df64(n, f_min, df, f_c, dm, i0=i0,
-                                  dm_lo=dm_lo)
+                                  dm_lo=dm_lo, exact=exact)
     return (jnp.cos(delta_phi) + 1j * jnp.sin(delta_phi)).astype(dtype)
 
 
@@ -112,11 +114,13 @@ def chirp_factor_host_ri(n: int, f_min: float, df: float, f_c: float,
 
 def chirp_factor_df64_ri(n: int, f_min: float, df: float, f_c: float,
                          dm, i0: int = 0, dm_lo=None,
-                         anchor_consts=None) -> jnp.ndarray:
+                         anchor_consts=None,
+                         exact: bool = False) -> jnp.ndarray:
     """df64 on-device chirp as stacked (cos, sin) float32 [2, n] — jit-safe
-    output dtype on complex-less runtimes."""
+    output dtype on complex-less runtimes.  ``exact=True`` forces the
+    per-element division chains (Config.chirp_exact escape hatch)."""
     phase = _chirp_phase_df64(n, f_min, df, f_c, dm, i0=i0, dm_lo=dm_lo,
-                              anchor_consts=anchor_consts)
+                              anchor_consts=anchor_consts, exact=exact)
     return jnp.stack([jnp.cos(phase), jnp.sin(phase)])
 
 
@@ -293,7 +297,8 @@ def _chirp_phase_df64_anchored(n: int, consts, i0=0, dm_d=None):
 
 
 def _chirp_phase_df64(n: int, f_min: float, df: float, f_c: float, dm,
-                      i0: int = 0, dm_lo=None, anchor_consts=None):
+                      i0: int = 0, dm_lo=None, anchor_consts=None,
+                      exact: bool = False):
     """delta_phi [n] in f32 via df64 arithmetic (shared by the complex and
     split-ri chirp generators).
 
@@ -307,7 +312,11 @@ def _chirp_phase_df64(n: int, f_min: float, df: float, f_c: float, dm,
     above).  Traced dm — DM-search trials — takes it too when the caller
     passes ``anchor_consts`` (built once with unit_dm=True at the grid's
     max |dm|); otherwise the exact per-element evaluation runs.
+    ``exact=True`` skips the anchored path entirely — the
+    Config.chirp_exact escape hatch and the hardware A/B knob.
     """
+    if exact:
+        anchor_consts = None
     if anchor_consts is not None:
         if dm_lo is None and isinstance(dm, (int, float, np.floating)):
             # same guard as the exact path below: a concrete dm must be
@@ -321,7 +330,7 @@ def _chirp_phase_df64(n: int, f_min: float, df: float, f_c: float, dm,
                 else jnp.asarray(dm_lo, dtype=jnp.float32)
         return _chirp_phase_df64_anchored(
             n, anchor_consts, i0=i0, dm_d=(dm_arr, dm_lo_arr))
-    if dm_lo is None:
+    if dm_lo is None and not exact:
         consts = anchored_chirp_consts(n, f_min, df, f_c, dm, i0=i0)
         if consts is not None:
             return _chirp_phase_df64_anchored(n, consts, i0=i0)

@@ -292,7 +292,8 @@ def four_step_fft(x: jnp.ndarray, inverse: bool = False,
 
 def rfft_via_c2c(x: jnp.ndarray, use_four_step: bool = False,
                  drop_nyquist: bool = False,
-                 len_cap: int | None = None) -> jnp.ndarray:
+                 len_cap: int | None = None,
+                 epilogue=None, premul=None) -> jnp.ndarray:
     """R2C FFT of 2m reals via one m-point C2C plus Hermitian post-process,
     returning m+1 bins (like rfft), or exactly m bins with
     ``drop_nyquist`` (the pipeline convention, ref: fft_pipe.hpp:75-77).
@@ -310,7 +311,8 @@ def rfft_via_c2c(x: jnp.ndarray, use_four_step: bool = False,
     z = pack_even_odd(x)
     zf = four_step_fft(z, len_cap=len_cap) if use_four_step \
         else jnp.fft.fft(z)
-    return hermitian_rfft_post(zf, drop_nyquist)
+    return hermitian_rfft_post(zf, drop_nyquist, epilogue=epilogue,
+                               premul=premul)
 
 
 def pack_even_odd(x: jnp.ndarray) -> jnp.ndarray:
@@ -335,20 +337,42 @@ def pack_even_odd(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def hermitian_rfft_post(zf: jnp.ndarray,
-                        drop_nyquist: bool = False) -> jnp.ndarray:
+                        drop_nyquist: bool = False,
+                        epilogue=None,
+                        premul=None) -> jnp.ndarray:
     """Hermitian post-process of the packed half-size C2C: F[m] -> X of
     the 2m-real rfft (ref: fft/fft_1d_r2c_post_process.hpp:33-82).
     X[k] = F[k] + conj(F[m-k]) pieces; the m-k indexing is a reverse +
     shift, written as flip/roll/concat (not a gather, which TPUs handle
-    poorly at this size)."""
+    poorly at this size).
+
+    ``epilogue``: optional ``f(zf, spec) -> spec`` applied to the
+    assembled spectrum *inside the same elementwise producer*, so XLA
+    writes the post-processed spectrum exactly once — the hook the
+    fused spectrum tail (RFI s1 + chirp, pipeline/segment.py) hangs
+    off.  ``zf`` is passed along so the epilogue can evaluate global
+    reductions (the RFI mean power, via ``rfi.mean_power_packed``)
+    against the FFT's already-materialized input instead of re-reading
+    the spectrum.
+
+    ``premul``: optional ``(c, cw)`` complex arrays [.., m] implementing
+    the chirp·twiddle precombination: the output becomes
+    ``c·even + cw·odd`` where ``cw = c·w`` was combined with the
+    Hermitian twiddle ahead of time — the chirp multiply costs no extra
+    pass and no in-trace trig when a chirp bank exists.  Requires
+    ``drop_nyquist`` (the pipeline convention; the m+1-bin form has no
+    precombined bank).
+    """
     m = zf.shape[-1]
     n = 2 * m
     if drop_nyquist:
         f_k = zf                                           # k in [0, m)
         # [(m-0)%m, m-1, ..., 1] = roll(flip(zf), 1)
         f_mk = jnp.conj(jnp.roll(jnp.flip(zf, axis=-1), 1, axis=-1))
-        w = _iota_phase(m, n, -1.0)
+        w = None if premul is not None else _iota_phase(m, n, -1.0)
     else:
+        if premul is not None:
+            raise ValueError("premul requires drop_nyquist=True")
         f_k = jnp.concatenate([zf, zf[..., :1]], axis=-1)  # F[m] = F[0]
         rev = jnp.flip(zf, axis=-1)                        # [m-1, ..., 0]
         f_mk = jnp.conj(jnp.concatenate([zf[..., :1], rev], axis=-1))
@@ -357,7 +381,14 @@ def hermitian_rfft_post(zf: jnp.ndarray,
         w = _phase_exp(jax.lax.iota(jnp.int32, m + 1), n, -1.0)
     even = 0.5 * (f_k + f_mk)
     odd = -0.5j * (f_k - f_mk)
-    return even + w * odd
+    if premul is not None:
+        c, cw = premul
+        out = c * even + cw * odd
+    else:
+        out = even + w * odd
+    if epilogue is not None:
+        out = epilogue(zf, out)
+    return out
 
 
 def subbyte_window_planes(window: np.ndarray, nbits: int) -> np.ndarray:
@@ -373,7 +404,8 @@ def rfft_subbyte(data: jnp.ndarray, nbits: int, strategy: str = "four_step",
                  window_planes: jnp.ndarray | None = None,
                  drop_nyquist: bool = True,
                  planes: jnp.ndarray | None = None,
-                 len_cap: int | None = None) -> jnp.ndarray:
+                 len_cap: int | None = None,
+                 epilogue=None, premul=None) -> jnp.ndarray:
     """Fused unpack + even/odd pack + R2C for 1/2/4-bit baseband bytes,
     with every intermediate lane-dense.
 
@@ -425,7 +457,8 @@ def rfft_subbyte(data: jnp.ndarray, nbits: int, strategy: str = "four_step",
         a = _pallas2_or_fallback(z, strategy, len_cap)
     else:
         a = _fft_minor(z, inverse=False, len_cap=len_cap)
-    return finish_rfft_subbyte(a, drop_nyquist)
+    return finish_rfft_subbyte(a, drop_nyquist, epilogue=epilogue,
+                               premul=premul)
 
 
 def _pallas2_or_fallback(z: jnp.ndarray, strategy: str,
@@ -453,7 +486,8 @@ def subbyte_planes_to_packed(planes: jnp.ndarray) -> jnp.ndarray:
 
 
 def finish_rfft_subbyte(a: jnp.ndarray,
-                        drop_nyquist: bool = True) -> jnp.ndarray:
+                        drop_nyquist: bool = True,
+                        epilogue=None, premul=None) -> jnp.ndarray:
     """Finish `rfft_subbyte` from the per-plane FFTs a[..., p, M]:
     twiddle + p-point cross-plane butterfly + Hermitian post-process.
     Split out so the staged execution plan (pipeline/segment.py) can run
@@ -473,7 +507,8 @@ def finish_rfft_subbyte(a: jnp.ndarray,
                 for k2 in range(p)]
         a = jnp.stack(rows, axis=-2)
     zf = a.reshape(*a.shape[:-2], m)
-    return hermitian_rfft_post(zf, drop_nyquist)
+    return hermitian_rfft_post(zf, drop_nyquist, epilogue=epilogue,
+                               premul=premul)
 
 
 # Threshold (packed C2C length, = n/2) above which the segment R2C
@@ -493,8 +528,15 @@ def resolve_strategy(n: int, strategy: str) -> str:
 
 
 def segment_rfft(x: jnp.ndarray, strategy: str = "auto",
-                 len_cap: int | None = None) -> jnp.ndarray:
+                 len_cap: int | None = None,
+                 epilogue=None, premul=None) -> jnp.ndarray:
     """The segment-sized R2C with the drop-Nyquist convention.
+
+    ``epilogue``/``premul`` fold elementwise spectrum work into the
+    final (Hermitian post-process) pass — see
+    :func:`hermitian_rfft_post`.  The monolithic strategy cannot host
+    them (the spectrum is produced inside XLA's R2C custom call) and
+    raises rather than silently running unfused.
 
     strategy:
     - "auto": monolithic below the four-step threshold, four_step above
@@ -515,20 +557,28 @@ def segment_rfft(x: jnp.ndarray, strategy: str = "auto",
       and no XLA FFT op anywhere.
     """
     strategy = resolve_strategy(x.shape[-1], strategy)
+    if strategy == "monolithic" and (epilogue is not None
+                                     or premul is not None):
+        raise ValueError(
+            "the monolithic XLA R2C cannot host a spectrum epilogue")
     if strategy in ("pallas2", "pallas2_interpret"):
         zf = _pallas2_or_fallback(pack_even_odd(x), strategy, len_cap)
-        return hermitian_rfft_post(zf, drop_nyquist=True)
+        return hermitian_rfft_post(zf, drop_nyquist=True,
+                                   epilogue=epilogue, premul=premul)
     if strategy in ("pallas", "pallas_interpret"):
         z = pack_even_odd(x)
         zf = four_step_fft(z, rows_impl=strategy, len_cap=len_cap)
-        return hermitian_rfft_post(zf, drop_nyquist=True)
+        return hermitian_rfft_post(zf, drop_nyquist=True,
+                                   epilogue=epilogue, premul=premul)
     if strategy == "four_step":
         return rfft_via_c2c(x, use_four_step=True, drop_nyquist=True,
-                            len_cap=len_cap)
+                            len_cap=len_cap, epilogue=epilogue,
+                            premul=premul)
     if strategy == "mxu":
         from srtb_tpu.ops.mxu_fft import mxu_fft
         z = pack_even_odd(x)
-        return hermitian_rfft_post(mxu_fft(z), drop_nyquist=True)
+        return hermitian_rfft_post(mxu_fft(z), drop_nyquist=True,
+                                   epilogue=epilogue, premul=premul)
     if strategy == "monolithic":
         return rfft_drop_nyquist(x)
     raise ValueError(f"unknown fft strategy {strategy!r}")

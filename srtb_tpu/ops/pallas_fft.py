@@ -174,6 +174,128 @@ def _fft_rows_stats_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref,
     s4_ref[:] = jnp.sum(p * p, axis=2)
 
 
+def _fft_rows_skzap_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref,
+                           wbi_ref, twr_ref, twi_ref, dwr_ref,
+                           out_re_ref, out_im_ref, zap_ref, fs_ref,
+                           ts_ref, *, la, lb, rows, apply_dewindow,
+                           m, thr_low, thr_high):
+    """The whole waterfall tail in ONE kernel: backward C2C + de-window
+    + spectral-kurtosis decision + zap + detection time-series
+    accumulation, all while the rows are VMEM-resident.
+
+    The key structural fact making this legal: each waterfall row is
+    transformed *entirely within one grid step* (the row fits VMEM), so
+    its SK moments — which the two-kernel chain
+    (fft_rows_stats_ri + pallas_kernels.sk_apply_timeseries) must round
+    -trip through HBM to globalize — are complete before the row is
+    ever written.  The zap verdict (thresholds precomputed by
+    rfi.sk_decision_thresholds, ref: spectrum/rfi_mitigation.hpp:
+    290-341) applies in-register, the zapped row is written once, and
+    the row's contribution to the frequency-summed power time series
+    (ref: signal_detect_pipe.hpp:305-316) accumulates into a single
+    [la, lb] block revisited across grid steps — the detect stage never
+    reads the waterfall back from HBM at all.
+
+    Outputs beyond the zapped rows: ``zap_ref``/``fs_ref`` are
+    [rows, 128] lane-broadcast per-row flags (zap verdict; first-time-
+    sample power) for the zero-channel count, ``ts_ref`` the [la, lb]
+    natural-flat time series (flatten outside the call)."""
+    from jax.experimental import pallas as pl
+
+    yr, yi = vmem_fft_rows(
+        re_ref[:], im_ref[:], war_ref[:], wai_ref[:], wbr_ref[:],
+        wbi_ref[:], twr_ref[:], twi_ref[:], la=la, lb=lb, rows=rows)
+    if apply_dewindow:
+        dw = dwr_ref[:].reshape(1, la, lb)  # reciprocal de-window coeffs
+        yr = yr * dw
+        yi = yi * dw
+    p = yr * yr + yi * yi                       # [rows, la, lb]
+    # complete per-row SK moments (the row is fully resident): reduce
+    # lanes last so every intermediate keeps a 128-wide minor dim
+    s2 = jnp.sum(jnp.sum(p, axis=2), axis=1, keepdims=True)   # [rows, 1]
+    s4 = jnp.sum(jnp.sum(p * p, axis=2), axis=1, keepdims=True)
+    sk = jnp.float32(m) * s4 / (s2 * s2)
+    zap = (sk > thr_high) | (sk < thr_low)      # [rows, 1]
+    # select, not multiply: a zapped row carrying Inf/NaN must become
+    # exactly zero (same contract as rfi.mitigate_rfi_spectral_kurtosis)
+    zap3 = zap[:, :, None]
+    out_re_ref[:] = jnp.where(zap3, 0.0, yr)
+    out_im_ref[:] = jnp.where(zap3, 0.0, yi)
+    zap_ref[:] = jnp.broadcast_to(
+        jnp.where(zap, 1.0, 0.0), zap_ref.shape)
+    # natural-flat bin t=0 is [r, ka=0, kb=0]: first-sample power,
+    # pre-zap (zapped rows count through the zap flag, matching the
+    # jnp chain's `zap | (first == 0)` zero-channel accounting)
+    fs_ref[:] = jnp.broadcast_to(p[:, 0:1, 0], fs_ref.shape)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        ts_ref[:] = jnp.zeros_like(ts_ref)
+
+    ts_ref[:] += jnp.sum(jnp.where(zap3, 0.0, p), axis=0)
+
+
+def fft_rows_skzap_ri(re: jnp.ndarray, im: jnp.ndarray,
+                      sk_threshold: float,
+                      inverse: bool = True,
+                      dewindow: jnp.ndarray | None = None,
+                      interpret: bool = False):
+    """Fully-fused waterfall tail over split re/im rows ``[..., F, L]``
+    (leading dims flattened to batch; callers run one data stream per
+    call so the time series stays per-stream): one HBM read of the
+    dedispersed spectrum rows, one write of the zapped waterfall, and
+    the SK verdict + zero-channel flags + detection time series come
+    out with the write — ``hbm_passes`` 2 where the jnp chain models 3
+    and really does ~5.
+
+    Returns ``(re, im, zapf, fs0, ts)``: zapped waterfall planes
+    [..., F, L]; ``zapf``/``fs0`` [..., F, 128] lane-broadcast per-row
+    zap flag and first-sample power (finish the zero-channel count with
+    ``(zapf[..., 0] != 0) | (fs0[..., 0] == 0)``); ``ts`` [L] the
+    not-yet-mean-subtracted power time series over kept rows.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from srtb_tpu.ops.rfi import sk_decision_thresholds
+
+    lc = _Launch(re, im, inverse)
+    thr_low, thr_high = sk_decision_thresholds(lc.length, sk_threshold)
+    apply_dewindow = dewindow is not None
+    if apply_dewindow:
+        dwr = (1.0 / dewindow.astype(jnp.float32)).reshape(lc.la, lc.lb)
+    else:  # placeholder tile, never read by the kernel
+        dwr = jnp.ones((lc.la, lc.lb), jnp.float32)
+
+    stat_block = pl.BlockSpec((lc.rows, 128), lambda i: (i, 0),
+                              memory_space=pltpu.VMEM)
+    ts_block = pl.BlockSpec((lc.la, lc.lb), lambda i: (0, 0),
+                            memory_space=pltpu.VMEM)
+    kernel = functools.partial(
+        _fft_rows_skzap_kernel, la=lc.la, lb=lc.lb, rows=lc.rows,
+        apply_dewindow=apply_dewindow, m=lc.length,
+        thr_low=float(thr_low), thr_high=float(thr_high))
+    out_re, out_im, zapf, fs0, ts = pl.pallas_call(
+        kernel,
+        grid=lc.grid,
+        in_specs=[lc.block, lc.block] + lc.const_specs
+                 + [lc.const_spec((lc.la, lc.lb))],
+        out_specs=[lc.out_block, lc.out_block, stat_block, stat_block,
+                   ts_block],
+        out_shape=[lc.out_shape(), lc.out_shape(),
+                   jax.ShapeDtypeStruct((lc.pbatch, 128), jnp.float32),
+                   jax.ShapeDtypeStruct((lc.pbatch, 128), jnp.float32),
+                   jax.ShapeDtypeStruct((lc.la, lc.lb), jnp.float32)],
+        interpret=interpret,
+        **_call_kwargs(interpret),
+    )(lc.re2, lc.im2, *lc.consts, dwr)
+    return (lc.unpad(out_re).reshape(lc.shape),
+            lc.unpad(out_im).reshape(lc.shape),
+            lc.unpad(zapf).reshape(*lc.shape[:-1], 128),
+            lc.unpad(fs0).reshape(*lc.shape[:-1], 128),
+            ts.reshape(lc.length))
+
+
 def _vmem_mb() -> int | None:
     """Single parse + validation of SRTB_PALLAS_VMEM_MB (None = the
     proven default plan).  Both readers — the block sizing and the

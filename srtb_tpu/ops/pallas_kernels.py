@@ -267,12 +267,13 @@ def _chirp_phase_block_anchored(rows, i0, consts):
     return jnp.float32(-2.0 * np.pi) * frac
 
 
-def _chirp_consts(n, f_min, df, f_c, dm, i0):
-    """Builder-side consts for the anchored in-kernel chirp; the
+def _chirp_consts(n, f_min, df, f_c, dm, i0, exact: bool = False):
+    """Builder-side consts for the anchored in-kernel chirp; ``exact``
+    (the Config.chirp_exact escape hatch) or the
     SRTB_PALLAS_CHIRP_EXACT=1 env knob forces the exact per-element
     path (hardware A/B of the round-3 anchored rewrite)."""
     import os
-    if os.environ.get("SRTB_PALLAS_CHIRP_EXACT", "") == "1":
+    if exact or os.environ.get("SRTB_PALLAS_CHIRP_EXACT", "") == "1":
         return None
     return dd.anchored_chirp_consts(n, f_min, df, f_c, dm, i0=int(i0),
                                     block=_LANES, allow_shrink=False)
@@ -341,7 +342,7 @@ def rfi_s1_dedisperse_df64(spec_ri: jnp.ndarray, threshold: float,
                            f_c: float, dm: float,
                            mask: jnp.ndarray | None = None,
                            interpret: bool = False,
-                           i0: int = 0) -> jnp.ndarray:
+                           i0: int = 0, exact: bool = False) -> jnp.ndarray:
     """spec_ri [2, n] -> RFI-s1-zapped, normalized, manually-masked and
     dedispersed [2, n] in ONE kernel pass (the mean-power reduce runs as
     a jnp pass first; everything elementwise is fused here).
@@ -377,7 +378,7 @@ def rfi_s1_dedisperse_df64(spec_ri: jnp.ndarray, threshold: float,
                                f_c=f_c, dm=dm, rows=rows, i0=int(i0),
                                norm=float(norm), has_mask=has_mask,
                                consts=_chirp_consts(
-                                   n, f_min, df, f_c, dm, i0))
+                                   n, f_min, df, f_c, dm, i0, exact))
     with _ob_mode(interpret):
         out_re, out_im = pl.pallas_call(
             kernel,
@@ -395,7 +396,8 @@ def rfi_s1_dedisperse_df64(spec_ri: jnp.ndarray, threshold: float,
 
 def dedisperse_df64(spec_ri: jnp.ndarray, f_min: float, df: float,
                     f_c: float, dm: float,
-                    interpret: bool = False, i0: int = 0) -> jnp.ndarray:
+                    interpret: bool = False, i0: int = 0,
+                    exact: bool = False) -> jnp.ndarray:
     """spec_ri [2, n] -> dedispersed [2, n], chirp generated in-kernel;
     ``i0`` is the global index of the first channel (sequence shards).
 
@@ -412,7 +414,7 @@ def dedisperse_df64(spec_ri: jnp.ndarray, f_min: float, df: float,
     kernel = functools.partial(_dedisperse_kernel, f_min=f_min, df=df,
                                f_c=f_c, dm=dm, rows=rows, i0=int(i0),
                                consts=_chirp_consts(
-                                   n, f_min, df, f_c, dm, i0))
+                                   n, f_min, df, f_c, dm, i0, exact))
     block = pl.BlockSpec((rows, _LANES), lambda i: (i, 0),
                          memory_space=pltpu.VMEM)
     with _ob_mode(interpret):

@@ -40,9 +40,48 @@ def mitigate_rfi_average_and_normalize(
     """
     power = _norm(spectrum)
     mean_power = jnp.mean(power, axis=-1, keepdims=True)
+    return mitigate_rfi_s1_given_mean(spectrum, mean_power, threshold,
+                                      normalization_coefficient)
+
+
+def mitigate_rfi_s1_given_mean(spectrum: jnp.ndarray, mean_power,
+                               threshold: float,
+                               normalization_coefficient) -> jnp.ndarray:
+    """The elementwise half of RFI stage 1, with the mean power supplied
+    by the caller — the form the fused spectrum tail folds into the
+    forward FFT's final pass (the mean then comes from
+    :func:`mean_power_packed` over the packed C2C output instead of a
+    separate sweep over the materialized spectrum)."""
+    power = _norm(spectrum)
     zap = power > threshold * mean_power
     return jnp.where(zap, jnp.zeros((), dtype=spectrum.dtype),
                      spectrum * normalization_coefficient)
+
+
+def mean_power_packed(zf: jnp.ndarray) -> jnp.ndarray:
+    """Mean ``|X_k|^2`` over the m dropped-Nyquist rfft bins, computed
+    from the packed half-size C2C output ``zf [..., m]`` WITHOUT forming
+    the spectrum (keepdims ``[..., 1]``).
+
+    Parseval: with z[t'] = x[2t'] + i·x[2t'+1] and F = FFT_m(z)
+    (unnormalized), sum_t x^2 = (1/m)·sum_k |F_k|^2, and the real-input
+    Hermitian symmetry of the full 2m-point transform gives
+
+        sum_{k=0}^{m-1} |X_k|^2 = sum_k |F_k|^2 + 2·Re(F_0)·Im(F_0)
+
+    (X_0 = Re F_0 + Im F_0, X_m = Re F_0 - Im F_0, so X_0^2 - X_m^2 =
+    4·Re F_0·Im F_0).  This lets the RFI stage-1 threshold be evaluated
+    inside the same pass that writes the spectrum: the mean is a
+    reduction over the FFT's already-materialized input, not a re-read
+    of its output.  Agrees with the direct ``jnp.mean(|spec|^2)`` to
+    float32 rounding (pinned in tests/test_fusion.py); decision flips
+    are only possible for bins within ~1 ulp of threshold·mean.
+    """
+    m = zf.shape[-1]
+    p = _norm(zf)
+    total = jnp.sum(p, axis=-1, keepdims=True)
+    f0 = zf[..., :1]
+    return (total + 2.0 * jnp.real(f0) * jnp.imag(f0)) / m
 
 
 def normalization_coefficient(n_channels: int,

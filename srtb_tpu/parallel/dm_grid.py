@@ -37,19 +37,31 @@ class DMTrialResult(NamedTuple):
 
 def build_chirp_bank(dm_list, n_spectrum: int, f_min: float, df: float,
                      f_c: float, mesh: Mesh | None = None,
-                     on_device: bool = False) -> jnp.ndarray:
+                     on_device: bool = False,
+                     exact: bool = False) -> jnp.ndarray:
     """[n_dm, 2, n_spectrum] (re, im) float32 chirp bank, optionally sharded
     over the mesh's ``dm`` axis.  ``on_device=True`` computes each chirp
     with df64 two-float arithmetic directly on the owning chip (no
-    host->device transfer of the bank, SURVEY.md §7 step 6)."""
+    host->device transfer of the bank, SURVEY.md §7 step 6).
+
+    The on-device path defaults to the anchored-Taylor evaluation: k is
+    linear in dm, so dm-independent anchor coefficients (validated once
+    at the grid's max |dm|) are scaled by each trial's dm on device —
+    one df64 multiply per anchor instead of ~3 df64 divisions per
+    channel per trial.  ``exact=True`` (the Config.chirp_exact escape
+    hatch) restores the per-element division chains."""
     dm_list = np.asarray(dm_list, dtype=np.float64)
     if on_device and mesh is not None:
         from srtb_tpu.ops import df64 as ds
         dm_hi, dm_lo = ds.from_float64(dm_list)  # keep full f64 precision
+        dm_absmax = float(np.max(np.abs(dm_list))) if dm_list.size else 0.0
+        consts = None if exact else dd.anchored_chirp_consts(
+            n_spectrum, f_min, df, f_c, dm_absmax or 1.0, unit_dm=True)
 
         def gen(hi_block, lo_block):
             return jax.vmap(lambda h, l: dd.chirp_factor_df64_ri(
-                n_spectrum, f_min, df, f_c, h, dm_lo=l))(hi_block, lo_block)
+                n_spectrum, f_min, df, f_c, h, dm_lo=l,
+                anchor_consts=consts))(hi_block, lo_block)
         fn = jax.jit(shard_map(gen, mesh=mesh, in_specs=(P("dm"), P("dm")),
                                out_specs=P("dm")))
         return fn(jnp.asarray(dm_hi), jnp.asarray(dm_lo))

@@ -115,11 +115,15 @@ class DistSegmentProcessor:
             # dm-linear anchored-Taylor coefficients (validated at the
             # grid's max |dm|): turns the per-trial in-step chirp from
             # ~3 df64 divisions/channel into one anchored update —
-            # None (exact path) when the bound can't be proven
+            # None (exact path) when the bound can't be proven or the
+            # Config.chirp_exact escape hatch is set
             dm_absmax = max((abs(float(d)) for d in self.dm_list),
                             default=0.0) or 1.0
-            self.chirp_anchor_consts = dd.anchored_chirp_consts(
-                self.n_spectrum, f_min, df, f_c, dm_absmax, unit_dm=True)
+            self.chirp_anchor_consts = None \
+                if getattr(cfg, "chirp_exact", False) \
+                else dd.anchored_chirp_consts(
+                    self.n_spectrum, f_min, df, f_c, dm_absmax,
+                    unit_dm=True)
         else:
             self.chirp_bank = _put_sharded(
                 np.asarray(dm_grid.build_chirp_bank(

@@ -61,6 +61,19 @@ def unpack_streams(raw: jnp.ndarray, variant: str, nbits: int,
 # temporaries before the next starts and never materializes a chirp bank.
 STAGED_MIN_N = 1 << 30
 
+# Largest n_spectrum at which fused_tail="auto" turns fusion on for the
+# BANKLESS plans (staged / use_pallas), whose epilogue generates the
+# df64 chirp in-trace.  The anchored-Taylor evaluation is per-anchor
+# cheap, but its per-element update still runs through ops/df64's
+# EFT optimization_barriers, which block XLA fusion — a handful of
+# spectrum-sized f32 intermediates materialize (~2 GB each at
+# n_spectrum = 2^29).  Harmless through 2^27 (n = 2^28), an unproven
+# peak-HBM risk at the 2^30 staged scale until a real-chip run retires
+# it (tools_tpu_r6_queue.sh staged_fused_on_30 forces it with
+# fused_tail="on", which overrides this gate).  Bank plans are exempt:
+# their chirp rides the precombined (c, cw) banks, no in-trace df64.
+FUSED_TAIL_DF64_MAX_SPECTRUM = 1 << 27
+
 
 class SegmentProcessor:
     """Builds and owns the jitted per-segment device function plus its
@@ -117,12 +130,17 @@ class SegmentProcessor:
         f_min, f_c, df = dd.spectrum_frequencies(cfg, self.n_spectrum)
         self.f_min, self.f_c, self.df = f_min, f_c, df
         self.staged = (self.n >= STAGED_MIN_N) if staged is None else staged
+        # fused spectrum tail (Config.fused_tail): RFI s1 + chirp fold
+        # into the forward FFT's final pass; resolved once so the plan,
+        # its signature, and the hbm_passes model can never disagree
+        self.fused_tail = self._resolve_fused_tail()
         # the chirp crosses the host->device boundary as stacked (re, im)
         # float32 [2, n]: some TPU runtimes can't transfer complex buffers,
         # and split re/im is the natural VPU layout anyway; complex exists
         # only inside jit.  The staged plan never materializes a bank —
         # at n = 2^30 it would occupy 4 GB of HBM for the segment's whole
         # lifetime — and instead computes the df64 chirp inside stage (c).
+        self.chirp_w = None  # chirp·twiddle precombined bank (fused tail)
         if self.staged or cfg.use_pallas:
             # staged and Pallas plans compute the chirp in-step; a
             # precomputed bank would sit dead in HBM (2 GB at n = 2^29)
@@ -132,11 +150,19 @@ class SegmentProcessor:
                 compute_chirp_on_device = cfg.use_emulated_fp64
             if compute_chirp_on_device:
                 self.chirp = jax.jit(
-                    lambda: dd.chirp_factor_df64_ri(self.n_spectrum, f_min,
-                                                    df, f_c, cfg.dm))()
+                    lambda: dd.chirp_factor_df64_ri(
+                        self.n_spectrum, f_min, df, f_c, cfg.dm,
+                        exact=getattr(cfg, "chirp_exact", False)))()
             else:
                 self.chirp = jnp.asarray(dd.chirp_factor_host_ri(
                     self.n_spectrum, f_min, df, f_c, cfg.dm))
+            if self.fused_tail:
+                # chirp·twiddle precombination: cw = chirp · w folds the
+                # Hermitian twiddle into the bank once, so the fused
+                # final pass costs one complex mul per bin and zero
+                # in-trace trig (explicit arg, not a closure capture —
+                # a captured 2 GB bank would bake into the program)
+                self.chirp_w = jax.jit(self._premul_bank)(self.chirp)
 
         mask = rfi.rfi_ranges_to_mask(
             rfi.eval_rfi_ranges(cfg.mitigate_rfi_freq_list), self.n_spectrum,
@@ -153,6 +179,30 @@ class SegmentProcessor:
         # Pallas kernels need interpret mode off-TPU (CPU CI)
         from srtb_tpu.utils.platform import on_accelerator
         self._pallas_interpret = not on_accelerator()
+        # fully-fused waterfall tail (pf.fft_rows_skzap_ri): C2C +
+        # de-window + SK decision + zap + time series in ONE kernel —
+        # requires the fused tail, both Pallas knobs, and rows that fit
+        # the VMEM row-FFT window
+        from srtb_tpu.ops import pallas_fft as _pf
+        self._skzap = bool(
+            self.fused_tail and cfg.use_pallas and cfg.use_pallas_sk
+            and _pf.supported(self.watfft_len, self.channel_count))
+        # modeled spectrum-sized HBM sweeps of this plan — the quantity
+        # bench.py's roofline model multiplies by (PERF.md "Roofline").
+        # A FLOOR in units of one spectrum-sized transfer (read or
+        # write), per stage group:
+        #   R2C read+write (2)
+        # + RFI s1 + chirp read+write (2, folded away by the fused tail)
+        # + waterfall FFT read+write (2)
+        # + SK + detect re-read floor (1, folded away by the skzap
+        #   kernel, whose stats/zap/time-series ride the watfft write)
+        # Which kernels execute a group changes real traffic only
+        # UPWARD from this floor (e.g. the unfused pallas_sk pair's zap
+        # rewrite makes the SK group 2 where the floor says 1), so
+        # achieved_gbps stays a lower bound for every plan; only the
+        # fusions above lower the floor itself.
+        self.hbm_passes = (2 + (0 if self.fused_tail else 2) + 2
+                           + (0 if self._skzap else 1))
         # XLA FFT row-length cap override (Config.fft_len_cap; None =
         # the ops/fft default), threaded through every FFT entry point
         self._len_cap = cfg.fft_len_cap or None
@@ -191,7 +241,92 @@ class SegmentProcessor:
                     " — restarts will recompile")
         log.debug(f"[segment] n={n} spectrum={self.n_spectrum} "
                   f"channels={self.channel_count} watfft={self.watfft_len} "
-                  f"reserved={self.nsamps_reserved} staged={self.staged}")
+                  f"reserved={self.nsamps_reserved} plan={self.plan_name} "
+                  f"hbm_passes={self.hbm_passes}")
+
+    # ------------------------------------------------------------------
+    # fused spectrum tail: plan resolution + the epilogue itself
+
+    def _resolve_fused_tail(self) -> bool:
+        """Resolve Config.fused_tail ("auto"/"on"/"off") against the
+        plan: the staged plan and every non-monolithic strategy end in
+        the Hermitian post-process, which can host the RFI-s1 + chirp
+        epilogue; the monolithic XLA R2C custom call cannot and stays
+        the unfused fallback under "auto"."""
+        mode = str(getattr(self.cfg, "fused_tail", "auto")).lower()
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(
+                f"fused_tail must be auto/on/off, got {mode!r}")
+        if mode == "off":
+            return False
+        hostable = self.staged or F.resolve_strategy(
+            self.n, self.cfg.fft_strategy) != "monolithic"
+        if mode == "on":
+            if not hostable:
+                raise ValueError(
+                    "fused_tail=on requires a non-monolithic "
+                    "fft_strategy (the XLA R2C custom call cannot host "
+                    "the RFI/chirp epilogue)")
+            return True
+        if not hostable:
+            return False
+        # auto: bankless plans generate the chirp in-trace — gate on
+        # the proven size range (see FUSED_TAIL_DF64_MAX_SPECTRUM);
+        # "on" above overrides for the hardware experiments
+        bankless = self.staged or self.cfg.use_pallas
+        if bankless and self.n_spectrum > FUSED_TAIL_DF64_MAX_SPECTRUM:
+            return False
+        return True
+
+    @property
+    def plan_name(self) -> str:
+        """Human/bench-readable plan id: base plan + resolved strategy
+        + which fusions are live (bench.py emits this per JSON line)."""
+        strategy = F.resolve_strategy(self.n, self.cfg.fft_strategy)
+        name = ("staged" if self.staged else "fused") + f":{strategy}"
+        if self.fused_tail:
+            name += "+ftail"
+        if self._skzap:
+            name += "+skzap"
+        return name
+
+    @staticmethod
+    def _premul_bank(c_ri: jnp.ndarray) -> jnp.ndarray:
+        """cw = chirp · w with w the drop-Nyquist Hermitian twiddle
+        exp(-2πik/n) — the chirp·twiddle precombination consumed by
+        ops.fft.hermitian_rfft_post(premul=...)."""
+        m = c_ri.shape[-1]
+        c = jax.lax.complex(c_ri[0], c_ri[1])
+        cw = c * F._iota_phase(m, 2 * m, -1.0)
+        return jnp.stack([jnp.real(cw), jnp.imag(cw)])
+
+    def _tail_epilogue(self, chirp_ri):
+        """The elementwise epilogue folded into the forward FFT's final
+        pass: RFI stage-1 zap (mean power via the Parseval identity over
+        the FFT's own input, rfi.mean_power_packed — no spectrum
+        re-read) + normalize + manual mask, then the chirp.  With a bank
+        (``chirp_ri`` given) the chirp was already applied through the
+        precombined (c, cw) pair inside the Hermitian assembly — the
+        zap/normalize commute with the unit-modulus multiply — so only
+        the zap runs here; without one the df64 chirp (anchored-Taylor
+        unless Config.chirp_exact) is generated in-trace and fuses into
+        the same write."""
+        cfg = self.cfg
+
+        def epilogue(zf, spec):
+            mean_power = rfi.mean_power_packed(zf)
+            spec = rfi.mitigate_rfi_s1_given_mean(
+                spec, mean_power,
+                cfg.mitigate_rfi_average_method_threshold,
+                self.norm_coeff)
+            spec = rfi.mitigate_rfi_manual(spec, self.rfi_mask)
+            if chirp_ri is None:
+                c_ri = dd.chirp_factor_df64_ri(
+                    spec.shape[-1], self.f_min, self.df, self.f_c,
+                    cfg.dm, exact=getattr(cfg, "chirp_exact", False))
+                spec = spec * jax.lax.complex(c_ri[0], c_ri[1])
+            return spec
+        return epilogue
 
     # ------------------------------------------------------------------
 
@@ -223,9 +358,18 @@ class SegmentProcessor:
             return impl + "_interpret"
         return impl
 
-    def _process(self, raw: jnp.ndarray, chirp_ri: jnp.ndarray):
+    def _process(self, raw: jnp.ndarray, chirp_ri: jnp.ndarray,
+                 chirp_w_ri: jnp.ndarray = None):
         strategy = self._resolve_rows_impl(
             F.resolve_strategy(self.n, self.cfg.fft_strategy))
+        epilogue = premul = None
+        if self.fused_tail:
+            epilogue = self._tail_epilogue(chirp_ri)
+            if chirp_ri is not None:
+                # bank plan: chirp·twiddle precombination inside the
+                # Hermitian assembly (see _premul_bank)
+                premul = (jax.lax.complex(chirp_ri[0], chirp_ri[1]),
+                          jax.lax.complex(chirp_w_ri[0], chirp_w_ri[1]))
         if self._blocked_subbyte and strategy in ("four_step", "mxu",
                                                   "pallas",
                                                   "pallas_interpret",
@@ -244,11 +388,19 @@ class SegmentProcessor:
             spec = F.rfft_subbyte(raw, self.cfg.baseband_input_bits,
                                   strategy, self.window_planes,
                                   planes=planes,
-                                  len_cap=self._len_cap)[None, :]
+                                  len_cap=self._len_cap,
+                                  epilogue=epilogue,
+                                  premul=premul)[None, :]
         else:
             x = self._unpack(raw)
             spec = F.segment_rfft(x, strategy,
-                                  len_cap=self._len_cap)   # [S, n/2]
+                                  len_cap=self._len_cap,
+                                  epilogue=epilogue,
+                                  premul=premul)   # [S, n/2]
+        if self.fused_tail:
+            # the spectrum left the FFT already zapped/normalized/
+            # masked/chirped — straight to the waterfall tail
+            return self._waterfall_detect(spec)
         return self._spectrum_tail(spec, chirp_ri)
 
     # ---- staged plan: three programs with (re, im) f32 boundaries ----
@@ -320,7 +472,10 @@ class SegmentProcessor:
         return jnp.stack([jnp.real(a), jnp.imag(a)])
 
     def _stage_b(self, a_ri: jnp.ndarray):
-        """segment-FFT second half + Hermitian post -> spectrum [S, n/2]."""
+        """segment-FFT second half + Hermitian post -> spectrum [S, n/2].
+        With the fused tail the RFI-s1 + df64-chirp epilogue folds into
+        the Hermitian post's single write here, so stage (c) starts from
+        an already-dedispersed spectrum."""
         impl = self._staged_impl()
         if impl in ("pallas2", "pallas2_interpret"):
             from srtb_tpu.ops import pallas_fft2 as pf2
@@ -331,27 +486,39 @@ class SegmentProcessor:
             zf = F.four_step_stage2(jax.lax.complex(a_ri[0], a_ri[1]),
                                     rows_impl=impl,
                                     len_cap=self._len_cap)
+        epilogue = self._tail_epilogue(None) if self.fused_tail else None
         if self._staged_blocked:
-            spec = F.finish_rfft_subbyte(zf[0])[None, :]
+            spec = F.finish_rfft_subbyte(zf[0], epilogue=epilogue)[None, :]
         else:
-            spec = F.hermitian_rfft_post(zf, drop_nyquist=True)
+            spec = F.hermitian_rfft_post(zf, drop_nyquist=True,
+                                         epilogue=epilogue)
         return jnp.stack([jnp.real(spec), jnp.imag(spec)])
 
     def _stage_c(self, spec_ri: jnp.ndarray):
-        """RFI s1 + in-step chirp + waterfall + RFI s2 + detect."""
+        """RFI s1 + in-step chirp + waterfall + RFI s2 + detect (the s1
+        + chirp front half lives in stage (b) when the tail is fused)."""
         spec = jax.lax.complex(spec_ri[0], spec_ri[1])
+        if self.fused_tail:
+            return self._waterfall_detect(spec)
         return self._spectrum_tail(spec, None)
 
     def _spectrum_tail(self, spec: jnp.ndarray, chirp_ri):
-        """Shared device chain from the raw spectrum onward.  With
-        ``chirp_ri=None`` the df64 chirp is generated inside the trace
-        (fuses into the multiply; nothing bank-sized is materialized)."""
+        """Legacy (unfused-tail) device chain from the raw spectrum
+        onward: RFI s1 + chirp as their own sweeps, then the waterfall
+        tail.  With ``chirp_ri=None`` the df64 chirp is generated inside
+        the trace (fuses into the multiply; nothing bank-sized is
+        materialized)."""
+        return self._waterfall_detect(self._apply_s1_chirp(spec, chirp_ri))
+
+    def _apply_s1_chirp(self, spec: jnp.ndarray, chirp_ri):
+        """RFI stage 1 + manual mask + chirp multiply as standalone
+        spectrum sweeps (the passes the fused tail folds into the FFT's
+        final write)."""
         cfg = self.cfg
-        use_pallas = cfg.use_pallas
         interp = getattr(self, "_pallas_interpret", False)
         from srtb_tpu.ops import pallas_kernels as pk
         n_streams = spec.shape[0]
-        if use_pallas:
+        if cfg.use_pallas:
             # Fully fused front half: RFI s1 zap + normalize + manual
             # mask + df64 in-register chirp in ONE HBM pass per stream
             # (the mean-power reduce stays a jnp pass).  Phase computed
@@ -362,33 +529,70 @@ class SegmentProcessor:
                 out_ri = pk.rfi_s1_dedisperse_df64(
                     spec_ri, cfg.mitigate_rfi_average_method_threshold,
                     self.norm_coeff, self.f_min, self.df, self.f_c,
-                    cfg.dm, mask=self.rfi_mask, interpret=interp)
+                    cfg.dm, mask=self.rfi_mask, interpret=interp,
+                    exact=getattr(cfg, "chirp_exact", False))
                 outs.append(jax.lax.complex(out_ri[0], out_ri[1]))
-            spec = jnp.stack(outs)
-        else:
-            spec = rfi.mitigate_rfi_average_and_normalize(
-                spec, cfg.mitigate_rfi_average_method_threshold,
-                self.norm_coeff)
-            spec = rfi.mitigate_rfi_manual(spec, self.rfi_mask)
-            if chirp_ri is None:
-                # In-step df64 chirp without Pallas (staged plan on the
-                # jnp path).  The XLA df64 chirp's optimization_barriers
-                # block fusion, so its ~12 error-free-transform
-                # intermediates each materialize a plane (24 GB peak at
-                # 2^30) — the Pallas kernel is the form that scales;
-                # this branch serves CPU tests and small segments.
-                outs = []
-                for s in range(n_streams):
-                    spec_ri = jnp.stack([jnp.real(spec[s]),
-                                         jnp.imag(spec[s])])
-                    out_ri = pk.dedisperse_df64(spec_ri, self.f_min,
-                                                self.df, self.f_c,
-                                                cfg.dm, interpret=interp)
-                    outs.append(jax.lax.complex(out_ri[0], out_ri[1]))
-                spec = jnp.stack(outs)
-            else:
-                chirp = jax.lax.complex(chirp_ri[0], chirp_ri[1])
-                spec = dd.dedisperse(spec, chirp)
+            return jnp.stack(outs)
+        spec = rfi.mitigate_rfi_average_and_normalize(
+            spec, cfg.mitigate_rfi_average_method_threshold,
+            self.norm_coeff)
+        spec = rfi.mitigate_rfi_manual(spec, self.rfi_mask)
+        if chirp_ri is None:
+            # In-step df64 chirp without Pallas (staged plan on the
+            # jnp path).  The XLA df64 chirp's optimization_barriers
+            # block fusion, so its ~12 error-free-transform
+            # intermediates each materialize a plane (24 GB peak at
+            # 2^30) — the Pallas kernel is the form that scales;
+            # this branch serves CPU tests and small segments.
+            outs = []
+            for s in range(n_streams):
+                spec_ri = jnp.stack([jnp.real(spec[s]),
+                                     jnp.imag(spec[s])])
+                out_ri = pk.dedisperse_df64(
+                    spec_ri, self.f_min, self.df, self.f_c,
+                    cfg.dm, interpret=interp,
+                    exact=getattr(cfg, "chirp_exact", False))
+                outs.append(jax.lax.complex(out_ri[0], out_ri[1]))
+            return jnp.stack(outs)
+        chirp = jax.lax.complex(chirp_ri[0], chirp_ri[1])
+        return dd.dedisperse(spec, chirp)
+
+    def _waterfall_detect(self, spec: jnp.ndarray):
+        """Waterfall backward C2C + RFI stage 2 + detection from an
+        already-dedispersed spectrum.  With the fully-fused skzap plan
+        (fused tail + use_pallas + use_pallas_sk + VMEM-resident rows)
+        the whole tail is ONE kernel per stream — the detect stage never
+        re-reads the waterfall from HBM."""
+        cfg = self.cfg
+        use_pallas = cfg.use_pallas
+        interp = getattr(self, "_pallas_interpret", False)
+        from srtb_tpu.ops import pallas_kernels as pk
+        n_streams = spec.shape[0]
+        if self._skzap:
+            from srtb_tpu.ops import pallas_fft as pf
+            t_len = self.watfft_len
+            x = spec[..., :self.channel_count * t_len].reshape(
+                n_streams, self.channel_count, t_len)
+            zapped, zero_counts, ts_rows = [], [], []
+            for s in range(n_streams):
+                wr, wi, zapf, fs0, ts = pf.fft_rows_skzap_ri(
+                    jnp.real(x[s]), jnp.imag(x[s]),
+                    cfg.mitigate_rfi_spectral_kurtosis_threshold,
+                    inverse=True, dewindow=self.watfft_dewindow,
+                    interpret=interp)
+                zapped.append(jax.lax.complex(wr, wi))
+                zero_counts.append(jnp.sum(
+                    ((zapf[:, 0] != 0) | (fs0[:, 0] == 0))
+                    .astype(jnp.int32)))
+                ts_rows.append(ts)
+            wf = jnp.stack(zapped)
+            t = det.trimmed_length(wf.shape[-1], self.time_reserved_count)
+            result = det.detect_from_time_series(
+                jnp.stack(ts_rows)[:, :t], jnp.stack(zero_counts),
+                cfg.signal_detect_signal_noise_threshold,
+                cfg.signal_detect_max_boxcar_length)
+            wf_ri = jnp.stack([jnp.real(wf), jnp.imag(wf)])
+            return wf_ri, result
         from srtb_tpu.ops import pallas_fft as pf
         pallas_wf = use_pallas and pf.supported(
             self.watfft_len, spec.shape[0] * self.channel_count)
@@ -487,7 +691,7 @@ class SegmentProcessor:
         "mitigate_rfi_spectral_kurtosis_threshold",
         "mitigate_rfi_freq_list", "baseband_reserve_sample",
         "fft_strategy", "fft_len_cap", "use_pallas", "use_pallas_sk",
-        "use_emulated_fp64",
+        "use_emulated_fp64", "fused_tail", "chirp_exact",
         # overlap-engine trace shapers: micro_batch_segments changes the
         # traced program (vmapped batch plan) outright;
         # inflight_segments shapes the runtime's donation/aliasing
@@ -520,7 +724,14 @@ class SegmentProcessor:
              "interp": self._pallas_interpret,
              "window": self._window_name,
              "has_chirp": self.chirp is not None,
-             "donate_input": self._donate_input},
+             "donate_input": self._donate_input,
+             # resolved fusion state, not just the "auto" request: a
+             # restarted process whose plan resolves differently (e.g.
+             # strategy flips monolithic <-> four_step across the
+             # threshold) must miss the AOT cache cleanly
+             "fused_tail": self.fused_tail,
+             "skzap": self._skzap,
+             "hbm_passes": self.hbm_passes},
             sort_keys=True, default=str)
 
     def enable_aot(self, path: str, allow_cpu: bool = False) -> bool:
@@ -538,7 +749,8 @@ class SegmentProcessor:
         raw_s = jax.ShapeDtypeStruct((expected,), jnp.uint8)
         if not self.staged:
             self._jit_process = cache.get_or_compile(
-                "fused", sig, self._jit_process, raw_s, self.chirp)
+                "fused", sig, self._jit_process, raw_s, self.chirp,
+                self.chirp_w)
         else:
             # chain the boundary avals by abstract evaluation (free:
             # trace only, no compile)
@@ -596,9 +808,9 @@ class SegmentProcessor:
         if self._jit_process_batch is None:
             in_donate = (0,) if self._donate_input else ()
             self._jit_process_batch = jax.jit(
-                jax.vmap(self._process, in_axes=(0, None)),
+                jax.vmap(self._process, in_axes=(0, None, None)),
                 donate_argnums=in_donate)
-        out = self._jit_process_batch(raw, self.chirp)
+        out = self._jit_process_batch(raw, self.chirp, self.chirp_w)
         if self._sanitize and self._donate_input:
             from srtb_tpu.analysis import sanitizer as S
             # the sanitizer is the sanctioned holder of the donated
@@ -632,7 +844,7 @@ class SegmentProcessor:
         no-op and the bug would otherwise only corrupt on the TPU).
         This serializes dispatch — sanitize is a debugging mode."""
         if not self.staged:
-            out = self._jit_process(raw, self.chirp)
+            out = self._jit_process(raw, self.chirp, self.chirp_w)
             if self._sanitize and self._donate_input:
                 from srtb_tpu.analysis import sanitizer as S
                 # sanctioned holder: expiry deletes the donated

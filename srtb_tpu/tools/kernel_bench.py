@@ -231,6 +231,39 @@ def main(argv=None) -> int:
         dt = _time(seq, spec_c, chirp, reps=reps)
         record("RFI s1 + chirp (jnp + bank)", dt, f"[{n_spec}]c64", n_spec)
 
+    # ---- fused spectrum-tail epilogue: Hermitian post + RFI s1 + chirp
+    # in ONE write (the spectrum-pass-fusion tentpole) vs the unfused
+    # hermitian -> s1 -> chirp sweep sequence.  spec_c stands in for the
+    # packed C2C output zf (same size/statistics); runs on any backend —
+    # the fusion is XLA-level, not Pallas.
+    from srtb_tpu.ops import fft as F
+
+    unfused_tail = jax.jit(lambda zf, c: dd.dedisperse(
+        rfi.mitigate_rfi_average_and_normalize(
+            F.hermitian_rfft_post(zf, drop_nyquist=True)[None], 1.5,
+            0.125),
+        jax.lax.complex(c[0], c[1]))[0])
+    dt = _time(unfused_tail, spec_c, chirp, reps=reps)
+    record("R2C tail: hermitian + RFI s1 + chirp (unfused sweeps)", dt,
+           f"[{n_spec}]c64", n_spec)
+
+    cw = jax.jit(lambda c: jnp.stack([
+        jnp.real(jax.lax.complex(c[0], c[1])
+                 * F._iota_phase(n_spec, 2 * n_spec, -1.0)),
+        jnp.imag(jax.lax.complex(c[0], c[1])
+                 * F._iota_phase(n_spec, 2 * n_spec, -1.0))]))(chirp)
+
+    def fused_tail(zf, c, cwb):
+        epi = lambda z, s: rfi.mitigate_rfi_s1_given_mean(  # noqa: E731
+            s, rfi.mean_power_packed(z), 1.5, 0.125)
+        return F.hermitian_rfft_post(
+            zf, drop_nyquist=True, epilogue=epi,
+            premul=(jax.lax.complex(c[0], c[1]),
+                    jax.lax.complex(cwb[0], cwb[1])))
+    dt = _time(jax.jit(fused_tail), spec_c, chirp, cw, reps=reps)
+    record("R2C tail: fused epilogue + chirp-twiddle premul (1 write)",
+           dt, f"[{n_spec}]c64", n_spec)
+
     # ---- spectral kurtosis on the waterfall ----
     wf_re = jax.device_put(
         rng.standard_normal((nchan, wlen)).astype(np.float32))
@@ -270,6 +303,34 @@ def main(argv=None) -> int:
                        f"[{nchan},{wlen}]c64", n_spec)
             except Exception as e:  # pragma: no cover
                 print(json.dumps({"kernel": "pallas sk", "error": str(e)}))
+
+    # ---- fully-fused waterfall tail: C2C + dewindow + SK decide + zap
+    # + time series in ONE kernel (pf.fft_rows_skzap_ri) vs the 2-kernel
+    # chain (fft_rows_stats_ri + sk_apply_timeseries) it supersedes —
+    # the "fused SK+ts read" attribution row for the ≤4-pass plans
+    if jax.default_backend() not in ("cpu",) and pf.supported(wlen, nchan):
+        from srtb_tpu.ops import pallas_kernels as pk
+        skzap = jax.jit(lambda r, i: pf.fft_rows_skzap_ri(
+            r, i, 1.05, inverse=True))
+        try:
+            dt = _time(skzap, wf_re, wf_im, reps=reps)
+            record("waterfall C2C + SK zap + ts (Pallas skzap, 1 kernel)",
+                   dt, f"[{nchan},{wlen}]c64", n_spec)
+        except Exception as e:  # pragma: no cover
+            print(json.dumps({"kernel": "pallas skzap", "error": str(e)}))
+
+        def two_kernel(r, i):
+            yr, yi, s2p, s4p = pf.fft_rows_stats_ri(r, i, inverse=True)
+            zap = pk.sk_zap_decision(s2p.sum(-1), s4p.sum(-1),
+                                     r.shape[-1], 1.05)
+            return pk.sk_apply_timeseries(jnp.stack([yr, yi]), zap)
+        try:
+            dt = _time(jax.jit(two_kernel), wf_re, wf_im, reps=reps)
+            record("waterfall C2C + SK zap + ts (stats + apply, "
+                   "2 kernels)", dt, f"[{nchan},{wlen}]c64", n_spec)
+        except Exception as e:  # pragma: no cover
+            print(json.dumps({"kernel": "pallas stats+apply",
+                              "error": str(e)}))
 
     # ---- detection chain (time series + boxcar ladder) ----
     detect = jax.jit(lambda w: det.detect(w[None], 0, 8.0, 256))

@@ -1,0 +1,88 @@
+#!/bin/bash
+# Round-6 TPU hardware backlog: spectrum-pass fusion A/Bs on top of the
+# still-undrained r5 backlog.  The tunnel has been down since ~17:10Z
+# Jul 30 (rounds 3-6); this queue first drains the r5 blocks (pallas2
+# acceptance, anchored chirp, overlap, AOT cold/warm), then measures
+# the round-6 fused plans the moment hardware returns.  Safe to re-run;
+# each block is independent.  Run from the repo root with the TPU
+# visible (tools_tpu_watcher.sh fires it automatically).
+#
+#   bash tools_tpu_r6_queue.sh [quick]
+#
+# "quick" drains only the new fused-plan rows (skips the r5 backlog and
+# the long 2^30 blocks).
+set -u
+OUT=${SRTB_PERF_OUT:-PERF_TPU.jsonl}
+stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
+note() { echo "{\"ts\": \"$(stamp)\", \"variant\": \"note\", \"note\": \"$1\"}" >> "$OUT"; }
+run() {
+  local tag="$1"; shift
+  echo "== $tag =="
+  local line
+  line=$("$@" 2>/dev/null | grep '^{' | tail -1)
+  if [ -n "$line" ]; then
+    echo "{\"ts\": \"$(stamp)\", \"variant\": \"$tag\", \"result\": $line}" >> "$OUT"
+    echo "$line"
+  else
+    echo "{\"ts\": \"$(stamp)\", \"variant\": \"$tag\", \"error\": true}" >> "$OUT"
+  fi
+}
+
+QUICK=${1:-}
+
+# ---- 0. the r5 backlog first (never drained: tunnel down r3-r5) ----
+if [ "$QUICK" != "quick" ] && [ -f tools_tpu_r5_queue.sh ]; then
+  note "r6 queue: draining r5 backlog first"
+  bash tools_tpu_r5_queue.sh
+fi
+
+note "r6 queue start: spectrum-pass fusion A/Bs (fused_tail on/off, skzap, chirp premul)"
+
+# ---- 1. fused-tail A/B at 2^27 (four_step hosts the epilogue; the
+#          monolithic default is the unfused reference plan).  Three
+#          legs: legacy 7-pass, fused 5-pass (epilogue + chirp·twiddle
+#          premul), fully-fused 4-pass (+ skzap waterfall kernel).
+#          Every line now carries plan/hbm_passes/model_hbm_gb from the
+#          per-plan count, so roofline_frac is comparable across legs.
+run fused_tail_off_27 env SRTB_BENCH_LOG2N=27 SRTB_BENCH_FFT_STRATEGY=four_step \
+    SRTB_BENCH_DEADLINE=900 python bench.py --fused-tail off
+run fused_tail_on_27  env SRTB_BENCH_LOG2N=27 SRTB_BENCH_FFT_STRATEGY=four_step \
+    SRTB_BENCH_DEADLINE=900 python bench.py --fused-tail on
+run fused_skzap_27    env SRTB_BENCH_LOG2N=27 SRTB_BENCH_FFT_STRATEGY=four_step \
+    SRTB_BENCH_USE_PALLAS=1 SRTB_BENCH_USE_PALLAS_SK=1 \
+    SRTB_BENCH_DEADLINE=900 python bench.py --fused-tail on
+# monolithic reference on the same sizes (the auto plan below 2^30)
+run fused_ref_mono_27 env SRTB_BENCH_LOG2N=27 SRTB_BENCH_DEADLINE=900 \
+    python bench.py --fused-tail off
+# fused tail on the pallas2 two-pass FFT (epilogue rides the Hermitian
+# post after pass 2 — the all-fusions flagship candidate)
+run fused_pallas2_27  env SRTB_BENCH_LOG2N=27 SRTB_BENCH_FFT_STRATEGY=pallas2 \
+    SRTB_BENCH_USE_PALLAS=1 SRTB_BENCH_USE_PALLAS_SK=1 \
+    SRTB_BENCH_DEADLINE=900 python bench.py --fused-tail on
+
+# ---- 2. per-kernel attribution for the fused epilogues (chained-loop
+#          rows: fused chirp+RFI hermitian write, fused skzap read) ----
+echo "== kernel bench (fused epilogue rows) =="
+python -m srtb_tpu.tools.kernel_bench --log2n 28 --reps 5 2>/dev/null \
+  | while read -r line; do
+      echo "{\"ts\": \"$(stamp)\", \"variant\": \"kernel_r6\", \"result\": $line}" >> "$OUT"
+      echo "$line"
+    done
+
+if [ "$QUICK" = "quick" ]; then exit 0; fi
+
+# ---- 3. 2^30 staged production segment: fused stage-b epilogue vs
+#          legacy.  The staged plan's RFI+chirp sweep was 0.67 s of
+#          16 GB traffic at the 819 GB/s roof — the fused leg should
+#          recover ~2/7 of the traffic floor.
+run staged_fused_off_30 env SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 \
+    SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=2700 python bench.py --fused-tail off
+run staged_fused_on_30  env SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 \
+    SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=2700 python bench.py --fused-tail on
+# fully-fused 2^30: staged + pallas legs + skzap waterfall (watfft_len
+# 2^14 fits the VMEM row window at 2^15 channels)
+run staged_skzap_30     env SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 \
+    SRTB_BENCH_REPS=3 SRTB_BENCH_USE_PALLAS=1 SRTB_BENCH_USE_PALLAS_SK=1 \
+    SRTB_BENCH_DEADLINE=2700 python bench.py --fused-tail on
+
+note "r6 queue done"
