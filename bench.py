@@ -325,6 +325,35 @@ def run_bench(platform_error, overlap: str = "on",
         "hbm_passes": proc.hbm_passes,
         "fused_tail": "on" if proc.fused_tail else "off",
     }
+    if int(os.environ.get("SRTB_BENCH_AUDIT", "0")):
+        # Roofline cross-check against the compile-time HLO plan
+        # auditor (srtb_tpu/analysis/hlo_audit.py): the measured plan's
+        # OWN compiled artifacts are re-lowered and their structural
+        # spectrum-sized sweeps counted, so the two HBM accountings —
+        # model_hbm_gb (the hbm_passes floor model above) and the
+        # audited artifact traffic — cite each other in one line.
+        # Opt-in (it compiles the plan a second time): ci.sh's bench
+        # smoke sets it; big-n TPU headline runs leave it off.
+        from srtb_tpu.analysis import hlo_audit as HA
+        card = HA.audit_processor(proc)
+        spectrum_bytes = 8.0 * proc.n_spectrum
+        audited_bytes = raw.nbytes \
+            + card["total_spectrum_passes"] * spectrum_bytes
+        out["audit_spectrum_passes"] = card["total_spectrum_passes"]
+        out["audit_hbm_gb"] = round(audited_bytes / 1e9, 3)
+        out["audit_checks_ok"] = not HA.failed_checks({"bench": card})
+        # the model is a FLOOR of the artifact's structural traffic: a
+        # model claiming >10% more bytes than the audited sweeps means
+        # the hbm_passes declaration went stale (e.g. a fusion landed
+        # without lowering the declared floor) and achieved_gbps /
+        # roofline_frac are being flattered
+        if bytes_moved > 1.1 * audited_bytes:
+            out["audit_warning"] = (
+                f"model_hbm_gb {out['model_hbm_gb']} exceeds audited "
+                f"artifact traffic {out['audit_hbm_gb']} by >10% — "
+                "hbm_passes floor is stale for this plan")
+            print(f"bench: WARNING: {out['audit_warning']}",
+                  file=sys.stderr)
     if cfg.aot_plan_path:
         # whether the AOT executable cache actually engaged — the
         # queue's aot_cold/aot_warm verdicts require this to be true

@@ -24,13 +24,13 @@ if [ "${1:-}" = "--hardware" ]; then
   exit 0
 fi
 
-echo "== [1/10] native build =="
+echo "== [1/11] native build =="
 make -C srtb_tpu/native
 
-echo "== [2/10] native sanitizer harness (ASan/UBSan) =="
+echo "== [2/11] native sanitizer harness (ASan/UBSan) =="
 make -C srtb_tpu/native check
 
-echo "== [3/10] static checks (compile + import) =="
+echo "== [3/11] static checks (compile + import) =="
 python -m compileall -q srtb_tpu tests bench.py __graft_entry__.py
 python - <<'EOF'
 import importlib, pkgutil
@@ -45,12 +45,28 @@ assert not bad, bad
 print(f"all srtb_tpu modules import cleanly")
 EOF
 
-echo "== [4/10] srtb-lint (static analysis vs baseline) =="
+echo "== [4/11] srtb-lint (static analysis vs baseline) =="
 # fails on findings not in srtb_tpu/analysis/baseline.json; accept an
-# intentional finding with --write-baseline + a note, or a pragma
-JAX_PLATFORMS=cpu python -m srtb_tpu.tools.lint srtb_tpu/
+# intentional finding with --write-baseline + a note, or a pragma.
+# The machine-readable run lands next to the other CI artifacts.
+mkdir -p artifacts
+JAX_PLATFORMS=cpu python -m srtb_tpu.tools.lint srtb_tpu/ \
+  --format json > artifacts/lint.json \
+  || { cat artifacts/lint.json; exit 1; }
 
-echo "== [5/10] pytest (8-device CPU mesh) =="
+echo "== [5/11] plan audit (compile-time HLO cards vs baseline) =="
+# AOT-lowers every plan family and audits the compiled artifacts:
+# spectrum-sized HBM sweeps vs the declared hbm_passes floor, donation
+# proven aliased (not silently dropped), no f64/host-callback/
+# collective creep.  Fails on any drift from
+# srtb_tpu/analysis/plan_cards.json (accept intentional changes with
+# --write-baseline + a note); the selftest then proves the gate still
+# catches a dropped donation and an injected extra spectrum pass.
+JAX_PLATFORMS=cpu python -m srtb_tpu.tools.plan_audit \
+  --out artifacts/plan_cards_audit.json
+JAX_PLATFORMS=cpu python -m srtb_tpu.tools.plan_audit --selftest
+
+echo "== [6/11] pytest (8-device CPU mesh) =="
 FAST_ARGS=()
 if [ "${1:-}" = "--fast" ]; then
   # one source of truth for what "slow" means: the pytest marker
@@ -59,10 +75,11 @@ if [ "${1:-}" = "--fast" ]; then
 fi
 python -m pytest tests/ -q "${FAST_ARGS[@]}"
 
-echo "== [6/10] bench smoke =="
-JAX_PLATFORMS=cpu SRTB_BENCH_LOG2N=16 python bench.py | tail -1
+echo "== [7/11] bench smoke (with the roofline/audit cross-check) =="
+JAX_PLATFORMS=cpu SRTB_BENCH_LOG2N=16 SRTB_BENCH_AUDIT=1 \
+  python bench.py | tail -1
 
-echo "== [7/10] fused-plan parity (spectrum-pass fusion, Pallas interpret on CPU) =="
+echo "== [8/11] fused-plan parity (spectrum-pass fusion, Pallas interpret on CPU) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import numpy as np
 
@@ -105,7 +122,7 @@ print(f"fused-plan parity OK: plan {fused.plan_name} "
       "detections bit-identical")
 EOF
 
-echo "== [8/10] telemetry + sanitizer smoke (journal + report + /metrics + /healthz + Config.sanitize) =="
+echo "== [9/11] telemetry + sanitizer smoke (journal + report + /metrics + /healthz + Config.sanitize) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import json, os, tempfile, urllib.request
 
@@ -181,7 +198,7 @@ print(f"sanitizer smoke OK: {stats_s.segments} segments with "
       "Config.sanitize on, tripwire restored")
 EOF
 
-echo "== [9/10] fault-injection smoke (one transient fault at every site -> recovery + v3 telemetry) =="
+echo "== [10/11] fault-injection smoke (one transient fault at every site -> recovery + v3 telemetry) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import json, os, tempfile
 
@@ -259,7 +276,7 @@ print(f"fault-injection smoke OK: {st1.segments} segments recovered "
       "/metrics + v3 journal")
 EOF
 
-echo "== [10/10] multichip dryrun (8 virtual devices) =="
+echo "== [11/11] multichip dryrun (8 virtual devices) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

@@ -5,11 +5,11 @@ the baseline), ``DOC`` (one line for ``--list-rules``) and
 ``check(project, module) -> iterator of Finding``.
 """
 
-from srtb_tpu.analysis.rules import (donate, dtype_drift, host_sync,
-                                     recompile, shared_state,
+from srtb_tpu.analysis.rules import (donate, dtype_drift, host_callback,
+                                     host_sync, recompile, shared_state,
                                      swallowed_except)
 
-ALL_RULES = (host_sync, donate, recompile, dtype_drift, shared_state,
-             swallowed_except)
+ALL_RULES = (host_sync, host_callback, donate, recompile, dtype_drift,
+             shared_state, swallowed_except)
 
 RULE_IDS = tuple(r.RULE for r in ALL_RULES)

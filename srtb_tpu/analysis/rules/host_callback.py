@@ -1,0 +1,74 @@
+"""host-callback-in-jit: a Python host callback reachable inside a jit
+body or the dispatch window.
+
+``jax.pure_callback`` / ``jax.experimental.io_callback`` /
+``jax.debug.callback`` / ``jax.debug.print`` lower to a ``custom-call``
+that re-enters Python **mid-program**: on TPU the device stalls on the
+host round trip every execution (the exact overlap-killer class of
+arXiv:2101.00941's hidden syncs), and inside the dispatch window it
+serializes the in-flight stream just like an explicit host sync.  A
+debug print left in a hot path is invisible at Python level once jitted
+— this rule catches it at the source, and the compile-time plan auditor
+(analysis/hlo_audit.py ``transfer_free`` check) proves the lowered
+artifact stayed callback-free at the HLO level.
+
+Accepted diagnostic uses (none exist today) belong in the baseline with
+a note, or behind a ``# srtb-lint: disable=host-callback-in-jit``
+pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from srtb_tpu.analysis.core import Finding, ModuleSource, Project
+from srtb_tpu.analysis.rules.host_sync import _hot_sets
+
+RULE = "host-callback-in-jit"
+DOC = ("pure_callback/io_callback/debug.callback/debug.print reachable "
+       "from a jit body or the dispatch window")
+
+_CALLBACKS = {
+    "jax.pure_callback":
+        "pure_callback re-enters Python mid-program",
+    "jax.experimental.io_callback":
+        "io_callback re-enters Python mid-program (and orders against "
+        "every other effect)",
+    "jax.experimental.host_callback.call":
+        "host_callback.call is the deprecated host round-trip API",
+    "jax.debug.callback":
+        "debug.callback re-enters Python mid-program",
+    "jax.debug.print":
+        "debug.print lowers to a host callback custom-call",
+}
+
+
+def _scan(info, mod: ModuleSource, zone: str):
+    for node in info.body_nodes():
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = mod.dotted_name(node.func)
+        msg = _CALLBACKS.get(dotted or "")
+        if msg is not None:
+            yield Finding(
+                RULE, mod.path, mod.rel, node.lineno, node.col_offset,
+                f"{msg} — the device stalls on the host every "
+                f"execution; keep callbacks out of the {zone} (move "
+                "diagnostics to the drain/sink side, or gate behind "
+                "the sanitizer)",
+                info.qualname, mod.line_text(node.lineno))
+
+
+def check(project: Project, mod: ModuleSource):
+    dispatch, jit_bodies = _hot_sets(project)
+    seen = set()
+    for info in dispatch:
+        if info.module is mod:
+            for f in _scan(info, mod, "dispatch window"):
+                seen.add((f.line, f.col))
+                yield f
+    for info in jit_bodies:
+        if info.module is mod:
+            for f in _scan(info, mod, "jit body"):
+                if (f.line, f.col) not in seen:
+                    yield f

@@ -77,6 +77,95 @@ def _load_native():
 
 _NATIVE = _load_native()
 
+# capability probe result, resolved once per process (None = unprobed)
+_RECVMMSG_OK: bool | None = None
+
+
+def _probe_recvmmsg() -> bool:
+    """Whether the recvmmsg(2) syscall actually works here.
+
+    Having ``libsrtb_udp.so`` built says nothing about the *kernel*:
+    sandboxed CI (gVisor/seccomp) accepts plain recvfrom but fails
+    recvmmsg with EINVAL/ENOSYS, which surfaced as 7 seed test failures
+    (``receive_block failed rc=-1``) rather than a clean skip.  Probe a
+    throwaway non-blocking loopback socket: EAGAIN means the syscall is
+    wired up and there is simply no datagram; anything else means the
+    native receiver cannot work in this environment."""
+    import errno as _errno
+
+    try:
+        libc = ctypes.CDLL(None, use_errno=True)
+        recvmmsg = libc.recvmmsg
+    except (OSError, AttributeError):
+        return False
+
+    class _Iovec(ctypes.Structure):
+        _fields_ = [("iov_base", ctypes.c_void_p),
+                    ("iov_len", ctypes.c_size_t)]
+
+    class _Msghdr(ctypes.Structure):
+        _fields_ = [("msg_name", ctypes.c_void_p),
+                    ("msg_namelen", ctypes.c_uint32),
+                    ("msg_iov", ctypes.POINTER(_Iovec)),
+                    ("msg_iovlen", ctypes.c_size_t),
+                    ("msg_control", ctypes.c_void_p),
+                    ("msg_controllen", ctypes.c_size_t),
+                    ("msg_flags", ctypes.c_int)]
+
+    class _Mmsghdr(ctypes.Structure):
+        _fields_ = [("msg_hdr", _Msghdr), ("msg_len", ctypes.c_uint)]
+
+    import select as _select
+
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        sock.bind(("127.0.0.1", 0))
+        sock.setblocking(False)
+        # deliver a real datagram first: some sandboxes answer EAGAIN
+        # on an empty queue (looks supported) and only fail EINVAL once
+        # recvmmsg actually has a message to deliver
+        tx.sendto(b"probe", sock.getsockname())
+        if not _select.select([sock], [], [], 2.0)[0]:
+            return False  # loopback delivery itself is broken here
+        buf = ctypes.create_string_buffer(16)
+        iov = _Iovec(ctypes.cast(buf, ctypes.c_void_p), len(buf))
+        mm = _Mmsghdr()
+        mm.msg_hdr.msg_iov = ctypes.pointer(iov)
+        mm.msg_hdr.msg_iovlen = 1
+        # mirror the native receiver's exact call shape: this sandbox's
+        # kernel accepts plain recvmmsg but rejects MSG_WAITFORONE
+        # (0x10000) with EINVAL — probing without the flag would pass
+        # here and still fail rc=-1 on the first real receive_block
+        msg_waitforone = 0x10000
+        rc = recvmmsg(sock.fileno(), ctypes.byref(mm), 1,
+                      msg_waitforone, None)
+        return rc >= 1
+    except OSError:
+        return False
+    finally:
+        tx.close()
+        sock.close()
+
+
+def native_available() -> bool:
+    """True when the native recvmmsg block receiver is usable: the lib
+    is built AND the kernel/sandbox actually implements recvmmsg.  The
+    single capability gate for auto-selection (UdpReceiverSource,
+    udp_soak) and for test skips — explicit ``use_native=True`` against
+    a False probe raises a clear OSError instead of a cryptic
+    ``rc=-1`` mid-receive."""
+    global _RECVMMSG_OK
+    if _NATIVE is None:
+        return False
+    if _RECVMMSG_OK is None:
+        _RECVMMSG_OK = _probe_recvmmsg()
+        if not _RECVMMSG_OK:
+            log.warning("[udp] recvmmsg unavailable in this environment "
+                        "(sandbox?) — native receiver disabled, Python "
+                        "fallback selected")
+    return _RECVMMSG_OK
+
 
 def counter_kind_for(fmt: formats.PacketFormat) -> int:
     return COUNTER_VDIF67 if fmt.name.startswith("gznupsr") else COUNTER_LE64
@@ -99,6 +188,11 @@ class NativeBlockReceiver:
         if _NATIVE is None:
             raise RuntimeError("libsrtb_udp.so not built "
                                "(run make -C srtb_tpu/native)")
+        if not native_available():
+            raise OSError(
+                "recvmmsg syscall unavailable in this environment "
+                "(sandboxed kernel?) — use the Python receiver "
+                "(use_native=False / udp_packet_provider='recvfrom')")
         self._lib = _NATIVE
         self._h = self._lib.srtb_udp_rx_create(
             addr.encode(), port, fmt.packet_payload_size,
@@ -569,8 +663,18 @@ class UdpReceiverSource:
                 "udp_packet_provider='packet_ring' needs the native lib "
                 "(make -C srtb_tpu/native) and use_native != False")
         if use_native is None:
-            use_native = (_NATIVE is not None and mode == "block"
-                          and provider not in ("recvfrom", "asyncio"))
+            if provider == "packet_ring":
+                # the AF_PACKET ring has its own syscalls (and its own
+                # OSError on failure) — recvmmsg availability is
+                # irrelevant to it
+                use_native = _NATIVE is not None
+            else:
+                # auto-selection consults the capability probe, not
+                # just lib presence: a sandbox without recvmmsg falls
+                # back to the Python block receiver instead of
+                # erroring mid-stream
+                use_native = (native_available() and mode == "block"
+                              and provider not in ("recvfrom", "asyncio"))
         rcvbuf = int(getattr(cfg, "udp_receiver_rcvbuf_bytes", 1 << 28))
         if mode == "continuous":
             # the continuous worker is sequential by construction; the

@@ -84,7 +84,17 @@ class SegmentProcessor:
     - **staged** (n >= STAGED_MIN_N, or ``staged=True``): three jitted
       programs — (a) unpack + pack + four-step first half, (b) four-step
       second half + Hermitian post-process, (c) RFI + in-step df64 chirp
-      + waterfall + detect.  Boundaries are stacked (re, im) float32.
+      + waterfall + detect.  Boundaries are stacked (re, im) float32 in
+      the CANONICAL shape [2, S, channel_count, watfft_len]: XLA only
+      honors ``donate_argnums`` when an output aval exactly matches the
+      donated input's aval, so every stage boundary (and the waterfall
+      output) shares one aval — stage (b) and (c) genuinely alias their
+      donated inputs instead of silently dropping the donation (the
+      pre-canonical shapes [2, S, n2, n1] -> [2, S, m] never matched
+      and XLA warned "donated buffers were not usable" on every staged
+      dispatch).  The reshapes ride the producing/consuming kernels.
+      ``python -m srtb_tpu.tools.plan_audit`` proves the aliasing
+      statically per plan.
     """
 
     def __init__(self, cfg: Config, window_name: str = W.DEFAULT_WINDOW,
@@ -222,12 +232,23 @@ class SegmentProcessor:
         in_donate = (0,) if self._donate_input else ()
         self._jit_process = jax.jit(self._process, donate_argnums=in_donate)
         self._jit_process_batch = None  # built lazily (micro-batch mode)
+        if self.staged:
+            # natural (pre-canonicalization) shape of the stage (a)
+            # intermediate, recovered inside stage (b) by a fused
+            # metadata reshape (abstract trace only — no compile, no run)
+            expected = cfg.segment_bytes(self.fmt.data_stream_count)
+            self._a_nat_shape = jax.eval_shape(
+                self._stage_a_nat,
+                jax.ShapeDtypeStruct((expected,), jnp.uint8)).shape
         self._jit_stage_a = jax.jit(self._stage_a, donate_argnums=in_donate)
         # the staged intermediates are consumed exactly once, so stages
-        # donate their inputs — without this the 4 GB boundary array of a
-        # 2^30 segment stays live across the next program's entire temp
-        # footprint and the chain ResourceExhausts at runtime even though
-        # each program compiled within budget
+        # donate their inputs — and because every boundary shares the
+        # canonical aval (see class docstring) the donation is a REAL
+        # input->output alias, not a dropped request: the 4 GB boundary
+        # array of a 2^30 segment is reused in place instead of staying
+        # live across the next program's entire temp footprint (the
+        # chain ResourceExhausted at runtime without it even though each
+        # program compiled within budget)
         self._jit_stage_b = jax.jit(self._stage_b, donate_argnums=(0,))
         self._jit_stage_c = jax.jit(self._stage_c, donate_argnums=(0,))
         self.aot_active = False
@@ -456,7 +477,38 @@ class SegmentProcessor:
             return F.subbyte_planes_to_packed(planes)[None]
         return F.pack_even_odd(self._unpack(raw))
 
+    # The staged boundary CANONICAL aval: [2, S, channel_count,
+    # watfft_len] float32.  Every stage consumes and produces this exact
+    # shape so XLA's aval-matching donation rule can alias each donated
+    # boundary to the stage's output (see the class docstring); the
+    # reshapes to/from the stages' natural working shapes are metadata
+    # remappings fused into the adjacent kernels' reads/writes — the
+    # plan auditor's entry-level copy count is the regression tripwire
+    # should a relayout ever materialize one as a real pass.
+
+    def _boundary_canon(self, x: jnp.ndarray) -> jnp.ndarray:
+        if self.channel_count * self.watfft_len == self.n_spectrum:
+            return x.reshape(2, -1, self.channel_count, self.watfft_len)
+        # non-dividing channel count: the waterfall row view truncates
+        # the spectrum tail (spec[..., :F*T]), so [2, S, F, T] cannot
+        # hold the full boundary — fall back to the flat canonical
+        # [2, S, m].  stage (b) in==out still aliases; stage (c)'s
+        # donation becomes a structural no_candidate (wf is smaller),
+        # which the plan card records honestly.
+        return x.reshape(2, -1, self.n_spectrum)
+
     def _stage_a(self, raw: jnp.ndarray):
+        return self._boundary_canon(self._stage_a_nat(raw))
+
+    def _stage_b(self, a_ri: jnp.ndarray):
+        return self._boundary_canon(
+            self._stage_b_nat(a_ri.reshape(self._a_nat_shape)))
+
+    def _stage_c(self, spec_ri: jnp.ndarray):
+        return self._stage_c_nat(
+            spec_ri.reshape(2, spec_ri.shape[1], -1))
+
+    def _stage_a_nat(self, raw: jnp.ndarray):
         """unpack + even/odd pack + segment-FFT first half."""
         impl = self._staged_impl()
         z = self._staged_pack(raw)
@@ -471,7 +523,7 @@ class SegmentProcessor:
                                len_cap=self._len_cap)  # [..., n2, n1]
         return jnp.stack([jnp.real(a), jnp.imag(a)])
 
-    def _stage_b(self, a_ri: jnp.ndarray):
+    def _stage_b_nat(self, a_ri: jnp.ndarray):
         """segment-FFT second half + Hermitian post -> spectrum [S, n/2].
         With the fused tail the RFI-s1 + df64-chirp epilogue folds into
         the Hermitian post's single write here, so stage (c) starts from
@@ -494,7 +546,7 @@ class SegmentProcessor:
                                          epilogue=epilogue)
         return jnp.stack([jnp.real(spec), jnp.imag(spec)])
 
-    def _stage_c(self, spec_ri: jnp.ndarray):
+    def _stage_c_nat(self, spec_ri: jnp.ndarray):
         """RFI s1 + in-step chirp + waterfall + RFI s2 + detect (the s1
         + chirp front half lives in stage (b) when the tail is fused)."""
         spec = jax.lax.complex(spec_ri[0], spec_ri[1])
@@ -731,8 +783,70 @@ class SegmentProcessor:
              # threshold) must miss the AOT cache cleanly
              "fused_tail": self.fused_tail,
              "skzap": self._skzap,
-             "hbm_passes": self.hbm_passes},
+             "hbm_passes": self.hbm_passes,
+             # staged-boundary schema version: the canonical
+             # donation-aliasable [2, S, F, T] boundary changed the
+             # staged programs' avals — a warm AOT cache written before
+             # it must miss cleanly, not feed the new chain executables
+             # with the old boundary shapes
+             "boundary": "canonical-v2"},
             sort_keys=True, default=str)
+
+    def lowerables(self):
+        """Every jitted program of this plan as ``(name, jit_fn,
+        abstract_args, donated_argnums)`` — lowerable via
+        ``jit_fn.lower(*abstract_args)`` without touching a device or
+        running anything.  The plan-enumeration hook the compile-time
+        HLO plan auditor (``srtb_tpu/analysis/hlo_audit.py``) and the
+        AOT cache both build on: abstract avals only, boundary shapes
+        chained by ``jax.eval_shape`` exactly as ``enable_aot`` chains
+        them, so the audited artifacts ARE the executed artifacts."""
+        expected = self.cfg.segment_bytes(self.fmt.data_stream_count)
+        raw_s = jax.ShapeDtypeStruct((expected,), jnp.uint8)
+        in_donate = (0,) if self._donate_input else ()
+        # Fresh jit wrappers of the underlying plan functions, NOT the
+        # self._jit_* attributes: enable_aot swaps those for loaded
+        # Compiled executables, which cannot .lower() again — the
+        # audit must stay lowerable on an AOT-active processor (e.g.
+        # SRTB_BENCH_AOT_DIR together with SRTB_BENCH_AUDIT).  The
+        # per-call wrappers are sanctioned here: this is the audit-only
+        # cold path (never the per-segment dispatch), and a cached
+        # wrapper would defeat the AOT independence above.
+        if self.staged:
+            a_out = jax.eval_shape(self._stage_a, raw_s)
+            b_out = jax.eval_shape(self._stage_b, a_out)
+            return [
+                ("stage_a",
+                 # srtb-lint: disable=recompile-hazard
+                 jax.jit(self._stage_a, donate_argnums=in_donate),
+                 (raw_s,), in_donate),
+                # srtb-lint: disable=recompile-hazard
+                ("stage_b", jax.jit(self._stage_b, donate_argnums=(0,)),
+                 (a_out,), (0,)),
+                # srtb-lint: disable=recompile-hazard
+                ("stage_c", jax.jit(self._stage_c, donate_argnums=(0,)),
+                 (b_out,), (0,)),
+            ]
+
+        def aval(x):
+            return None if x is None else jax.ShapeDtypeStruct(
+                x.shape, x.dtype)
+
+        progs = [("fused",
+                  # srtb-lint: disable=recompile-hazard
+                  jax.jit(self._process, donate_argnums=in_donate),
+                  (raw_s, aval(self.chirp), aval(self.chirp_w)),
+                  in_donate)]
+        mb = int(getattr(self.cfg, "micro_batch_segments", 1) or 1)
+        if mb > 1:
+            batch_s = jax.ShapeDtypeStruct((mb, expected), jnp.uint8)
+            progs.append(("batch",
+                          jax.jit(jax.vmap(self._process,
+                                           in_axes=(0, None, None)),
+                                  donate_argnums=in_donate),
+                          (batch_s, aval(self.chirp),
+                           aval(self.chirp_w)), in_donate))
+        return progs
 
     def enable_aot(self, path: str, allow_cpu: bool = False) -> bool:
         """Swap the jitted plan programs for cached compiled executables
@@ -789,6 +903,17 @@ class SegmentProcessor:
                 f"segment must be {expected} bytes, got {raw.shape}")
         return jax.device_put(np.ascontiguousarray(raw, dtype=np.uint8))
 
+    def _batch_jit(self):
+        """The lazily-built micro-batch program: the fused plan vmapped
+        over the leading batch axis (one jit object, shared by
+        :meth:`process_batch` and :meth:`lowerables`)."""
+        if self._jit_process_batch is None:
+            in_donate = (0,) if self._donate_input else ()
+            self._jit_process_batch = jax.jit(
+                jax.vmap(self._process, in_axes=(0, None, None)),
+                donate_argnums=in_donate)
+        return self._jit_process_batch
+
     def process_batch(self, raws) -> tuple[jnp.ndarray, det.DetectResult]:
         """Micro-batch mode: run B stacked segments ``raws`` [B, bytes]
         in ONE jit call (the fused plan vmapped over the batch axis),
@@ -805,12 +930,7 @@ class SegmentProcessor:
         if raw.ndim != 2 or raw.shape[1] != expected:
             raise ValueError(
                 f"batch must be [B, {expected}] bytes, got {raw.shape}")
-        if self._jit_process_batch is None:
-            in_donate = (0,) if self._donate_input else ()
-            self._jit_process_batch = jax.jit(
-                jax.vmap(self._process, in_axes=(0, None, None)),
-                donate_argnums=in_donate)
-        out = self._jit_process_batch(raw, self.chirp, self.chirp_w)
+        out = self._batch_jit()(raw, self.chirp, self.chirp_w)
         if self._sanitize and self._donate_input:
             from srtb_tpu.analysis import sanitizer as S
             # the sanitizer is the sanctioned holder of the donated

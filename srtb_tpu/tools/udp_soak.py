@@ -90,7 +90,9 @@ def run_soak(n_packets: int = 20000, impl: str = "auto",
     from srtb_tpu.utils.metrics import metrics
     fmt = formats.FASTMB_ROACH2  # 8-byte counter header + 4096-byte payload
     if impl == "auto":
-        impl = "native" if udp._NATIVE is not None else "python"
+        # capability probe, not lib presence: sandboxes without the
+        # recvmmsg syscall soak through the Python receiver
+        impl = "native" if udp.native_available() else "python"
     if impl == "native":
         rx = udp.NativeBlockReceiver("127.0.0.1", port, fmt)
     elif impl == "packet_ring":

@@ -540,6 +540,96 @@ class TestBaselineAndCli:
         assert data["new"] and data["new"][0]["rule"] == "sync-hot-path"
 
 
+# -------------------------------------------- host-callback-in-jit
+
+
+class TestHostCallbackInJit:
+    def test_jit_body_positive(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            import jax
+
+            def g(x):
+                jax.debug.print("x={x}", x=x)
+                return x * 2
+
+            f = jax.jit(g)
+        """)
+        fs = _run(tmp_path)
+        assert "host-callback-in-jit" in _rules(fs)
+        f = next(x for x in fs if x.rule == "host-callback-in-jit")
+        assert "debug.print" in f.message and f.context == "g"
+
+    def test_pure_callback_in_jit_body(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            import jax
+            import numpy as np
+
+            def host_fn(x):
+                return np.sort(x)
+
+            @jax.jit
+            def g(x):
+                return jax.pure_callback(
+                    host_fn, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+        """)
+        fs = _run(tmp_path)
+        assert "host-callback-in-jit" in _rules(fs)
+
+    def test_io_callback_via_alias(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            import jax
+            from jax.experimental import io_callback as iocb
+
+            def log_it(x):
+                pass
+
+            @jax.jit
+            def g(x):
+                iocb(log_it, None, x)
+                return x
+        """)
+        fs = _run(tmp_path)
+        assert "host-callback-in-jit" in _rules(fs)
+
+    def test_dispatch_window_positive(self, tmp_path):
+        _write(tmp_path, "pipeline/runtime.py", """
+            import jax
+
+            class Pipeline:
+                def _dispatch_segment(self, seg):
+                    jax.debug.callback(print, seg)
+                    return seg
+        """)
+        fs = _run(tmp_path)
+        assert "host-callback-in-jit" in _rules(fs)
+        f = next(x for x in fs if x.rule == "host-callback-in-jit")
+        assert "dispatch window" in f.message
+
+    def test_outside_jit_negative(self, tmp_path):
+        # a callback in plain host code (drain side) is sanctioned
+        _write(tmp_path, "mod.py", """
+            import jax
+
+            def drain(x):
+                jax.debug.print("x={x}", x=x)
+                return x
+        """)
+        assert "host-callback-in-jit" not in _rules(_run(tmp_path))
+
+    def test_pragma_suppression(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            import jax
+
+            @jax.jit
+            def g(x):
+                # sanctioned diagnostic
+                # srtb-lint: disable=host-callback-in-jit
+                jax.debug.print("x={x}", x=x)
+                return x
+        """)
+        assert "host-callback-in-jit" not in _rules(_run(tmp_path))
+
+
 # --------------------------------------------------- acceptance gate
 
 

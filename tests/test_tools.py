@@ -303,10 +303,17 @@ def test_e2e_live_overload_degrades_gracefully(tmp_path):
     assert rec["segments"] == 6
     # the offered load genuinely exceeded what was drained...
     assert rec["vs_realtime_window"] < rec["rate_x"]
-    # ...and the excess is visible as accounted loss, not a stall
-    assert rec["packets_lost"] > 0
-    assert 0 < rec["loss_rate"] < 1
-    assert rec["packets_total"] > rec["packets_lost"]
+    # ...and the excess is visible as ACCOUNTED loss, not a stall.
+    # Two sanctioned loss channels exist: kernel-buffer overflow
+    # surfacing as udp counter-gap loss (packets_lost), or — when the
+    # ingest thread keeps draining the socket faster than compute (the
+    # Python-receiver fallback on recvmmsg-less sandboxes does) — the
+    # overlap engine's DropOldestSegmentBuffer (segments_dropped).
+    dropped = rec["metrics_http"].get("segments_dropped", 0)
+    assert rec["packets_lost"] > 0 or dropped > 0, rec
+    if rec["packets_lost"]:
+        assert 0 < rec["loss_rate"] < 1
+        assert rec["packets_total"] > rec["packets_lost"]
 
 
 def test_trace_summary_wire_parser():

@@ -35,8 +35,9 @@ def test_block_assembly_with_loss_and_reorder(impl):
     fmt = formats.FASTMB_ROACH2
     payload = fmt.payload_bytes  # 4096
     port = 42000 + (0 if impl == "native" else 1)
-    if impl == "native" and udp._NATIVE is None:
-        pytest.skip("native lib not built")
+    if impl == "native" and not udp.native_available():
+        pytest.skip("native recvmmsg receiver unavailable "
+                    "(lib not built or syscall sandboxed)")
     cls = (udp.NativeBlockReceiver if impl == "native"
            else udp.PythonBlockReceiver)
     rx = cls("127.0.0.1", port, fmt)
@@ -67,8 +68,9 @@ def test_block_assembly_with_loss_and_reorder(impl):
 
 @pytest.mark.parametrize("impl", ["native", "python"])
 def test_udp_source_yields_segment(impl):
-    if impl == "native" and udp._NATIVE is None:
-        pytest.skip("native lib not built")
+    if impl == "native" and not udp.native_available():
+        pytest.skip("native recvmmsg receiver unavailable "
+                    "(lib not built or syscall sandboxed)")
     fmt = formats.FASTMB_ROACH2
     payload = fmt.payload_bytes
     port = 42010 + (0 if impl == "native" else 1)
@@ -228,7 +230,7 @@ def test_ingest_sustains_realtime_rate(impl):
     ingest ceiling recorded in PERF.md."""
     from srtb_tpu.tools.udp_soak import run_soak, REQUIRED_GBPS
     if impl == "default":
-        impl = "native" if udp._NATIVE is not None else "python"
+        impl = "native" if udp.native_available() else "python"
         port = 42150
     else:
         if udp._NATIVE is None:
@@ -250,7 +252,7 @@ def test_ingest_ceiling_exceeds_requirement():
     0.256 Gbps real-time requirement with a wide margin (loss against a
     full-speed sender is expected and must be accounted, not hidden)."""
     from srtb_tpu.tools.udp_soak import run_soak, REQUIRED_GBPS
-    impl = "native" if udp._NATIVE is not None else "python"
+    impl = "native" if udp.native_available() else "python"
     res = run_soak(n_packets=8000, impl=impl, port=42151)
     assert res["gbps"] > 2 * REQUIRED_GBPS, res
     # loss accounting is self-consistent
@@ -499,8 +501,9 @@ def test_block_assembly_duplicate_counter_accounting(impl):
     dup overwrites its slot (idempotent) and the block completes only
     when every distinct slot fills; a dup alongside a real gap still
     reports the loss."""
-    if impl == "native" and udp._NATIVE is None:
-        pytest.skip("native lib not built")
+    if impl == "native" and not udp.native_available():
+        pytest.skip("native recvmmsg receiver unavailable "
+                    "(lib not built or syscall sandboxed)")
     fmt = formats.FASTMB_ROACH2
     payload = fmt.payload_bytes
     cls = (udp.NativeBlockReceiver if impl == "native"
