@@ -175,11 +175,21 @@ def parse_args(argv=None):
     # env spelling the queue scripts use.
     p.add_argument("--fused-tail", choices=("auto", "on", "off"),
                    default=os.environ.get("SRTB_BENCH_FUSED_TAIL", "auto"))
+    # incremental H2D ring A/B legs (Config.ingest_ring).  Both ring
+    # legs upload bytes PER REP (the streaming pipeline's real transfer
+    # pattern, with overlap-save reserving a tail): "on" re-uploads only
+    # the stride through the warm assemble plan, "off" re-uploads the
+    # full segment.  The default "none" keeps the historical
+    # device-resident-input loop (no per-rep H2D, no reserve) so
+    # headline rows stay comparable across rounds.  SRTB_BENCH_RING is
+    # the env spelling the queue scripts use.
+    p.add_argument("--ring", choices=("on", "off", "none"),
+                   default=os.environ.get("SRTB_BENCH_RING", "none"))
     return p.parse_args(argv)
 
 
 def run_bench(platform_error, overlap: str = "on",
-              fused_tail: str = "auto"):
+              fused_tail: str = "auto", ring: str = "none"):
     import jax
 
     from srtb_tpu.utils.platform import apply_platform_env
@@ -212,14 +222,22 @@ def run_bench(platform_error, overlap: str = "on",
         baseband_freq_low=1405.0 + 32.0,
         baseband_bandwidth=-64.0,
         baseband_sample_rate=128e6,
-        dm=-478.80,
+        # SRTB_BENCH_DM: the reserved fraction scales with |DM|, so the
+        # ring legs use it both to fit small CI shapes (the production
+        # DM reserves more than a 2^16 segment) and to push the
+        # high-reserved-fraction legs where the ring saves the most
+        dm=float(os.environ.get("SRTB_BENCH_DM", "-478.80")),
         spectrum_channel_count=channels,
         mitigate_rfi_average_method_threshold=1.5,
         mitigate_rfi_spectral_kurtosis_threshold=1.05,
         signal_detect_signal_noise_threshold=8.0,
         signal_detect_max_boxcar_length=256,
         mitigate_rfi_freq_list="1418-1422",
-        baseband_reserve_sample=False,
+        # the ring legs measure overlap-save transfer traffic, so they
+        # reserve the dedispersion tail (|DM| 478.80 reserves ~16% of a
+        # 2^27 segment); the historical headline path keeps reserve off
+        baseband_reserve_sample=(ring != "none"),
+        ingest_ring=("on" if ring == "on" else "off"),
         fft_strategy=os.environ.get("SRTB_BENCH_FFT_STRATEGY", "auto"),
         use_pallas=bool(int(os.environ.get("SRTB_BENCH_USE_PALLAS", "0"))),
         use_pallas_sk=bool(int(os.environ.get("SRTB_BENCH_USE_PALLAS_SK",
@@ -260,8 +278,19 @@ def run_bench(platform_error, overlap: str = "on",
     # on some TPU tunnels block_until_ready returns silently on an
     # errored async execution — the error only surfaces at value fetch,
     # and a bench that never fetches would time failures as ~0 s.
-    wf, res = proc.run_device(raw_dev)
-    np.asarray(res.signal_counts)
+    # Ring legs warm BOTH carry-emitting programs (cold + warm assemble)
+    # so compile_s covers what the measured loop dispatches.
+    if ring == "on":
+        (wf, res), carry0 = proc.run_device_cold(raw_dev)
+        np.asarray(res.signal_counts)
+        del wf, res
+        (wf, res), carry0 = proc.run_device_ring(
+            carry0, jax.device_put(raw[proc.reserved_bytes:]))
+        np.asarray(res.signal_counts)
+        del carry0
+    else:
+        wf, res = proc.run_device(raw_dev)
+        np.asarray(res.signal_counts)
     compile_s = time.perf_counter() - t0
     del wf, res  # a retained 4 GB waterfall would OOM the next 2^30 run
 
@@ -282,10 +311,37 @@ def run_bench(platform_error, overlap: str = "on",
     # waterfall handle right after dispatch lets its 4 GB free as soon
     # as its segment completes (2^30 would OOM otherwise).
     reps = int(os.environ.get("SRTB_BENCH_REPS", "5"))
+    # the stride's "new" bytes for warm ring reps (length stride_bytes)
+    raw_tail = raw[proc.reserved_bytes:] if ring == "on" else None
+    h2d_host_s = 0.0
+    h2d_bytes_total = 0
     t0 = time.perf_counter()
     last = None
+    carry = None
     for _ in range(reps):
-        wf, res = proc.run_device(raw_dev)
+        if ring == "none":
+            wf, res = proc.run_device(raw_dev)
+        elif ring == "on" and carry is not None:
+            # warm: only the stride's new bytes cross the link; the
+            # staging host time is what the async engine hides under
+            # device compute (h2d_hidden_ms)
+            th = time.perf_counter()
+            new_dev = jax.device_put(raw_tail)
+            h2d_host_s += time.perf_counter() - th
+            h2d_bytes_total += raw_tail.nbytes
+            (wf, res), carry = proc.run_device_ring(carry, new_dev)
+        else:
+            # ring off (full re-upload per segment, the streaming
+            # pipeline's pre-ring transfer pattern) or the cold first
+            # ring dispatch
+            th = time.perf_counter()
+            dev = jax.device_put(raw)
+            h2d_host_s += time.perf_counter() - th
+            h2d_bytes_total += raw.nbytes
+            if ring == "on":
+                (wf, res), carry = proc.run_device_cold(dev)
+            else:
+                wf, res = proc.run_device(dev)
         last = res.signal_counts
         del wf, res
         if overlap == "off":
@@ -295,6 +351,7 @@ def run_bench(platform_error, overlap: str = "on",
             # paid every time
             np.asarray(last)
     np.asarray(last)
+    del carry
     dt = (time.perf_counter() - t0) / reps
 
     samples_per_sec = n / dt
@@ -324,7 +381,18 @@ def run_bench(platform_error, overlap: str = "on",
         "plan": proc.plan_name,
         "hbm_passes": proc.hbm_passes,
         "fused_tail": "on" if proc.fused_tail else "off",
+        "ring": ring,
     }
+    if ring != "none":
+        # H2D accounting (PERF.md "H2D accounting"): average uploaded
+        # bytes per segment (stride model: one cold full segment, then
+        # stride_bytes per warm rep) and the host wall time spent
+        # staging them — hidden under device compute with overlap on,
+        # serialized into every segment with overlap off
+        out["h2d_gb"] = round(h2d_bytes_total / reps / 1e9, 4)
+        out["h2d_hidden_ms"] = round(h2d_host_s / reps * 1e3, 2)
+        out["reserved_frac"] = round(
+            proc.reserved_bytes / proc._segment_bytes, 3)
     if int(os.environ.get("SRTB_BENCH_AUDIT", "0")):
         # Roofline cross-check against the compile-time HLO plan
         # auditor (srtb_tpu/analysis/hlo_audit.py): the measured plan's
@@ -407,7 +475,8 @@ def main():
     os.environ["JAX_PLATFORMS"] = platform
     watchdog = _arm_watchdog(platform, err)
     try:
-        run_bench(err, overlap=args.overlap, fused_tail=args.fused_tail)
+        run_bench(err, overlap=args.overlap, fused_tail=args.fused_tail,
+                  ring=args.ring)
         # disarm before teardown: a slow runtime shutdown must not fire
         # a second, contradictory diagnostic line after the real result
         if watchdog is not None:

@@ -24,13 +24,13 @@ if [ "${1:-}" = "--hardware" ]; then
   exit 0
 fi
 
-echo "== [1/11] native build =="
+echo "== [1/12] native build =="
 make -C srtb_tpu/native
 
-echo "== [2/11] native sanitizer harness (ASan/UBSan) =="
+echo "== [2/12] native sanitizer harness (ASan/UBSan) =="
 make -C srtb_tpu/native check
 
-echo "== [3/11] static checks (compile + import) =="
+echo "== [3/12] static checks (compile + import) =="
 python -m compileall -q srtb_tpu tests bench.py __graft_entry__.py
 python - <<'EOF'
 import importlib, pkgutil
@@ -45,7 +45,7 @@ assert not bad, bad
 print(f"all srtb_tpu modules import cleanly")
 EOF
 
-echo "== [4/11] srtb-lint (static analysis vs baseline) =="
+echo "== [4/12] srtb-lint (static analysis vs baseline) =="
 # fails on findings not in srtb_tpu/analysis/baseline.json; accept an
 # intentional finding with --write-baseline + a note, or a pragma.
 # The machine-readable run lands next to the other CI artifacts.
@@ -54,7 +54,7 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.lint srtb_tpu/ \
   --format json > artifacts/lint.json \
   || { cat artifacts/lint.json; exit 1; }
 
-echo "== [5/11] plan audit (compile-time HLO cards vs baseline) =="
+echo "== [5/12] plan audit (compile-time HLO cards vs baseline) =="
 # AOT-lowers every plan family and audits the compiled artifacts:
 # spectrum-sized HBM sweeps vs the declared hbm_passes floor, donation
 # proven aliased (not silently dropped), no f64/host-callback/
@@ -66,7 +66,7 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.plan_audit \
   --out artifacts/plan_cards_audit.json
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.plan_audit --selftest
 
-echo "== [6/11] pytest (8-device CPU mesh) =="
+echo "== [6/12] pytest (8-device CPU mesh) =="
 FAST_ARGS=()
 if [ "${1:-}" = "--fast" ]; then
   # one source of truth for what "slow" means: the pytest marker
@@ -75,11 +75,11 @@ if [ "${1:-}" = "--fast" ]; then
 fi
 python -m pytest tests/ -q "${FAST_ARGS[@]}"
 
-echo "== [7/11] bench smoke (with the roofline/audit cross-check) =="
+echo "== [7/12] bench smoke (with the roofline/audit cross-check) =="
 JAX_PLATFORMS=cpu SRTB_BENCH_LOG2N=16 SRTB_BENCH_AUDIT=1 \
   python bench.py | tail -1
 
-echo "== [8/11] fused-plan parity (spectrum-pass fusion, Pallas interpret on CPU) =="
+echo "== [8/12] fused-plan parity (spectrum-pass fusion, Pallas interpret on CPU) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import numpy as np
 
@@ -122,7 +122,76 @@ print(f"fused-plan parity OK: plan {fused.plan_name} "
       "detections bit-identical")
 EOF
 
-echo "== [9/11] telemetry + sanitizer smoke (journal + report + /metrics + /healthz + Config.sanitize) =="
+echo "== [9/12] ring parity smoke (incremental H2D ring on vs off, Pallas interpret) =="
+# The ISSUE-8 acceptance gate: ring-on output is bit-identical to
+# ring-off on a Pallas-kernel plan (interpret mode on CPU), and the
+# per-segment h2d_bytes counter equals the stride model exactly — the
+# full segment on the one cold dispatch, stride_bytes (segment minus
+# the reserved overlap tail) on every warm dispatch.  The plan-audit
+# stage [5/12] already proved the carry donation is a real alias for
+# every ring-v1 family; this proves the runtime keeps its half of the
+# contract.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import os, tempfile
+import numpy as np
+
+from srtb_tpu.config import Config
+from srtb_tpu.io.synth import make_dispersed_baseband
+from srtb_tpu.pipeline.runtime import Pipeline
+from srtb_tpu.utils.metrics import metrics
+
+tmp = tempfile.mkdtemp(prefix="srtb_ci_ring_")
+n = 1 << 14
+make_dispersed_baseband(n * 4, 1405.0, 64.0, 0.05, pulse_positions=n,
+                        nbits=8).tofile(os.path.join(tmp, "bb.bin"))
+
+class Cap:
+    def __init__(self): self.out = []
+    def push(self, w, p):
+        d = w.detect
+        self.out.append((np.asarray(d.signal_counts).copy(),
+                         np.asarray(d.zero_count).copy(),
+                         np.asarray(d.time_series).copy()))
+
+def run(ring):
+    metrics.reset()
+    cfg = Config(baseband_input_count=n, baseband_input_bits=8,
+                 baseband_freq_low=1405.0, baseband_bandwidth=64.0,
+                 baseband_sample_rate=128e6, dm=0.05,
+                 input_file_path=os.path.join(tmp, "bb.bin"),
+                 baseband_output_file_prefix=os.path.join(tmp, ring + "_"),
+                 spectrum_channel_count=64,
+                 mitigate_rfi_average_method_threshold=100.0,
+                 mitigate_rfi_spectral_kurtosis_threshold=2.0,
+                 baseband_reserve_sample=True, writer_thread_count=0,
+                 fft_strategy="four_step", use_pallas=True,
+                 inflight_segments=3, ingest_ring=ring)
+    sink = Cap()
+    with Pipeline(cfg, sinks=[sink]) as pipe:
+        stats = pipe.run()
+    h2d, cold = metrics.get("h2d_bytes"), metrics.get("ring_cold_dispatches")
+    metrics.reset()
+    return stats, sink, h2d, cold, pipe.processor
+
+s_on, c_on, h_on, cold_on, proc = run("on")
+s_off, c_off, h_off, cold_off, _ = run("off")
+assert proc.ring and proc.plan_name.endswith("+ring"), proc.plan_name
+assert s_on.segments == s_off.segments >= 4
+for a, b in zip(c_on.out, c_off.out):
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+seg_b, stride = proc._segment_bytes, proc.stride_bytes
+assert h_on == seg_b + (s_on.segments - 1) * stride, (h_on, seg_b, stride)
+assert h_off == s_off.segments * seg_b, h_off
+assert cold_on == 1 and cold_off == 0, (cold_on, cold_off)
+print(f"ring parity OK: plan {proc.plan_name}, {s_on.segments} segments "
+      f"bit-identical; h2d ring-on {int(h_on)} B == cold {seg_b} + "
+      f"{s_on.segments - 1} x stride {stride} (ring-off {int(h_off)} B; "
+      f"saved {int(h_off - h_on)} B = reserved fraction "
+      f"{proc.reserved_bytes / seg_b:.1%} per warm segment)")
+EOF
+
+echo "== [10/12] telemetry + sanitizer smoke (journal + report + /metrics + /healthz + Config.sanitize) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import json, os, tempfile, urllib.request
 
@@ -198,7 +267,7 @@ print(f"sanitizer smoke OK: {stats_s.segments} segments with "
       "Config.sanitize on, tripwire restored")
 EOF
 
-echo "== [10/11] fault-injection smoke (one transient fault at every site -> recovery + v3 telemetry) =="
+echo "== [11/12] fault-injection smoke (one transient fault at every site -> recovery + v3 telemetry) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import json, os, tempfile
 
@@ -276,7 +345,7 @@ print(f"fault-injection smoke OK: {st1.segments} segments recovered "
       "/metrics + v3 journal")
 EOF
 
-echo "== [11/11] multichip dryrun (8 virtual devices) =="
+echo "== [12/12] multichip dryrun (8 virtual devices) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

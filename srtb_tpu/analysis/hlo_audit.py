@@ -135,6 +135,38 @@ PLAN_FAMILIES = (
              donate=True, staged=True,
              env={"SRTB_STAGED_ROWS_IMPL": "pallas2"},
              expect_hbm_passes=5),
+    # ---- ingest-ring (ring-v1) families: overlap-save reserves a tail
+    # (baseband_reserve_sample + a small dm keeps 0 < reserved < n at
+    # the audit shape), so the two-input carry ++ new assemble programs
+    # exist and their carry donation must audit as a PROVEN alias
+    # (checks.ring_alias_ok) — uint8[reserved_bytes] in -> identical
+    # aval out, rewritten in place every warm dispatch.
+    PlanSpec("four_step_ftail_ring", "fused tail + ingest ring: carry "
+             "donation proven aliased on the warm assemble program",
+             {"fft_strategy": "four_step", "fused_tail": "on",
+              "baseband_reserve_sample": True, "dm": 0.1},
+             donate=True, expect_hbm_passes=5),
+    PlanSpec("monolithic_ring", "ring on the unfused monolithic "
+             "fallback plan",
+             {"fft_strategy": "monolithic", "fused_tail": "off",
+              "baseband_reserve_sample": True, "dm": 0.1},
+             donate=True, expect_hbm_passes=7),
+    PlanSpec("pallas_skzap_ring", "fully fused 4-pass plan + ring",
+             {"fft_strategy": "four_step", "fused_tail": "on",
+              "use_pallas": True, "use_pallas_sk": True,
+              "baseband_reserve_sample": True, "dm": 0.1},
+             donate=True, expect_hbm_passes=4),
+    PlanSpec("four_step_ftail_ring_mb2", "ring micro-batch: ONE carry "
+             "+ B stride uploads assemble B overlapped segments",
+             {"fft_strategy": "four_step", "fused_tail": "on",
+              "micro_batch_segments": 2,
+              "baseband_reserve_sample": True, "dm": 0.1},
+             donate=True, expect_hbm_passes=5),
+    PlanSpec("staged_ring", "staged plan + ring: stage_a_ring emits "
+             "the carry alongside the canonical boundary",
+             {"fft_strategy": "four_step", "fused_tail": "on",
+              "baseband_reserve_sample": True, "dm": 0.1},
+             donate=True, staged=True, expect_hbm_passes=5),
 )
 
 PLAN_KEYS = tuple(s.key for s in PLAN_FAMILIES)
@@ -382,6 +414,13 @@ def audit_processor(proc, keep_text: bool = False) -> dict:
         programs[name] = audit_program(fn, args, donated, spectrum_bytes,
                                        keep_text=keep_text)
     total_passes = sum(p["spectrum_passes"] for p in programs.values())
+    ring = bool(getattr(proc, "ring", False))
+    # the warm assemble programs whose carry (flat param 0) MUST alias:
+    # a dropped/no_candidate carry donation means every warm dispatch
+    # allocates a fresh reserved-tail buffer — the exact silent
+    # regression the ring-v1 gate exists to catch
+    warm_names = ("ring", "stage_a_ring", "batch_ring")
+    warm_progs = {n: p for n, p in programs.items() if n in warm_names}
     checks = {
         # declared hbm_passes is a FLOOR of real spectrum traffic: the
         # compiled artifact must sweep at least that much
@@ -396,12 +435,21 @@ def audit_processor(proc, keep_text: bool = False) -> dict:
             and not p["host_transfer_ops"] for p in programs.values()),
         "dtype_clean": all(p["f64_ops"] == 0 and p["c128_ops"] == 0
                            for p in programs.values()),
+        # ring-v1: the carry donation is a proven alias on EVERY warm
+        # assemble program (and those programs exist when the ring is
+        # resolved on); vacuously true for direct-ingest plans
+        "ring_alias_ok": (not ring or (
+            bool(warm_progs) and all(
+                0 in p["donation"]["aliased"] and p["alias_bytes"] > 0
+                for p in warm_progs.values()))),
     }
     return {
         "plan_name": proc.plan_name,
         "declared_hbm_passes": proc.hbm_passes,
         "fused_tail": bool(proc.fused_tail),
         "staged": bool(proc.staged),
+        "ingest": "ring-v1" if ring else "direct",
+        "reserved_bytes": int(getattr(proc, "reserved_bytes", 0)),
         "n_spectrum": proc.n_spectrum,
         "programs": programs,
         "total_spectrum_passes": total_passes,
@@ -442,7 +490,8 @@ _DIFF_PROGRAM_KEYS = (
     "host_transfer_ops", "custom_calls", "host_callbacks", "f64_ops",
     "c128_ops", "donation", "alias_bytes")
 _DIFF_PLAN_KEYS = ("plan_name", "declared_hbm_passes", "fused_tail",
-                   "staged", "total_spectrum_passes", "checks")
+                   "staged", "ingest", "reserved_bytes",
+                   "total_spectrum_passes", "checks")
 
 
 def stable_view(card: dict) -> dict:
@@ -585,4 +634,29 @@ def selftest(log2n: int = DEFAULT_LOG2N,
             "donation-disabled injection not caught: non-donating "
             f"wrapper still audits as aliased: {undonated['donation']} "
             f"alias_bytes={undonated['alias_bytes']}")
+
+    # ring-v1: the carry alias must be proven on the warm assemble
+    # program, and a plan that loses it (non-donating wrapper again)
+    # must fail the ring_alias_ok check
+    rspec = next(s for s in PLAN_FAMILIES
+                 if s.key == "four_step_ftail_ring")
+    rproc = build_plan(rspec, log2n=log2n, channels=channels)
+    if not rproc.ring:
+        failures.append("ring family resolved with the ring OFF "
+                        "(audit shape reserves no tail?)")
+        return failures
+    rcard = audit_processor(rproc)
+    if not rcard["checks"]["ring_alias_ok"]:
+        failures.append(
+            "clean ring plan fails ring_alias_ok: "
+            f"{rcard['programs'].get('ring', {}).get('donation')}")
+    rbytes = 8 * rproc.n_spectrum
+    (_, _, rargs, _), = [p for p in rproc.lowerables()
+                         if p[0] == "ring"]
+    lost = audit_program(jax.jit(rproc._process_ring), rargs, (), rbytes)
+    if lost["donation"]["declared"] or 0 in lost["donation"]["aliased"]:
+        failures.append(
+            "carry-donation-disabled injection not caught: the "
+            f"non-donating assemble still audits aliased: "
+            f"{lost['donation']}")
     return failures

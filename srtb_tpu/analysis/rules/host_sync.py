@@ -36,7 +36,9 @@ HOT_ROOTS = (
     ("pipeline/runtime.py", {
         "_dispatch_segment", "_dispatch_micro_batch", "_result_ready",
         "_timed_ingest", "fill_window", "ingest_one"}),
-    ("pipeline/segment.py", {"stage_input", "run_device"}),
+    ("pipeline/segment.py", {"stage_input", "run_device",
+                             "run_device_ring", "run_device_cold",
+                             "stack_batch"}),
 )
 
 _SYNC_FUNCS = {

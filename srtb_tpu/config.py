@@ -155,6 +155,19 @@ class Config:
     # anchored path agrees with the exact one to ~1e-9 turns
     # (ops/dedisperse.anchored_chirp_consts error budget)
     chirp_exact: bool = False
+    # incremental H2D overlap-save ring ("auto" | "on" | "off"): keep
+    # each segment's reserved tail device-resident as a raw-byte carry
+    # so every warm dispatch uploads only the stride's NEW bytes — H2D
+    # bytes per segment drop by exactly the reserved fraction,
+    # bit-identically (pipeline/segment.py ring plans; the carry
+    # donation is a proven input->output alias, checked by the plan
+    # audit).  "auto" = on whenever overlap-save reserves a byte-
+    # aligned non-empty tail; "on" forces it (errors when nothing is
+    # reserved); "off" restores full per-segment uploads and the file
+    # reader's legacy seek-back re-reads.  Cold full uploads (first
+    # segment, watchdog requeue, dispatch retry, shed, checkpoint
+    # resume) re-arm the carry from the retained host buffer.
+    ingest_ring: str = "auto"
     # bounded window of segments dispatched to the device before the
     # oldest result is drained (pipeline/runtime.py async engine):
     # ingest + unpack + H2D staging of segment k+1..k+W-1 run while the

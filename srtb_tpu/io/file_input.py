@@ -8,6 +8,17 @@ Mirrors read_file_pipe (ref: pipeline/read_file_pipe.hpp:31-127):
   consecutive segments overlap (the overlap-save "long-context" mechanism);
 - a logical byte counter, not the stream position, tracks progress because
   the final partial segment reads past EOF.
+
+Skip-read fast path (ingest ring, ``Config.ingest_ring`` != "off"):
+once a segment has been emitted, its reserved tail is retained in host
+memory, so the next segment reads only the stride's NEW bytes from disk
+— no seek-back, no re-read of bytes the reader just delivered — and the
+head is a host memcpy of the retained tail.  The emitted byte stream is
+bit-identical to the legacy seek-back path, and the ``reserved_bytes``
+bookkeeping (``logical_offset`` advancing by ``segment - reserved`` per
+segment) is UNCHANGED, so checkpoints written either way resume
+identically; a resume (or any start) has no retained tail and takes the
+full-read path as the cold fallback.
 """
 
 from __future__ import annotations
@@ -49,6 +60,18 @@ class BasebandFileReader:
         # the next segment starts, even past EOF zero-padding
         self.logical_offset = start
         self._exhausted = False
+        # skip-read fast path: the retained reserved tail of the last
+        # emitted segment (None = cold, take the full-read + seek-back
+        # path).  Gated on the ingest-ring knob so "off" restores the
+        # reference's exact read pattern.
+        self._skip_read = (
+            str(getattr(cfg, "ingest_ring", "auto")).lower() != "off"
+            and 0 < self.reserved_bytes < self.segment_bytes)
+        # shared tail-retention + seq-stamping contract (io/overlap.py);
+        # seek-back segments overlap too, so seq is always stamped —
+        # only the tail retention is gated on the skip-read path
+        from srtb_tpu.io.overlap import OverlapTailCarry
+        self._carry = OverlapTailCarry(self.reserved_bytes)
 
     def __iter__(self):
         return self
@@ -57,8 +80,10 @@ class BasebandFileReader:
         if self._exhausted:
             raise StopIteration
         buf = self.pool.acquire(self.segment_bytes)
+        warm = self._skip_read and self._carry.warm
+        reserved = self.reserved_bytes if warm else 0
         try:
-            chunk = self._file.read(self.segment_bytes)
+            chunk = self._file.read(self.segment_bytes - reserved)
         except BaseException:
             # a failed read may be retried by the pipeline's ingest
             # guard, which calls __next__ again and acquires a fresh
@@ -66,12 +91,19 @@ class BasebandFileReader:
             # transient strands a segment-sized block in the pool
             self.pool.release(buf)
             raise
-        if len(chunk) == 0:
+        if len(chunk) == 0 and not warm:
             self.pool.release(buf)
             log.info(f"[read_file] {self.cfg.input_file_path} has been read")
             self._exhausted = True
             raise StopIteration
-        buf[:len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
+        if warm:
+            # head = retained tail (host memcpy replaces the legacy
+            # seek-back disk re-read, bit-identically); with 0 new
+            # bytes this still emits the tail + zeros final segment
+            # the seek-back path would have produced
+            self._carry.head_into(buf)
+        buf[reserved:reserved + len(chunk)] = np.frombuffer(
+            chunk, dtype=np.uint8)
         # ingest telemetry: windowed read throughput + pool occupancy
         # gauges (the host-buffer analog of the receiver ring gauges)
         metrics.add("file_bytes_read", len(chunk))
@@ -83,18 +115,29 @@ class BasebandFileReader:
                     pool_stats["cached_bytes"])
         metrics.set("segment_pool_in_use", pool_stats["in_use"])
         self.logical_offset += self.segment_bytes
-        if len(chunk) < self.segment_bytes:
+        if len(chunk) < self.segment_bytes - reserved:
             # final partial segment: emit zero-padded, then stop
-            # (ref: read_file_pipe.hpp:76-77 memset + short read)
+            # (ref: read_file_pipe.hpp:76-77 memset + short read).
+            # Warm short reads land here too: a file ending exactly at
+            # a segment boundary still yields the same trailing
+            # tail-plus-zeros segment the seek-back path emits.
             self._exhausted = True
         elif 0 < self.reserved_bytes < self.segment_bytes:
-            # overlap-save: rewind so the next segment reprocesses the
+            # overlap-save: the next segment reprocesses the
             # dedispersion-corrupted tail (ref: read_file_pipe.hpp:86-99)
+            # — by retaining it in host memory (skip-read: the next
+            # read starts at the stride boundary, where the file
+            # position already is) or by the legacy seek-back re-read.
+            # logical_offset bookkeeping is identical either way.
             self.logical_offset -= self.reserved_bytes
-            self._file.seek(-self.reserved_bytes, 1)
+            if self._skip_read:
+                self._carry.retain(buf)
+            else:
+                self._file.seek(-self.reserved_bytes, 1)
         return SegmentWork(
             data=buf,
             timestamp=time.time_ns(),
+            seq=self._carry.next_seq(),
         )
 
     def close(self):

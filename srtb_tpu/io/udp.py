@@ -705,13 +705,80 @@ class UdpReceiverSource:
             raise ValueError(
                 f"segment bytes {self.segment_bytes} not a multiple of "
                 f"packet payload {payload}")
+        # Overlap-save for the real-time source: with
+        # baseband_reserve_sample active, consecutive segments must
+        # overlap by the reserved tail (exactly like the file reader's
+        # seek-back) so the dedispersion-corrupted edge each segment
+        # trims is re-processed by the next one instead of silently
+        # lost between UDP blocks.  The tail is retained in host
+        # memory and only the stride's NEW bytes are received per
+        # segment — the network hands over stride bytes, and when the
+        # ingest ring is live the device upload is the same stride.
+        from srtb_tpu.ops import dedisperse as dd
+        nsamps = dd.nsamps_reserved(cfg)
+        bits = abs(cfg.baseband_input_bits)
+        reserved = int(nsamps * bits // 8 * self.fmt.data_stream_count)
+        self.reserved_bytes = 0
+        seq_valid = True
+        if reserved > 0:
+            # the reserved tail is DM/bandwidth math rounded to
+            # waterfall tiles, so payload alignment holds only for
+            # cooperating configs.  A misaligned config keeps the
+            # legacy non-overlapping block framing (it ran that way
+            # before overlap-save existed here) with a loud warning —
+            # and its segments are left UNSTAMPED (seq = -1) so the
+            # engine's adjacency guard keeps the ingest ring cold
+            # rather than warm-assembling non-overlapping blocks
+            # against a carry that is not their head.
+            problems = []
+            if (nsamps * bits) % 8:
+                problems.append(f"reserved samples {nsamps} not "
+                                f"byte-aligned at {bits}-bit samples")
+            if reserved >= self.segment_bytes:
+                problems.append(f"reserved bytes {reserved} >= "
+                                f"segment {self.segment_bytes}")
+            if mode == "block" \
+                    and (self.segment_bytes - reserved) % payload:
+                problems.append(
+                    f"stride {self.segment_bytes - reserved} not a "
+                    f"multiple of the packet payload {payload} "
+                    "(align spectrum_channel_count / segment size to "
+                    "enable overlap)")
+            if problems:
+                log.warning(
+                    "[udp_receiver] overlap-save disabled ("
+                    + "; ".join(problems) + "): segments will NOT "
+                    "overlap and the ingest ring stays cold for this "
+                    "source")
+                seq_valid = False
+            else:
+                self.reserved_bytes = reserved
+        self.stride_bytes = self.segment_bytes - self.reserved_bytes
+        # shared tail-retention + seq-stamping contract (io/overlap.py)
+        from srtb_tpu.io.overlap import OverlapTailCarry
+        self._carry = OverlapTailCarry(self.reserved_bytes,
+                                       stamp_seq=seq_valid)
 
     def __iter__(self):
         return self
 
     def __next__(self) -> SegmentWork:
         buf = np.zeros(self.segment_bytes, dtype=np.uint8)
-        first_counter, lost, total = self.receiver.receive_block(buf)
+        # warm: head = retained tail of the previous segment; the
+        # receiver fills only the stride's new bytes (a contiguous
+        # view — both native and Python receivers write in place)
+        reserved = self._carry.head_into(buf)
+        first_counter, lost, total = self.receiver.receive_block(
+            buf[reserved:] if reserved else buf)
+        if reserved:
+            # the segment's first byte belongs to a packet
+            # reserved_bytes earlier than the first freshly received
+            # one (exact in block mode, where reserved is a payload
+            # multiple; floor-approximate for a mid-packet continuous
+            # tail)
+            first_counter -= reserved // self.fmt.payload_bytes
+        if self.reserved_bytes > 0:
+            self._carry.retain(buf)
         metrics.add("packets_total", total)
         metrics.add("packets_lost", lost)
         # windowed loss accounting: snapshot()/Prometheus derive the
@@ -731,6 +798,7 @@ class UdpReceiverSource:
             timestamp=time.time_ns(),
             udp_packet_counter=first_counter,
             data_stream_id=self.data_stream_id,
+            seq=self._carry.next_seq(),
         )
 
     def close(self):

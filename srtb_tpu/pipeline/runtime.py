@@ -262,6 +262,13 @@ class Pipeline:
         # per-sink push (not per drained item), so the engine's wedge
         # detectors see progress through a slow multi-sink flush
         self._sink_heartbeat = 0
+        # device-resident carry of the ingest ring (None = cold): the
+        # reserved tail of the last dispatched segment, threaded from
+        # one dispatch into the next (pipeline/segment.py ring plans),
+        # plus the (data_stream_id, seq) of that segment — warm
+        # assembly is only valid against the stream-adjacent successor
+        self._ring_carry = None
+        self._ring_prev = None
         # serializes the accounted/abandoned handoff between a wedged
         # sink worker and the bounded shutdown: _drain_body's
         # "abandoned? else account" decision and the shutdown's
@@ -396,8 +403,94 @@ class Pipeline:
                 return True
         return True
 
+    # ------------------------------------------------- ingest ring state
+
+    @property
+    def _ring_live(self) -> bool:
+        """Whether the device-resident carry ring is active for this
+        run: the processor resolved Config.ingest_ring on AND it speaks
+        the staging protocol (duck-typed stub processors don't)."""
+        return bool(getattr(self.processor, "ring", False)) \
+            and getattr(self.processor, "stage_input", None) is not None
+
+    def _ring_invalidate(self) -> None:
+        """Drop the device carry: the NEXT dispatch goes cold (full
+        upload from its retained host buffer).  Called whenever carry
+        continuity breaks — watchdog requeue, shed segment — and at
+        run start/end (a checkpoint resume is a fresh run, so resume
+        re-dispatch is cold by construction)."""
+        self._ring_carry = None
+        self._ring_prev = None
+
+    def _ring_adjacent(self, seg) -> bool:
+        """Whether ``seg`` is the stream-adjacent successor of the last
+        dispatched segment — the precondition for warm assembly: its
+        overlap head must BE the carry.  Unstamped segments (seq < 0,
+        e.g. hand-built SegmentWork) are never warm; a seq gap (a
+        dropped segment upstream) or a different data_stream_id (an
+        interleaved multi-receiver stream) goes cold rather than
+        assembling against a foreign tail."""
+        prev = self._ring_prev
+        return (prev is not None
+                and getattr(seg, "seq", -1) >= 0
+                and seg.seq == prev[1] + 1
+                and getattr(seg, "data_stream_id", 0) == prev[0])
+
+    def _dispatch_ring(self, seg, index: int, requeue: bool) -> tuple:
+        """Ring-mode device dispatch of one segment.  Warm when a
+        carry is live: upload stride bytes only and run the two-input
+        assemble plan.  Cold (no carry / requeue): full upload through
+        the carry-emitting cold plan, so the ring re-arms with no
+        extra H2D bytes.  A dispatch RETRY always re-stages cold from
+        the retained host buffer — the first attempt donated both the
+        carry and the staged stride bytes — and stays bit-identical.
+        ``requeue`` isolates the dispatch from the ring: the live
+        carry belongs to a LATER segment (the caller invalidated it),
+        and the requeued segment's own carry is already history."""
+        proc = self.processor
+        stage_in = proc.stage_input
+        carry = None if requeue or not self._ring_adjacent(seg) \
+            else self._ring_carry
+        if carry is not None:
+            self._ring_carry = None  # consumed below (donated)
+            staged = self._op("h2d", index,
+                              lambda: stage_in(seg.data,
+                                               stride_only=True))
+            attempt = [0]
+
+            def run_it():
+                attempt[0] += 1
+                if attempt[0] == 1:
+                    return proc.run_device_ring(carry, staged)
+                # the failed warm attempt consumed the carry: go cold
+                return proc.run_device_cold(stage_in(seg.data))
+
+            out, next_carry = self._op("dispatch", index, run_it)
+        else:
+            staged = self._op("h2d", index, lambda: stage_in(seg.data))
+            first = [True]
+
+            def run_it():
+                if first[0]:
+                    first[0] = False
+                    return proc.run_device_cold(staged)
+                return proc.run_device_cold(stage_in(seg.data))
+
+            out, next_carry = self._op("dispatch", index, run_it)
+        if not requeue:
+            # adopt the carry for the next dispatch; a requeued
+            # segment's carry is stale (the ring has moved past it)
+            self._ring_carry = next_carry
+            seq = getattr(seg, "seq", -1)
+            # an unstamped segment cannot anchor adjacency: the next
+            # dispatch stays cold
+            self._ring_prev = ((getattr(seg, "data_stream_id", 0), seq)
+                               if seq >= 0 else None)
+        return out
+
     def _dispatch_segment(self, seg, ingest_s: float,
-                          offset_after: int, index: int = 0) -> tuple:
+                          offset_after: int, index: int = 0,
+                          requeue: bool = False) -> tuple:
         """Stage one segment's bytes to the device (async H2D) and
         enqueue its program; both run under the "dispatch" stage, and
         under the "h2d" / "dispatch" fault sites respectively.
@@ -409,7 +502,9 @@ class Pipeline:
         requeues and the fault injector to schedule)."""
         with self._stage("dispatch"):
             stage_in = getattr(self.processor, "stage_input", None)
-            if stage_in is not None:
+            if self._ring_live:
+                wf, det_res = self._dispatch_ring(seg, index, requeue)
+            elif stage_in is not None:
                 staged = self._op("h2d", index,
                                   lambda: stage_in(seg.data))
                 first = [True]
@@ -448,10 +543,19 @@ class Pipeline:
         fault site (one jit call = one failure domain)."""
         t0 = time.perf_counter()
         with trace_annotation("srtb:dispatch"):
-            stacked = np.stack([np.asarray(s.data) for s in segs])
-            wf_b, det_b = self._op(
-                "dispatch", first_index,
-                lambda: self.processor.process_batch(stacked))
+            if self._ring_live:
+                wf_b, det_b = self._dispatch_batch_ring(segs, first_index)
+            else:
+                stack = getattr(self.processor, "stack_batch", None)
+                # host byte buffers, never device arrays: the
+                # contiguous wrap is a no-op for the sources' ndarrays
+                stacked = (stack([s.data for s in segs])
+                           if stack is not None else
+                           np.stack([np.ascontiguousarray(s.data)
+                                     for s in segs]))
+                wf_b, det_b = self._op(
+                    "dispatch", first_index,
+                    lambda: self.processor.process_batch(stacked))
         per_seg = (time.perf_counter() - t0) / len(segs)
         items = []
         for i, seg in enumerate(segs):
@@ -462,6 +566,44 @@ class Pipeline:
             items.append((seg, wf_b[i], det_i, offsets[i], span,
                           time.perf_counter(), first_index + i))
         return items
+
+    def _dispatch_batch_ring(self, segs: list, first_index: int):
+        """Ring-mode micro-batch dispatch: warm batches upload B stride
+        slices (pooled stack) against the live carry; cold batches
+        upload B full segments through the carry-emitting cold batch
+        plan.  Retries go cold from the retained host buffers, exactly
+        like the single-segment path."""
+        proc = self.processor
+        # warm needs the whole batch stream-adjacent: segs[0] continues
+        # the carry, and each member continues its predecessor
+        chain_ok = self._ring_adjacent(segs[0]) and all(
+            getattr(b, "seq", -1) == getattr(a, "seq", -2) + 1
+            and getattr(b, "data_stream_id", 0)
+            == getattr(a, "data_stream_id", 0)
+            for a, b in zip(segs, segs[1:]))
+        carry = self._ring_carry if chain_ok else None
+        datas = [s.data for s in segs]
+        if carry is not None:
+            self._ring_carry = None  # consumed below (donated)
+            attempt = [0]
+
+            def run_it():
+                attempt[0] += 1
+                if attempt[0] == 1:
+                    return proc.process_batch_ring(
+                        carry, proc.stack_batch(datas, stride_only=True))
+                return proc.process_batch_cold(proc.stack_batch(datas))
+
+            out, next_carry = self._op("dispatch", first_index, run_it)
+        else:
+            out, next_carry = self._op(
+                "dispatch", first_index,
+                lambda: proc.process_batch_cold(proc.stack_batch(datas)))
+        self._ring_carry = next_carry
+        seq = getattr(segs[-1], "seq", -1)
+        self._ring_prev = ((getattr(segs[-1], "data_stream_id", 0), seq)
+                           if seq >= 0 else None)
+        return out
 
     def _fetch_inflight(self, item: tuple, depth: int,
                         live_depth: int) -> tuple:
@@ -530,6 +672,17 @@ class Pipeline:
                                               positive, degrade_level,
                                               done=sinks_done))
         span["sink"] = self.stage_timer.last["sink"]
+        # host staging-buffer pool: copies staged for this segment
+        # (micro-batch stacks, non-contiguous inputs) are reusable once
+        # the segment drained — the device program that consumed the
+        # transfer has completed.  MUST run BEFORE the reader-pool
+        # release below: the registry keys on id(seg.data), and once
+        # the reader can reacquire that exact buffer object a fresh
+        # registration under the same id could be popped here instead,
+        # returning a staging buffer whose transfer is still in flight
+        rel = getattr(self.processor, "release_staging", None)
+        if rel is not None:
+            rel(seg.data)
         # file mode: sinks never retain segments (no piggybank deque),
         # so the host buffer can go back to the pool for the reader
         pool = getattr(self.source, "pool", None)
@@ -599,6 +752,10 @@ class Pipeline:
         start = time.perf_counter()
         n_samples_per_seg = cfg.baseband_input_count
         drained = [self.checkpoint.segments_done if self.checkpoint else 0]
+        # ring carry starts cold every run: a checkpoint-resumed (or
+        # simply restarted) process has no device-resident tail, so the
+        # first dispatch is a full upload that re-arms the ring
+        self._ring_invalidate()
 
         # sink work runs on a framework Pipe in overlapped mode so
         # writers + the lazy waterfall transfer cannot serialize into
@@ -715,11 +872,27 @@ class Pipeline:
             """Account one shed segment as explicit loss (counter +
             loss window) and return its host buffer to the reader pool
             (file mode — sinks never retained it); ``in_flight`` frees
-            the window slot the sink will never release."""
+            the window slot the sink will never release.  A shed also
+            breaks ring-carry continuity: the next dispatched
+            segment's overlap head is no longer the tail of the last
+            DISPATCHED segment, so the carry is invalidated and the
+            next dispatch re-arms cold (an undispatched shed breaks
+            the source-adjacency chain; an in-flight shed is just
+            conservative hygiene, at one full upload's cost)."""
             metrics.add("segments_dropped")
             metrics.window("segments_dropped").add(1)
+            self._ring_invalidate()
             if in_flight:
                 live_add(-1)
+            # staging release first, reader pool second — same id-reuse
+            # ordering rule as _drain_body.  Releasing is safe on every
+            # shed path: an undispatched shed never staged (no-op), and
+            # every in-flight shed (wedged-sink / bounded-shutdown)
+            # sheds a FETCHED item, so the program that consumed the
+            # staged transfer has provably completed.
+            rel = getattr(self.processor, "release_staging", None)
+            if rel is not None:
+                rel(seg_data)
             pool = getattr(self.source, "pool", None)
             if pool is not None and cfg.input_file_path:
                 pool.release(seg_data)
@@ -904,8 +1077,15 @@ class Pipeline:
                         f"cancelling and re-dispatching "
                         f"({used + 1}/{watchdog_max})")
                     seg, _wf, _det, offset_after, span, _t0, _i = item
+                    # ring: the wedged device may never materialize the
+                    # in-flight carry chain — invalidate so the next
+                    # FRESH dispatch goes cold too, and re-dispatch
+                    # this segment cold + carry-isolated from its
+                    # retained full host buffer (bit-identical)
+                    self._ring_invalidate()
                     item = self._dispatch_segment(
-                        seg, span["ingest"], offset_after, index)
+                        seg, span["ingest"], offset_after, index,
+                        requeue=True)
                     pending[0] = item
                     waited_since = time.perf_counter()
                 else:
@@ -1074,6 +1254,9 @@ class Pipeline:
                               "segments accounted as segments_dropped")
                 stop.request_stop()
             metrics.set("inflight_depth", 0)
+            # drop the carry's device buffer at run end (a retained
+            # reserved-tail array would pin HBM between runs)
+            self._ring_invalidate()
         if sink_pipe is not None and sink_pipe.exception is not None:
             raise sink_pipe.exception
         if sink_wedged:

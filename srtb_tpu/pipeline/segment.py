@@ -186,6 +186,25 @@ class SegmentProcessor:
         # trim of the waterfall time axis (ref: signal_detect_pipe.hpp:289-299)
         self.time_reserved_count = self.nsamps_reserved // self.channel_count
 
+        # ---- incremental H2D overlap-save ring (Config.ingest_ring) ----
+        # Overlap-save re-processes the reserved tail of every segment,
+        # so a full-segment upload re-transmits bytes that are already
+        # device-resident from one segment ago.  The ring keeps that
+        # tail on the device as a raw-byte CARRY: each warm dispatch
+        # uploads only the stride's new bytes and a jitted assemble step
+        # concatenates carry ++ new into the full segment while emitting
+        # the next carry with an IDENTICAL aval (uint8[reserved_bytes]
+        # in -> uint8[reserved_bytes] out) — XLA only honors donation on
+        # an exact aval match (the PR 7 lesson), so the carry donation
+        # is a *proven* input->output alias, checked per plan by the
+        # plan-audit gate (analysis/hlo_audit.py ring families).
+        self._segment_bytes = cfg.segment_bytes(self.fmt.data_stream_count)
+        self.reserved_bytes = int(
+            self.nsamps_reserved * abs(cfg.baseband_input_bits) // 8
+            * self.fmt.data_stream_count)
+        self.stride_bytes = self._segment_bytes - self.reserved_bytes
+        self.ring = self._resolve_ring()
+
         # Pallas kernels need interpret mode off-TPU (CPU CI)
         from srtb_tpu.utils.platform import on_accelerator
         self._pallas_interpret = not on_accelerator()
@@ -251,6 +270,45 @@ class SegmentProcessor:
         # program compiled within budget)
         self._jit_stage_b = jax.jit(self._stage_b, donate_argnums=(0,))
         self._jit_stage_c = jax.jit(self._stage_c, donate_argnums=(0,))
+        # ring plan variants.  The carry (arg 0) is ALWAYS donated: it
+        # is a ring-owned intermediate consumed exactly once per step
+        # (callers receive the next carry in exchange), and its output
+        # twin shares the exact aval so the donation is a real alias —
+        # the reserved-bytes buffer is rewritten in place every segment
+        # instead of accreting one fresh HBM allocation per dispatch.
+        # The stride input rides the caller's donate_input policy (it
+        # can never alias an output — recorded as no_candidate).
+        self._jit_ring = None
+        self._jit_cold = None
+        self._jit_stage_a_ring = None
+        self._jit_stage_a_cold = None
+        self._jit_batch_ring = None
+        self._jit_batch_cold = None
+        if self.ring:
+            ring_donate = (0,) + ((1,) if self._donate_input else ())
+            if self.staged:
+                self._jit_stage_a_ring = jax.jit(
+                    self._stage_a_ring, donate_argnums=ring_donate)
+                self._jit_stage_a_cold = jax.jit(
+                    self._stage_a_cold, donate_argnums=in_donate)
+            else:
+                self._jit_ring = jax.jit(self._process_ring,
+                                         donate_argnums=ring_donate)
+                self._jit_cold = jax.jit(self._process_cold,
+                                         donate_argnums=in_donate)
+        # host staging-buffer pool: when stage_input/stack_batch must
+        # materialize a contiguous uint8 copy (non-contiguous or
+        # non-uint8 input, micro-batch stacking), the bytes land in a
+        # pooled buffer sized by the plan's segment/stride byte counts
+        # instead of a fresh allocation per segment.  Buffers register
+        # against the owning segment's host buffer and return to the
+        # pool when the segment drains (Pipeline calls release_staging);
+        # the FIFO cap self-heals callers that never release.
+        from srtb_tpu.utils.bufferpool import BufferPool
+        self._staging_pool = BufferPool("staging")
+        self._staging_out: "dict[int, tuple]" = {}
+        self._staging_cap = 2 * max(
+            1, int(getattr(cfg, "inflight_segments", 2) or 1)) + 4
         self.aot_active = False
         if cfg.aot_plan_path:
             if not self.enable_aot(cfg.aot_plan_path):
@@ -299,6 +357,79 @@ class SegmentProcessor:
             return False
         return True
 
+    def _resolve_ring(self) -> bool:
+        """Resolve Config.ingest_ring ("auto"/"on"/"off") against the
+        plan: the ring needs a non-empty, byte-aligned reserved tail
+        strictly smaller than the segment.  "auto" turns it on whenever
+        overlap-save is active; "on" forces it (and errors when the
+        config has nothing to carry); "off" restores full re-uploads."""
+        mode = str(getattr(self.cfg, "ingest_ring", "auto")).lower()
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(
+                f"ingest_ring must be auto/on/off, got {mode!r}")
+        if mode == "off":
+            return False
+        bits = abs(self.cfg.baseband_input_bits)
+        usable = (self.nsamps_reserved > 0
+                  and (self.nsamps_reserved * bits) % 8 == 0
+                  and 0 < self.reserved_bytes < self._segment_bytes)
+        if mode == "on" and not usable:
+            raise ValueError(
+                "ingest_ring=on requires overlap-save with a byte-"
+                "aligned reserved tail (baseband_reserve_sample with "
+                f"0 < reserved_bytes < segment_bytes; got reserved="
+                f"{self.reserved_bytes} of {self._segment_bytes})")
+        return usable
+
+    # ---- ring plan variants: carry ++ new assemble + carry emission.
+    # The warm variants take (carry uint8[R], new uint8[stride]) and
+    # return the plan outputs PLUS the next carry uint8[R] — the last
+    # reserved_bytes of the assembled segment, emitted with the exact
+    # aval of the donated carry input so XLA aliases the two buffers.
+    # The cold variants take the full uint8[segment_bytes] upload and
+    # also emit the carry, so a cold dispatch needs no extra H2D bytes
+    # and no separate slice program to re-arm the ring.
+
+    def _process_ring(self, carry: jnp.ndarray, new: jnp.ndarray,
+                      chirp_ri: jnp.ndarray, chirp_w_ri=None):
+        raw = jnp.concatenate([carry, new])
+        out = self._process(raw, chirp_ri, chirp_w_ri)
+        return out, raw[self.stride_bytes:]
+
+    def _process_cold(self, raw: jnp.ndarray, chirp_ri: jnp.ndarray,
+                      chirp_w_ri=None):
+        return (self._process(raw, chirp_ri, chirp_w_ri),
+                raw[self.stride_bytes:])
+
+    def _stage_a_ring(self, carry: jnp.ndarray, new: jnp.ndarray):
+        raw = jnp.concatenate([carry, new])
+        return self._stage_a(raw), raw[self.stride_bytes:]
+
+    def _stage_a_cold(self, raw: jnp.ndarray):
+        return self._stage_a(raw), raw[self.stride_bytes:]
+
+    def _process_batch_ring(self, carry: jnp.ndarray, new_b: jnp.ndarray,
+                            chirp_ri: jnp.ndarray, chirp_w_ri=None):
+        """Micro-batch warm step: ONE carry plus B stride uploads
+        reassemble B overlapped segments (raw_i starts at i*stride of
+        carry ++ new_0 ++ ... ++ new_{B-1}); the next carry is the tail
+        of the whole window, aliased onto the donated carry."""
+        b = new_b.shape[0]
+        full = jnp.concatenate([carry, new_b.reshape(-1)])
+        seg = self._segment_bytes
+        raws = jnp.stack([full[i * self.stride_bytes:
+                               i * self.stride_bytes + seg]
+                          for i in range(b)])
+        out = jax.vmap(self._process, in_axes=(0, None, None))(
+            raws, chirp_ri, chirp_w_ri)
+        return out, full[full.shape[0] - self.reserved_bytes:]
+
+    def _process_batch_cold(self, raws: jnp.ndarray,
+                            chirp_ri: jnp.ndarray, chirp_w_ri=None):
+        out = jax.vmap(self._process, in_axes=(0, None, None))(
+            raws, chirp_ri, chirp_w_ri)
+        return out, raws[-1, self.stride_bytes:]
+
     @property
     def plan_name(self) -> str:
         """Human/bench-readable plan id: base plan + resolved strategy
@@ -309,6 +440,8 @@ class SegmentProcessor:
             name += "+ftail"
         if self._skzap:
             name += "+skzap"
+        if self.ring:
+            name += "+ring"
         return name
 
     @staticmethod
@@ -751,6 +884,9 @@ class SegmentProcessor:
         # different overlap settings must miss the cache cleanly, not
         # load a stale executable
         "inflight_segments", "micro_batch_segments",
+        # the ingest ring adds the two-input assemble programs and
+        # changes which program the engine dispatches per segment
+        "ingest_ring",
     )
 
     def plan_signature(self) -> str:
@@ -784,6 +920,11 @@ class SegmentProcessor:
              "fused_tail": self.fused_tail,
              "skzap": self._skzap,
              "hbm_passes": self.hbm_passes,
+             # resolved ingest plan: the ring's two-input assemble
+             # programs (and their carry avals) exist only when it is
+             # live, so a restart that resolves differently (e.g. a
+             # dm change flips reserved_bytes to 0) must miss cleanly
+             "ingest": "ring-v1" if self.ring else "direct",
              # staged-boundary schema version: the canonical
              # donation-aliasable [2, S, F, T] boundary changed the
              # staged programs' avals — a warm AOT cache written before
@@ -804,6 +945,9 @@ class SegmentProcessor:
         expected = self.cfg.segment_bytes(self.fmt.data_stream_count)
         raw_s = jax.ShapeDtypeStruct((expected,), jnp.uint8)
         in_donate = (0,) if self._donate_input else ()
+        ring_donate = (0,) + ((1,) if self._donate_input else ())
+        carry_s = jax.ShapeDtypeStruct((self.reserved_bytes,), jnp.uint8)
+        new_s = jax.ShapeDtypeStruct((self.stride_bytes,), jnp.uint8)
         # Fresh jit wrappers of the underlying plan functions, NOT the
         # self._jit_* attributes: enable_aot swaps those for loaded
         # Compiled executables, which cannot .lower() again — the
@@ -815,7 +959,7 @@ class SegmentProcessor:
         if self.staged:
             a_out = jax.eval_shape(self._stage_a, raw_s)
             b_out = jax.eval_shape(self._stage_b, a_out)
-            return [
+            progs = [
                 ("stage_a",
                  # srtb-lint: disable=recompile-hazard
                  jax.jit(self._stage_a, donate_argnums=in_donate),
@@ -827,16 +971,41 @@ class SegmentProcessor:
                 ("stage_c", jax.jit(self._stage_c, donate_argnums=(0,)),
                  (b_out,), (0,)),
             ]
+            if self.ring:
+                progs += [
+                    ("stage_a_ring",
+                     # srtb-lint: disable=recompile-hazard
+                     jax.jit(self._stage_a_ring,
+                             donate_argnums=ring_donate),
+                     (carry_s, new_s), ring_donate),
+                    ("stage_a_cold",
+                     # srtb-lint: disable=recompile-hazard
+                     jax.jit(self._stage_a_cold,
+                             donate_argnums=in_donate),
+                     (raw_s,), in_donate),
+                ]
+            return progs
 
         def aval(x):
             return None if x is None else jax.ShapeDtypeStruct(
                 x.shape, x.dtype)
 
+        chirps = (aval(self.chirp), aval(self.chirp_w))
         progs = [("fused",
                   # srtb-lint: disable=recompile-hazard
                   jax.jit(self._process, donate_argnums=in_donate),
-                  (raw_s, aval(self.chirp), aval(self.chirp_w)),
-                  in_donate)]
+                  (raw_s,) + chirps, in_donate)]
+        if self.ring:
+            progs += [
+                ("ring",
+                 # srtb-lint: disable=recompile-hazard
+                 jax.jit(self._process_ring, donate_argnums=ring_donate),
+                 (carry_s, new_s) + chirps, ring_donate),
+                ("ring_cold",
+                 # srtb-lint: disable=recompile-hazard
+                 jax.jit(self._process_cold, donate_argnums=in_donate),
+                 (raw_s,) + chirps, in_donate),
+            ]
         mb = int(getattr(self.cfg, "micro_batch_segments", 1) or 1)
         if mb > 1:
             batch_s = jax.ShapeDtypeStruct((mb, expected), jnp.uint8)
@@ -844,8 +1013,22 @@ class SegmentProcessor:
                           jax.jit(jax.vmap(self._process,
                                            in_axes=(0, None, None)),
                                   donate_argnums=in_donate),
-                          (batch_s, aval(self.chirp),
-                           aval(self.chirp_w)), in_donate))
+                          (batch_s,) + chirps, in_donate))
+            if self.ring:
+                news_s = jax.ShapeDtypeStruct((mb, self.stride_bytes),
+                                              jnp.uint8)
+                progs += [
+                    ("batch_ring",
+                     # srtb-lint: disable=recompile-hazard
+                     jax.jit(self._process_batch_ring,
+                             donate_argnums=ring_donate),
+                     (carry_s, news_s) + chirps, ring_donate),
+                    ("batch_cold",
+                     # srtb-lint: disable=recompile-hazard
+                     jax.jit(self._process_batch_cold,
+                             donate_argnums=in_donate),
+                     (batch_s,) + chirps, in_donate),
+                ]
         return progs
 
     def enable_aot(self, path: str, allow_cpu: bool = False) -> bool:
@@ -861,10 +1044,19 @@ class SegmentProcessor:
         sig = self.plan_signature()
         expected = self.cfg.segment_bytes(self.fmt.data_stream_count)
         raw_s = jax.ShapeDtypeStruct((expected,), jnp.uint8)
+        carry_s = jax.ShapeDtypeStruct((self.reserved_bytes,), jnp.uint8)
+        new_s = jax.ShapeDtypeStruct((self.stride_bytes,), jnp.uint8)
         if not self.staged:
             self._jit_process = cache.get_or_compile(
                 "fused", sig, self._jit_process, raw_s, self.chirp,
                 self.chirp_w)
+            if self.ring:
+                self._jit_ring = cache.get_or_compile(
+                    "ring", sig, self._jit_ring, carry_s, new_s,
+                    self.chirp, self.chirp_w)
+                self._jit_cold = cache.get_or_compile(
+                    "ring_cold", sig, self._jit_cold, raw_s,
+                    self.chirp, self.chirp_w)
         else:
             # chain the boundary avals by abstract evaluation (free:
             # trace only, no compile)
@@ -876,11 +1068,25 @@ class SegmentProcessor:
                 "stage_b", sig, self._jit_stage_b, a_out)
             self._jit_stage_c = cache.get_or_compile(
                 "stage_c", sig, self._jit_stage_c, b_out)
+            if self.ring:
+                self._jit_stage_a_ring = cache.get_or_compile(
+                    "stage_a_ring", sig, self._jit_stage_a_ring,
+                    carry_s, new_s)
+                self._jit_stage_a_cold = cache.get_or_compile(
+                    "stage_a_cold", sig, self._jit_stage_a_cold, raw_s)
         self.aot_active = True
         return True
 
     @staticmethod
-    def _as_device_bytes(raw) -> jnp.ndarray:
+    def _count_h2d(nbytes: int) -> None:
+        """Account one host->device transfer (the ring's falsifiable
+        payoff: warm dispatches move exactly stride_bytes, cold ones
+        exactly segment_bytes — tests and the ci smoke assert the
+        counter against that stride model)."""
+        from srtb_tpu.utils.metrics import metrics
+        metrics.add("h2d_bytes", nbytes)
+
+    def _as_device_bytes(self, raw) -> jnp.ndarray:
         """Host bytes -> device uint8 via *explicit* ``device_put``
         (``jnp.asarray`` on host data is an implicit H2D transfer; the
         explicit spelling keeps every pipeline transfer visible to
@@ -888,20 +1094,109 @@ class SegmentProcessor:
         if isinstance(raw, jax.Array):
             return raw if raw.dtype == jnp.uint8 \
                 else jnp.asarray(raw, dtype=jnp.uint8)
-        return jax.device_put(
-            np.ascontiguousarray(np.asarray(raw), dtype=np.uint8))
+        arr = np.ascontiguousarray(np.asarray(raw), dtype=np.uint8)
+        self._count_h2d(arr.nbytes)
+        return jax.device_put(arr)
 
-    def stage_input(self, raw) -> jnp.ndarray:
+    # ---------------------------- host staging buffers (pooled copies)
+
+    def _staged_host(self, raw, owner=None) -> np.ndarray:
+        """A contiguous uint8 host view of ``raw``, copying into a
+        pooled staging buffer only when a copy is unavoidable (wrong
+        dtype / non-contiguous input).  ``owner`` keys the buffer's
+        lifetime: it returns to the pool at release_staging(owner)
+        (the pipeline calls that when the segment drains), or via the
+        FIFO overflow cap for callers that never release."""
+        arr = raw if isinstance(raw, np.ndarray) \
+            else np.ascontiguousarray(raw)  # host data, never a device fetch
+        if arr.dtype == np.uint8 and arr.flags["C_CONTIGUOUS"]:
+            return arr
+        buf = self._staging_pool.acquire(arr.size, zero=False)
+        np.copyto(buf, arr.reshape(-1), casting="unsafe")
+        self._register_staging(owner if owner is not None else raw, buf)
+        return buf
+
+    def _register_staging(self, owner, buf: np.ndarray) -> None:
+        entry = self._staging_out.get(id(owner))
+        if entry is None:
+            # the owner rides in the entry so its id stays pinned
+            # until release (no reuse-after-GC key collisions)
+            self._staging_out[id(owner)] = (owner, [buf])
+        else:
+            entry[1].append(buf)
+        while len(self._staging_out) > self._staging_cap:
+            # overflow: the oldest registration's transfer completed
+            # long ago (the in-flight window bounds concurrency), so
+            # reclaiming it is safe even for a caller that never
+            # releases explicitly
+            _, (_owner, bufs) = next(iter(self._staging_out.items()))
+            self._staging_out.pop(id(_owner))
+            for b in bufs:
+                self._staging_pool.release(b)
+
+    def release_staging(self, owner) -> None:
+        """Return the staging buffers registered against ``owner``
+        (one segment's host byte buffer) to the pool.  Called by the
+        pipeline when the segment drains; a no-op for segments that
+        never needed a staging copy."""
+        entry = self._staging_out.pop(id(owner), None)
+        if entry is not None:
+            for b in entry[1]:
+                self._staging_pool.release(b)
+
+    def stack_batch(self, datas, stride_only: bool = False) -> np.ndarray:
+        """Stack B segments' host bytes into one pooled, contiguous
+        [B, segment_bytes] (or [B, stride_bytes] with ``stride_only``)
+        uint8 array for a micro-batch dispatch — reusing a staging
+        buffer instead of a fresh ``np.stack`` allocation per batch.
+        Registered against the FIRST segment's buffer: the batch is one
+        device program, so its first drain implies the whole transfer
+        completed."""
+        width = self.stride_bytes if stride_only else self._segment_bytes
+        buf = self._staging_pool.acquire(len(datas) * width, zero=False)
+        out = buf.reshape(len(datas), width)
+        for i, d in enumerate(datas):
+            src = d if isinstance(d, np.ndarray) \
+                else np.ascontiguousarray(d)
+            out[i] = src[src.shape[0] - width:] if stride_only else src
+        self._register_staging(datas[0], buf)
+        return out
+
+    # ------------------------------------------------- H2D staging
+
+    def stage_input(self, raw, stride_only: bool = False) -> jnp.ndarray:
         """Start the async host->device transfer of one segment's raw
         bytes and return the device handle immediately (H2D staging).
         The overlap engine calls this right after ingest, so the
         transfer runs under the *previous* segment's device compute
-        instead of serializing into the next dispatch."""
+        instead of serializing into the next dispatch.
+
+        With ``stride_only`` (the live ring's warm path) only the
+        stride's NEW bytes — ``raw[reserved_bytes:]`` — cross the PCIe/
+        tunnel link; the reserved head is already device-resident as
+        the carry.  ``raw`` stays the FULL segment either way: the
+        retained host buffer is what watchdog requeues and dispatch
+        retries re-stage cold, bit-identically."""
         expected = self.cfg.segment_bytes(self.fmt.data_stream_count)
         if raw.shape != (expected,):
             raise ValueError(
                 f"segment must be {expected} bytes, got {raw.shape}")
-        return jax.device_put(np.ascontiguousarray(raw, dtype=np.uint8))
+        staged = self._staged_host(raw, owner=raw)
+        if stride_only:
+            if not self.ring:
+                raise ValueError("stride_only staging requires the "
+                                 "ingest ring (Config.ingest_ring)")
+            staged = staged[self.reserved_bytes:]
+        elif self.ring:
+            # counted HERE, not in the engine, so the count stays one-
+            # per-full-upload under retries (a retried dispatch
+            # re-stages and re-counts) — the invariant telemetry
+            # consumers rely on: h2d_bytes == ring_cold_dispatches *
+            # segment_bytes + warm_count * stride_bytes
+            from srtb_tpu.utils.metrics import metrics
+            metrics.add("ring_cold_dispatches")
+        self._count_h2d(staged.nbytes)
+        return jax.device_put(staged)
 
     def _batch_jit(self):
         """The lazily-built micro-batch program: the fused plan vmapped
@@ -974,20 +1269,159 @@ class SegmentProcessor:
         if not self._sanitize:
             return self._jit_stage_c(
                 self._jit_stage_b(self._jit_stage_a(raw)))
+        # the sanitizer is the sanctioned holder of the donated input
+        # (it expires it)  # srtb-lint: disable=use-after-donate
+        a = self._staged_a_checks(self._jit_stage_a(raw), raw)
+        return self._staged_tail(a)
+
+    def _staged_a_checks(self, a, consumed, donated: bool | None = None):
+        """Sanitizer hooks at the stage (a) boundary: contract + NaN
+        tripwires, and explicit expiry of the consumed (donated)
+        input so a use-after-donate raises on CPU CI too.  ``donated``
+        overrides the donate_input default — the ring carry is ALWAYS
+        donated regardless of the raw-input policy, so its expiry must
+        not be gated on ``self._donate_input``."""
         from srtb_tpu.analysis import sanitizer as S
-        a = self._jit_stage_a(raw)
         S.check_contract("stage_a boundary", a, lead=2,
                          dtype=jnp.float32)
         S.check_finite("stage_a boundary", a)
-        if self._donate_input:
+        if self._donate_input if donated is None else donated:
             # sanctioned holder: expiry deletes the donated
             # buffer  # srtb-lint: disable=use-after-donate
-            S.expire_donated(raw, a)
+            S.expire_donated(consumed, a)
+        return a
+
+    def _staged_tail(self, a):
+        """Stages (b) + (c) under the sanitizer (the shared back half
+        of run_device and the ring variants)."""
+        from srtb_tpu.analysis import sanitizer as S
         b = self._jit_stage_b(a)  # donates a (checked above, by value)
         S.check_contract("stage_b boundary", b, lead=2,
                          dtype=jnp.float32)
         S.check_finite("stage_b boundary", b)
         return self._jit_stage_c(b)
+
+    # ------------------------------------------- ring execution paths
+
+    def run_device_ring(self, carry: jnp.ndarray, new: jnp.ndarray):
+        """Warm ring step: run one segment from the device-resident
+        ``carry`` (the previous segment's reserved tail) plus the
+        stride's freshly uploaded ``new`` bytes.  Returns
+        ``((waterfall_ri, detect), next_carry)``.
+
+        The carry is DONATED (a proven alias — see the ring comment in
+        ``__init__``): callers must treat it as consumed and thread the
+        returned next_carry into the following step instead."""
+        if not self.ring:
+            raise ValueError("ingest ring disabled for this plan "
+                             "(Config.ingest_ring / no reserved tail)")
+        if self.staged:
+            a, next_carry = self._jit_stage_a_ring(carry, new)
+            if not self._sanitize:
+                out = self._jit_stage_c(self._jit_stage_b(a))
+            else:
+                # sanctioned holder: _staged_a_checks expires the
+                # carry, which is donated UNCONDITIONALLY (unlike the
+                # raw input)
+                out = self._staged_tail(self._staged_a_checks(
+                    a, carry,  # srtb-lint: disable=use-after-donate
+                    donated=True))
+        else:
+            out, next_carry = self._jit_ring(carry, new, self.chirp,
+                                             self.chirp_w)
+            if self._sanitize:
+                from srtb_tpu.analysis import sanitizer as S
+                # sanctioned holder: the donated carry is expired
+                # here  # srtb-lint: disable=use-after-donate
+                S.expire_donated(carry, out)
+        return out, next_carry
+
+    def run_device_cold(self, raw: jnp.ndarray):
+        """Cold ring step: run one segment from a FULL device-resident
+        upload and (re-)arm the ring — the carry is emitted by the same
+        program, so a cold dispatch costs exactly segment_bytes of H2D
+        and no extra slice pass.  Used for the first segment and after
+        any event that breaks carry continuity (watchdog requeue,
+        dispatch retry, shed segment, checkpoint resume)."""
+        if not self.ring:
+            raise ValueError("ingest ring disabled for this plan "
+                             "(Config.ingest_ring / no reserved tail)")
+        if self.staged:
+            a, next_carry = self._jit_stage_a_cold(raw)
+            if not self._sanitize:
+                out = self._jit_stage_c(self._jit_stage_b(a))
+            else:
+                # sanctioned holder: _staged_a_checks expires the
+                # donated input  # srtb-lint: disable=use-after-donate
+                out = self._staged_tail(self._staged_a_checks(a, raw))
+        else:
+            out, next_carry = self._jit_cold(raw, self.chirp,
+                                             self.chirp_w)
+            if self._sanitize and self._donate_input:
+                from srtb_tpu.analysis import sanitizer as S
+                # sanctioned holder  # srtb-lint: disable=use-after-donate
+                S.expire_donated(raw, out)
+        return out, next_carry
+
+    def _batch_ring_jit(self):
+        if self._jit_batch_ring is None:
+            donate = (0,) + ((1,) if self._donate_input else ())
+            self._jit_batch_ring = jax.jit(self._process_batch_ring,
+                                           donate_argnums=donate)
+        return self._jit_batch_ring
+
+    def _batch_cold_jit(self):
+        if self._jit_batch_cold is None:
+            in_donate = (0,) if self._donate_input else ()
+            self._jit_batch_cold = jax.jit(self._process_batch_cold,
+                                           donate_argnums=in_donate)
+        return self._jit_batch_cold
+
+    def _check_batch(self, raw, width: int):
+        if self.staged:
+            raise ValueError(
+                "micro_batch_segments > 1 requires the fused plan "
+                "(staged segments are already dispatch-amortized)")
+        if raw.ndim != 2 or raw.shape[1] != width:
+            raise ValueError(
+                f"batch must be [B, {width}] bytes, got {raw.shape}")
+
+    def process_batch_ring(self, carry, news):
+        """Micro-batch warm ring step: B stride uploads ``news``
+        [B, stride_bytes] plus the device carry run B overlapped
+        segments in ONE vmapped jit call.  Returns
+        ``((waterfall_ri, detect), next_carry)`` batched like
+        :meth:`process_batch`; the carry is donated (consumed)."""
+        if not self.ring:
+            raise ValueError("ingest ring disabled for this plan "
+                             "(Config.ingest_ring / no reserved tail)")
+        news = self._as_device_bytes(news)
+        self._check_batch(news, self.stride_bytes)
+        out, next_carry = self._batch_ring_jit()(carry, news, self.chirp,
+                                                 self.chirp_w)
+        if self._sanitize:
+            from srtb_tpu.analysis import sanitizer as S
+            # sanctioned holder  # srtb-lint: disable=use-after-donate
+            S.expire_donated(carry, out)
+        return out, next_carry
+
+    def process_batch_cold(self, raws):
+        """Micro-batch cold ring step: B full-segment uploads, plan
+        outputs plus the re-armed carry in one jit call."""
+        if not self.ring:
+            raise ValueError("ingest ring disabled for this plan "
+                             "(Config.ingest_ring / no reserved tail)")
+        from srtb_tpu.utils.metrics import metrics
+        metrics.add("ring_cold_dispatches")  # one per full-batch upload
+        raws = self._as_device_bytes(raws)
+        self._check_batch(raws, self._segment_bytes)
+        out, next_carry = self._batch_cold_jit()(raws, self.chirp,
+                                                 self.chirp_w)
+        if self._sanitize and self._donate_input:
+            from srtb_tpu.analysis import sanitizer as S
+            # sanctioned holder  # srtb-lint: disable=use-after-donate
+            S.expire_donated(raws, out)
+        return out, next_carry
 
     @property
     def data_stream_count(self) -> int:

@@ -25,6 +25,14 @@ class SegmentWork:
     timestamp: int = 0          # nanoseconds since epoch
     udp_packet_counter: int = NO_UDP_PACKET_COUNTER
     data_stream_id: int = 0
+    # per-source emission sequence (-1 = unstamped).  The ingest ring's
+    # warm path is only valid between STREAM-ADJACENT segments (the new
+    # segment's overlap head must be the previous dispatched segment's
+    # tail): the engine goes cold whenever (data_stream_id, seq) is not
+    # exactly one step past the last dispatch — a dropped segment
+    # (DropOldestSegmentBuffer) or an interleaved multi-receiver stream
+    # must never be warm-assembled against a foreign carry.
+    seq: int = -1
 
 
 @dataclass

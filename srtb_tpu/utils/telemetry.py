@@ -151,6 +151,11 @@ def segment_span(segment: int, stages_s: dict, queue_depth: int,
         "restarts": int(metrics.get("worker_restarts")),
         "shed_waterfalls": int(metrics.get("shed_waterfalls")),
         "shed_baseband": int(metrics.get("shed_baseband")),
+        # ingest-ring H2D accounting (cumulative at drain; deltas
+        # between consecutive records give per-segment upload bytes —
+        # stride_bytes warm, segment_bytes cold)
+        "h2d_bytes": int(metrics.get("h2d_bytes")),
+        "ring_cold_dispatches": int(metrics.get("ring_cold_dispatches")),
     }
     if overlap_hidden_s is not None:
         rec["overlap_hidden_ms"] = round(

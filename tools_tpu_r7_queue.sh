@@ -1,0 +1,81 @@
+#!/bin/bash
+# Round-7 TPU hardware backlog: incremental-H2D ring A/Bs (device-
+# resident overlap-save carry) on top of the still-undrained r6 backlog
+# (fused-plan legs).  Each ring pair uploads bytes per segment the way
+# the streaming engine does — "off" re-uploads the full segment, "on"
+# only the stride's new bytes — so the delta isolates the transfer-side
+# win; h2d_gb / h2d_hidden_ms land in every line.  Safe to re-run; each
+# block is independent.  Run from the repo root with the TPU visible
+# (tools_tpu_watcher.sh fires it automatically).
+#
+#   bash tools_tpu_r7_queue.sh [quick]
+#
+# "quick" drains only the new ring rows (skips the r6 backlog and the
+# long 2^30 blocks).
+set -u
+OUT=${SRTB_PERF_OUT:-PERF_TPU.jsonl}
+stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
+note() { echo "{\"ts\": \"$(stamp)\", \"variant\": \"note\", \"note\": \"$1\"}" >> "$OUT"; }
+run() {
+  local tag="$1"; shift
+  echo "== $tag =="
+  local line
+  line=$("$@" 2>/dev/null | grep '^{' | tail -1)
+  if [ -n "$line" ]; then
+    echo "{\"ts\": \"$(stamp)\", \"variant\": \"$tag\", \"result\": $line}" >> "$OUT"
+    echo "$line"
+  else
+    echo "{\"ts\": \"$(stamp)\", \"variant\": \"$tag\", \"error\": true}" >> "$OUT"
+  fi
+}
+
+QUICK=${1:-}
+
+# ---- 0. the r6 backlog first (fused-plan legs, never drained) ----
+if [ "$QUICK" != "quick" ] && [ -f tools_tpu_r6_queue.sh ]; then
+  note "r7 queue: draining r6 backlog first"
+  bash tools_tpu_r6_queue.sh quick
+fi
+
+note "r7 queue start: incremental-H2D ring A/Bs (stride uploads vs full re-uploads)"
+
+# ---- 1. ring A/B at 2^27 (production |DM| 478.80 reserves ~16% of
+#          the segment; the ring should cut steady-state H2D by that
+#          fraction, bit-identically).  four_step hosts the fused tail
+#          so compute-side traffic matches the r6 flagship plans.
+run ring_off_27 env SRTB_BENCH_LOG2N=27 SRTB_BENCH_FFT_STRATEGY=four_step \
+    SRTB_BENCH_DEADLINE=900 python bench.py --ring off
+run ring_on_27  env SRTB_BENCH_LOG2N=27 SRTB_BENCH_FFT_STRATEGY=four_step \
+    SRTB_BENCH_DEADLINE=900 python bench.py --ring on
+
+# ---- 2. high-reserved-fraction legs at 2^27: |DM| 1600 reserves
+#          ~55% of the segment — the regime where re-uploading the
+#          tail dominates ingest traffic and the ring saves the most.
+run ring_hidm_off_27 env SRTB_BENCH_LOG2N=27 SRTB_BENCH_FFT_STRATEGY=four_step \
+    SRTB_BENCH_DM=-1600 SRTB_BENCH_DEADLINE=900 python bench.py --ring off
+run ring_hidm_on_27  env SRTB_BENCH_LOG2N=27 SRTB_BENCH_FFT_STRATEGY=four_step \
+    SRTB_BENCH_DM=-1600 SRTB_BENCH_DEADLINE=900 python bench.py --ring on
+
+if [ "$QUICK" = "quick" ]; then exit 0; fi
+
+# ---- 3. 2^30 staged production segment: the staged ring
+#          (stage_a_ring emits the carry alongside the canonical
+#          boundary).  3 reps — each leg moves ~0.27 GB (warm) vs
+#          ~0.34 GB (cold) of H2D per segment at the ~2% 2^30
+#          reserved fraction, so the headline check here is
+#          bit-identical plans + h2d accounting, with the hi-DM pair
+#          below carrying the bandwidth story.
+run staged_ring_off_30 env SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 \
+    SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=2700 python bench.py --ring off
+run staged_ring_on_30  env SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 \
+    SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=2700 python bench.py --ring on
+# high-DM 2^30 staged pair (|DM| 12000 reserves ~40% of 2^30): the
+# production regime the ISSUE motivates — reserved-dominated ingest
+run staged_ring_hidm_off_30 env SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 \
+    SRTB_BENCH_DM=-12000 SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=2700 \
+    python bench.py --ring off
+run staged_ring_hidm_on_30  env SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 \
+    SRTB_BENCH_DM=-12000 SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=2700 \
+    python bench.py --ring on
+
+note "r7 queue done"
