@@ -573,6 +573,80 @@ def failed_checks(cards: dict) -> list:
 
 
 # ------------------------------------------------------------------
+# demotion-ladder target audit: the self-healing ladder must never
+# demote into an unaudited plan family
+
+# the fully-featured ladder base: every canonical demotion rung is
+# live from here (micro-batch, ring, skzap, fused tail, staged,
+# monolithic), so walking it exercises the ladder's whole range
+LADDER_AUDIT_CFG = {
+    "fft_strategy": "four_step", "fused_tail": "on",
+    "use_pallas": True, "use_pallas_sk": True,
+    "micro_batch_segments": 2,
+    "baseband_reserve_sample": True, "dm": 0.1,
+}
+
+
+def _plan_fingerprint(plan_name: str, ingest: str, staged: bool,
+                      micro_batch: bool) -> tuple:
+    return (str(plan_name), str(ingest), bool(staged),
+            bool(micro_batch))
+
+
+def _card_fingerprints(baseline: "CardBaseline") -> dict:
+    """fingerprint -> [family keys] over the checked-in cards.  The
+    fingerprint is (plan_name, ingest, staged, has-micro-batch):
+    plan_name already encodes strategy + fused_tail + skzap + ring,
+    and a micro-batching plan carries a "batch" program."""
+    out: dict[tuple, list] = {}
+    for key, card in baseline.cards.items():
+        fp = _plan_fingerprint(
+            card.get("plan_name", ""), card.get("ingest", "direct"),
+            card.get("staged", False),
+            "batch" in card.get("programs", {}))
+        out.setdefault(fp, []).append(key)
+    return out
+
+
+def audit_ladder(baseline: "CardBaseline",
+                 log2n: int = DEFAULT_LOG2N,
+                 channels: int = DEFAULT_CHANNELS) -> list:
+    """Check that EVERY demotion-ladder rung reachable from the
+    fully-featured audit config resolves to a plan family already
+    carded in the baseline — the self-healing ladder
+    (resilience/demote.py) must never land the run on an unaudited
+    plan.  Returns failure strings (empty = every target is carded).
+
+    Builds each rung's SegmentProcessor at the audit shape (constants
+    only — nothing lowers or runs) and matches its resolved
+    fingerprint against the baseline cards."""
+    from srtb_tpu.pipeline.segment import SegmentProcessor
+    from srtb_tpu.resilience.demote import ladder_rungs
+
+    cfg = _audit_config(log2n, channels, dict(LADDER_AUDIT_CFG))
+    rungs = ladder_rungs(cfg)
+    failures = []
+    if not rungs:
+        return ["ladder: no demotion rungs resolved from the "
+                "fully-featured audit config (ladder dead?)"]
+    fps = _card_fingerprints(baseline)
+    for rung in rungs:
+        proc = SegmentProcessor(rung.cfg, staged=rung.staged,
+                                donate_input=True)
+        mb = int(getattr(rung.cfg, "micro_batch_segments", 1) or 1)
+        fp = _plan_fingerprint(proc.plan_name,
+                               "ring-v1" if proc.ring else "direct",
+                               proc.staged, mb > 1)
+        if fp not in fps:
+            failures.append(
+                f"ladder: rung {rung.step!r} resolves to an UNAUDITED "
+                f"plan (plan={fp[0]} ingest={fp[1]} staged={fp[2]} "
+                f"micro_batch={fp[3]}) — card the family in "
+                "plan_cards.json before the ladder may demote into it")
+    return failures
+
+
+# ------------------------------------------------------------------
 # selftest: prove the auditor catches the regressions it exists for
 
 
@@ -659,4 +733,22 @@ def selftest(log2n: int = DEFAULT_LOG2N,
             "carry-donation-disabled injection not caught: the "
             f"non-donating assemble still audits aliased: "
             f"{lost['donation']}")
+
+    # demotion-ladder gate: every rung must match the checked-in
+    # baseline, and the gate must visibly fail against a baseline
+    # with no cards (= every rung unaudited)
+    checked_in = CardBaseline.load(DEFAULT_BASELINE)
+    if checked_in.cards:
+        ladder_problems = audit_ladder(checked_in, log2n=log2n,
+                                       channels=channels)
+        if ladder_problems:
+            failures.append(
+                "demotion-ladder targets do not all resolve to "
+                "checked-in plan cards: " + "; ".join(ladder_problems))
+    missing = audit_ladder(CardBaseline(), log2n=log2n,
+                           channels=channels)
+    if not missing:
+        failures.append(
+            "ladder-gate injection not caught: an EMPTY baseline "
+            "still passes audit_ladder (the gate would never fire)")
     return failures

@@ -233,10 +233,38 @@ class Config:
     degrade_queue_high: float = 0.9
     degrade_queue_low: float = 0.25
     degrade_hold_segments: int = 3
+    # ---- self-healing compute (resilience/demote.py) ----
+    # plan-demotion ladder for device OOM / compile faults: "auto"
+    # walks micro_batch -> ring -> skzap -> fused_tail -> staged ->
+    # monolithic (cumulatively, skipping rungs the active config
+    # doesn't use); an explicit comma list selects a subset in that
+    # order; "off" disables demotion (device faults escalate like any
+    # fatal).  Each demotion rebuilds the segment plan from the rung's
+    # config (the AOT cache misses cleanly via plan_signature) and
+    # re-dispatches the faulted segment cold from its retained host
+    # buffer.  Every demotion-ladder target is audited: the plan-audit
+    # CI gate proves each rung resolves to a carded plan family.
+    plan_ladder: str = "auto"
+    # promotion probe: after this many consecutively healthy segments
+    # on a demoted plan, step one rung back up (the next dispatch
+    # probes the richer plan; a recurring fault just demotes again).
+    # 0 = stay demoted for the rest of the run.
+    promote_after_segments: int = 0
+    # device-halt recovery: tear down in-flight device state, clear
+    # the jax caches, rebuild the processor (fresh executables on the
+    # new backend handle) and re-dispatch in-flight segments from
+    # their retained host buffers — at most this many reinits within
+    # device_reinit_window_s, then escalation (a flapping device must
+    # not flap forever).  0 disables reinit recovery.
+    device_reinit_max: int = 2
+    device_reinit_window_s: float = 300.0
     # deterministic fault injection (resilience/faults.py):
     # "site:action@index,..." with sites ingest|h2d|dispatch|fetch|
     # sink_write|checkpoint and actions raise|fatal|corrupt|
-    # stall=SECONDS; "" = off (zero cost)
+    # stall=SECONDS, plus the device-fault actions oom|compile_fail|
+    # device_halt (h2d/dispatch/fetch sites only — they raise with
+    # the real jax exception strings so the self-healing ladder's
+    # string classifier is exercised); "" = off (zero cost)
     fault_plan: str = ""
     # bounded join of worker threads at shutdown (pipeline sink pipe,
     # ThreadedPipeline drain): on expiry the wedged thread is reported
@@ -305,7 +333,8 @@ class Config:
         "telemetry_journal_max_bytes", "inflight_segments",
         "micro_batch_segments", "retry_max_attempts",
         "segment_watchdog_requeues", "supervisor_max_restarts",
-        "degrade_hold_segments",
+        "degrade_hold_segments", "promote_after_segments",
+        "device_reinit_max",
     })
     _FLOAT_FIELDS = frozenset({
         "baseband_freq_low", "baseband_bandwidth", "baseband_sample_rate",
@@ -317,6 +346,7 @@ class Config:
         "retry_backoff_max_s", "retry_deadline_s",
         "supervisor_window_s", "degrade_queue_high",
         "degrade_queue_low", "shutdown_join_timeout_s",
+        "device_reinit_window_s",
     })
     _BOOL_FIELDS = frozenset({
         "baseband_reserve_sample", "baseband_write_all", "gui_enable",

@@ -40,8 +40,21 @@ crashed sink pipe is restarted with a bounded budget
 (``supervisor_max_restarts``), and sustained sink backlog walks the
 graceful-degradation ladder (shed waterfall dumps, then baseband
 dumps, then accounted whole-segment loss).  Every recovery is a
-counter and a v3 journal field; ``Config.fault_plan`` injects
+counter and a journal field; ``Config.fault_plan`` injects
 deterministic faults at any site for CI.
+
+Self-healing compute (resilience/demote.py, PR 9): failures the
+accelerator side raises — device OOM, Pallas/Mosaic compile faults,
+device halts — are classified from the real jax exception strings and
+recovered instead of escalating: OOM/compile faults demote the plan
+down an audited ladder (micro_batch -> ring -> skzap -> fused_tail ->
+staged -> monolithic) and re-dispatch the faulted segment cold from
+its retained host buffer; halts reinitialize the backend (clear
+caches, rebuild the processor, re-dispatch the in-flight window)
+under a bounded reinit budget; ``promote_after_segments`` probes back
+up after a healthy stretch.  Counters: ``plan_demotions``,
+``plan_promotions``, ``device_reinits``; gauge ``plan_ladder_level``;
+journal field ``active_plan`` (schema v4).
 """
 
 from __future__ import annotations
@@ -61,7 +74,7 @@ from srtb_tpu.io.file_input import BasebandFileReader
 from srtb_tpu.io.writers import WriteAllSink, WriteSignalSink
 from srtb_tpu.pipeline.segment import SegmentProcessor
 from srtb_tpu.pipeline.work import SegmentResultWork, SegmentWork
-from srtb_tpu.resilience.errors import WatchdogEscalation
+from srtb_tpu.resilience.errors import DEVICE_HALT, WatchdogEscalation
 from srtb_tpu.resilience.faults import FaultInjector
 from srtb_tpu.resilience.retry import RetryPolicy, retry_call
 from srtb_tpu.utils import telemetry
@@ -258,6 +271,17 @@ class Pipeline:
         self.faults = FaultInjector.from_plan(
             getattr(cfg, "fault_plan", ""))
         self.retry = RetryPolicy.from_config(cfg)
+        # self-healing compute (resilience/demote.py): plan demotion
+        # for device OOM/compile faults, bounded backend reinit for
+        # halts.  None when both are configured off; when armed it is
+        # consulted only from the dispatch/fetch exception handlers
+        # plus one counter bump per drained segment — a healthy run
+        # pays nothing measurable (PERF.md round 13 A/B).
+        from srtb_tpu.resilience.demote import ComputeHealer
+        self.healer = ComputeHealer.from_config(cfg, self._plan_factory)
+        if self.healer is not None:
+            self.healer.bind_base(getattr(self.processor, "staged",
+                                          None))
         # sink-side liveness heartbeat: bumped after every completed
         # per-sink push (not per drained item), so the engine's wedge
         # detectors see progress through a slow multi-sink flush
@@ -371,7 +395,8 @@ class Pipeline:
                 index, span, queue_depth, det_count, positive, n_samples,
                 timestamp_ns=getattr(seg, "timestamp", 0),
                 overlap_hidden_s=overlap_hidden_s,
-                inflight_depth=inflight_depth))
+                inflight_depth=inflight_depth,
+                active_plan=getattr(self.processor, "plan_name", None)))
 
     # ---------------------------------------------- async segment engine
 
@@ -402,6 +427,42 @@ class Pipeline:
                           "deferring to the blocking fetch")
                 return True
         return True
+
+    # ------------------------------------------- self-healing compute
+
+    def _plan_factory(self, cfg, staged):
+        """Build a replacement segment plan for the self-healing
+        ladder (a demotion rung, the promotion probe, or a device
+        reinit).  Mirrors the constructor-relevant state of the
+        CURRENT processor — donation policy and window — so the only
+        thing that changes is the plan itself; the rung's config
+        changes trace-relevant knobs, so ``plan_signature()`` differs
+        and any AOT cache (``cfg.aot_plan_path``, re-enabled by the
+        constructor) misses cleanly and re-lowers."""
+        from srtb_tpu.ops import window as W
+        from srtb_tpu.pipeline.segment import SegmentProcessor
+        return SegmentProcessor(
+            cfg,
+            window_name=getattr(self.processor, "_window_name",
+                                W.DEFAULT_WINDOW),
+            staged=staged,
+            donate_input=bool(getattr(self.processor, "_donate_input",
+                                      False)))
+
+    def _swap_processor(self, newp) -> None:
+        """Install a replacement plan (demotion / promotion / reinit).
+        The warm ingest-ring carry belongs to the OLD plan's programs
+        and carry-aval contract, so it is invalidated — the next
+        dispatch goes cold from its retained host buffer — and the
+        old processor is retired: its compiled handles (including any
+        in-memory AOT executables bound to a dead backend after a
+        reinit) raise loudly on any stray dispatch instead of running
+        stale."""
+        old, self.processor = self.processor, newp
+        self._ring_invalidate()
+        retire = getattr(old, "retire", None)
+        if retire is not None and old is not newp:
+            retire()
 
     # ------------------------------------------------- ingest ring state
 
@@ -990,21 +1051,131 @@ class Pipeline:
 
         # dispatch granularity: a micro-batch lands B segments at once,
         # so admission is gated on the whole unit fitting the window —
-        # in-flight depth never exceeds inflight_segments
-        unit = batch
+        # in-flight depth never exceeds inflight_segments.  The unit is
+        # DYNAMIC: the self-healing ladder's first rung drops the
+        # micro-batch, and the engine's admission/dispatch unit must
+        # follow the active plan (the demoted processor has no batch
+        # programs).
+        def cur_unit() -> int:
+            if self.healer is not None:
+                return min(window, self.healer.micro_batch)
+            return batch
 
         san = self.sanitizer
+
+        # ---- self-healing compute: the dispatch/fetch fault handlers.
+        # heal() is called ONLY from exception handlers — a healthy run
+        # never reaches any of this.
+
+        def reinit_and_redispatch(exc) -> bool:
+            """Device-halt recovery: every in-flight device buffer and
+            compiled handle on the halted backend is suspect.  Budget-
+            checked by the healer's device_reinit supervisor; on
+            approval: drop the jit/compile caches bound to the old
+            backend handle (jax.clear_caches), swap in a freshly built
+            processor at the current rung (no loaded AOT executables,
+            no warm state; the swap also invalidates the warm
+            ingest-ring carry, so the next warm-eligible dispatch goes
+            COLD instead of assembling against a dead device buffer),
+            then re-dispatch every in-flight segment cold from its
+            retained host buffer, in dispatch order — journal order
+            and checkpoint resume offsets are unchanged, exactly like
+            a watchdog requeue."""
+            h = self.healer
+            newp = h.reinit(exc)
+            if newp is None:
+                return False  # budget spent: escalate
+            try:
+                jax.clear_caches()
+            except Exception as e:  # version drift must not block
+                log.warning(f"[selfheal] jax.clear_caches failed "
+                            f"({e!r}); proceeding with the rebuild")
+            self._swap_processor(newp)
+            for i in range(len(pending)):
+                seg, _wf, _det, offset_after, span, _t0, idx = \
+                    pending[i]
+                pending[i] = dispatch_one(seg, span["ingest"],
+                                          offset_after, idx,
+                                          requeue=True)
+            return True
+
+        def heal(exc) -> bool:
+            """True when a device-classified fault was recovered (the
+            active processor may have been swapped).  False propagates
+            the ORIGINAL failure (not a device fault / healing off).
+            A spent budget raises the typed FATAL escalation instead —
+            the escaped exception must classify FATAL, not DEVICE, or
+            an outer supervisor would keep restarting a permanently
+            OOMing run."""
+            from srtb_tpu.resilience.errors import (LadderExhausted,
+                                                    ReinitBudgetExceeded)
+            h = self.healer
+            if h is None:
+                return False
+            kind = h.classify(exc)
+            if kind is None:
+                return False
+            if kind == DEVICE_HALT:
+                if reinit_and_redispatch(exc):
+                    return True
+                raise ReinitBudgetExceeded(
+                    "device halt beyond reinit recovery "
+                    "(device_reinit_max budget spent or disabled): "
+                    f"{exc}") from exc
+            newp = h.demote(exc, kind)
+            if newp is None:
+                raise LadderExhausted(
+                    f"device fault survived every demotion rung: "
+                    f"{exc}") from exc
+            self._swap_processor(newp)
+            return True
+
+        def dispatch_one(seg, ingest_s, offset_after, index,
+                         requeue=False):
+            """One segment dispatch with self-healing: a device-
+            classified failure demotes/reinits and re-dispatches the
+            SAME segment from its retained host buffer; anything else
+            propagates.  The replacement dispatch is carry-isolated
+            (``requeue=True``): the swap invalidated the ring, and a
+            re-dispatched segment must never warm-assemble."""
+            while True:
+                try:
+                    return self._dispatch_segment(seg, ingest_s,
+                                                  offset_after, index,
+                                                  requeue=requeue)
+                except BaseException as e:  # noqa: BLE001 — classified
+                    if not heal(e):
+                        raise
+                    requeue = True
+
+        def maybe_promote() -> None:
+            """Promotion probe: after promote_after_segments healthy
+            drains on a demoted plan, step one rung back up before
+            admitting the next segment — the next dispatch probes the
+            richer plan; a recurring fault demotes again via heal()."""
+            h = self.healer
+            if h is not None and h.promote_due():
+                newp = h.promote()
+                if newp is not None:
+                    self._swap_processor(newp)
 
         def fill_window() -> None:
             if san is not None:
                 # dispatch-window state (pending deque, dispatch
                 # counters) is owned by the run() thread
                 san.assert_owner("inflight_window")
-            while live_count() + unit <= window and want_more() \
+            while live_count() + cur_unit() <= window and want_more() \
                     and sink_alive():
-                if batch > 1:
-                    budget = batch if max_segments is None else \
-                        min(batch, max_segments - dispatched[0])
+                maybe_promote()
+                b = cur_unit()
+                if live_count() + b > window:
+                    # the promotion probe restored the micro-batch and
+                    # the bigger unit no longer fits: drain first (the
+                    # in-flight depth bound holds across promotions)
+                    return
+                if b > 1:
+                    budget = b if max_segments is None else \
+                        min(b, max_segments - dispatched[0])
                     got = []
                     while len(got) < budget:
                         one = ingest_one(dispatched[0] + len(got))
@@ -1014,12 +1185,26 @@ class Pipeline:
                     if not got:
                         return
                     segs, ingests, offsets = map(list, zip(*got))
-                    if len(segs) == batch:
-                        items = self._dispatch_micro_batch(
-                            segs, ingests, offsets, dispatched[0])
+                    if len(segs) == b:
+                        try:
+                            items = self._dispatch_micro_batch(
+                                segs, ingests, offsets, dispatched[0])
+                        except BaseException as e:  # noqa: BLE001
+                            if not heal(e):
+                                raise
+                            # the healed plan may no longer micro-
+                            # batch: finish these segments as single
+                            # cold dispatches (the tail path below
+                            # proves the single-segment plan is
+                            # result-compatible)
+                            items = [dispatch_one(s, dt, off,
+                                                  dispatched[0] + i,
+                                                  requeue=True)
+                                     for i, (s, dt, off)
+                                     in enumerate(got)]
                     else:  # tail shorter than B: single-segment plan
-                        items = [self._dispatch_segment(
-                                     s, dt, off, dispatched[0] + i)
+                        items = [dispatch_one(s, dt, off,
+                                              dispatched[0] + i)
                                  for i, (s, dt, off) in enumerate(got)]
                     pending.extend(items)
                     live_add(len(segs))
@@ -1030,9 +1215,9 @@ class Pipeline:
                     one = ingest_one(dispatched[0])
                     if one is None:
                         return
+                    seg, dt, off = one
                     pending.append(
-                        self._dispatch_segment(*one,
-                                               index=dispatched[0]))
+                        dispatch_one(seg, dt, off, dispatched[0]))
                     live_add(1)
                     dispatched[0] += 1
                     self.stats.segments += 1
@@ -1083,9 +1268,13 @@ class Pipeline:
                     # this segment cold + carry-isolated from its
                     # retained full host buffer (bit-identical)
                     self._ring_invalidate()
-                    item = self._dispatch_segment(
-                        seg, span["ingest"], offset_after, index,
-                        requeue=True)
+                    # healed re-dispatch: a requeue onto a faulty plan
+                    # (the wedge WAS an OOM in disguise, or the probe
+                    # plan broke) demotes and retries instead of
+                    # re-wedging through the whole requeue budget
+                    item = dispatch_one(seg, span["ingest"],
+                                        offset_after, index,
+                                        requeue=True)
                     pending[0] = item
                     waited_since = time.perf_counter()
                 else:
@@ -1106,7 +1295,26 @@ class Pipeline:
             depth = len(pending)
             live_now = live_count()
             item = pending.popleft()
-            return emit(self._fetch_inflight(item, depth, live_now))
+            while True:
+                try:
+                    fetched = self._fetch_inflight(item, depth,
+                                                   live_now)
+                    break
+                except BaseException as e:  # noqa: BLE001 — classified
+                    if not heal(e):
+                        raise
+                    # the faulted segment's device results died with
+                    # the fault: re-dispatch it cold from the retained
+                    # host buffer under the (possibly demoted /
+                    # reinitialized) plan, then fetch again
+                    seg, _wf, _det, offset_after, span, _t0, idx = item
+                    item = dispatch_one(seg, span["ingest"],
+                                        offset_after, idx,
+                                        requeue=True)
+            h = self.healer
+            if h is not None:
+                h.note_healthy()
+            return emit(fetched)
 
         # watchdog state for a fully-parked window: [since, progress
         # marker] — same per-sink-push progress rule as push_sink
@@ -1171,7 +1379,8 @@ class Pipeline:
                 # window too full to admit the next dispatch unit (or
                 # source done): block on the oldest — the in-order
                 # point where overlap is actually earned
-                if live_count() + unit > window or not want_more():
+                if live_count() + cur_unit() > window \
+                        or not want_more():
                     if not drain_oldest():
                         break
             while pending and sink_alive():
@@ -1540,11 +1749,41 @@ class ThreadedPipeline(Pipeline):
             return (seg, self.stage_timer.last["ingest"], count[0] - 1)
 
         def device_f(stop_token, item):
+            from srtb_tpu.resilience.errors import LadderExhausted
             seg, ingest_dt, index = item
+            h = self.healer
+            if h is not None and h.promote_due():
+                # promotion probe, same pacing as the async engine
+                # (note_healthy is bumped by the drain thread; an
+                # off-by-one-segment probe is acceptable pacing slack)
+                newp = h.promote()
+                if newp is not None:
+                    self._swap_processor(newp)
             with self._stage("dispatch"):
-                wf, det_res = self._op(
-                    "dispatch", index,
-                    lambda: self.processor.process(seg.data))
+                while True:
+                    try:
+                        wf, det_res = self._op(
+                            "dispatch", index,
+                            lambda: self.processor.process(seg.data))
+                        break
+                    except BaseException as e:  # noqa: BLE001
+                        # plan demotion works here exactly like the
+                        # async engine: rebuild cheaper, re-dispatch
+                        # the retained segment.  Device-HALT recovery
+                        # does not — results already queued on q_res
+                        # belong to the dead backend and this engine
+                        # has no retained in-flight window to
+                        # re-dispatch them from — so halts escalate
+                        # (use the async engine for reinit coverage).
+                        kind = h.classify(e) if h is not None else None
+                        if kind is None or kind == DEVICE_HALT:
+                            raise
+                        newp = h.demote(e, kind)
+                        if newp is None:
+                            raise LadderExhausted(
+                                "device fault survived every demotion "
+                                f"rung: {e}") from e
+                        self._swap_processor(newp)
             span = {"ingest": ingest_dt,
                     "dispatch": self.stage_timer.last["dispatch"]}
             self.stats.segments += 1
@@ -1559,9 +1798,12 @@ class ThreadedPipeline(Pipeline):
             drain_busy[0] = True
             index = item[-1]
             try:
-                return _drain_body(
-                    stop_token,
-                    self._fetch_device(item[:-1], index), index)
+                fetched = self._fetch_device(item[:-1], index)
+                if self.healer is not None:
+                    # healthy-segment pacing for the promotion probe
+                    # (consumed by device_f; an int bump under the GIL)
+                    self.healer.note_healthy()
+                return _drain_body(stop_token, fetched, index)
             finally:
                 drain_busy[0] = False
 

@@ -75,6 +75,52 @@ STAGED_MIN_N = 1 << 30
 FUSED_TAIL_DF64_MAX_SPECTRUM = 1 << 27
 
 
+# ---- pure-config plan-resolution predicates.  Single home shared by
+# the SegmentProcessor resolvers below AND the demotion ladder's
+# no-op-rung detection (resilience/demote.py): the ladder must skip a
+# rung exactly when the feature would not resolve ON, and a hand-
+# maintained mirror of these rules would silently drift.
+
+
+def ring_usable(cfg) -> bool:
+    """Whether overlap-save reserves a non-empty, byte-aligned tail
+    strictly smaller than the segment — the structural precondition of
+    the ingest ring, independent of the ``ingest_ring`` mode knob."""
+    from srtb_tpu.io import formats as _formats
+    fmt = _formats.resolve(cfg.baseband_format_type)
+    bits = abs(int(cfg.baseband_input_bits))
+    nres = int(dd.nsamps_reserved(cfg))
+    reserved = nres * bits // 8 * fmt.data_stream_count
+    seg = cfg.segment_bytes(fmt.data_stream_count)
+    return nres > 0 and (nres * bits) % 8 == 0 and 0 < reserved < seg
+
+
+def fused_tail_resolves(cfg, staged: bool) -> bool:
+    """Resolution of ``Config.fused_tail`` ("auto"/"on"/"off") for a
+    plan with the given resolved ``staged`` flag (see
+    SegmentProcessor._resolve_fused_tail for the rationale of each
+    branch).  Raises on "on" with a monolithic, non-staged plan."""
+    mode = str(getattr(cfg, "fused_tail", "auto")).lower()
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"fused_tail must be auto/on/off, got {mode!r}")
+    if mode == "off":
+        return False
+    n = int(cfg.baseband_input_count)
+    hostable = staged or F.resolve_strategy(
+        n, cfg.fft_strategy) != "monolithic"
+    if mode == "on":
+        if not hostable:
+            raise ValueError(
+                "fused_tail=on requires a non-monolithic "
+                "fft_strategy (the XLA R2C custom call cannot host "
+                "the RFI/chirp epilogue)")
+        return True
+    if not hostable:
+        return False
+    bankless = staged or getattr(cfg, "use_pallas", False)
+    return not (bankless and n // 2 > FUSED_TAIL_DF64_MAX_SPECTRUM)
+
+
 class SegmentProcessor:
     """Builds and owns the jitted per-segment device function plus its
     precomputed constants (chirp, window, RFI mask, normalization).
@@ -331,31 +377,14 @@ class SegmentProcessor:
         plan: the staged plan and every non-monolithic strategy end in
         the Hermitian post-process, which can host the RFI-s1 + chirp
         epilogue; the monolithic XLA R2C custom call cannot and stays
-        the unfused fallback under "auto"."""
-        mode = str(getattr(self.cfg, "fused_tail", "auto")).lower()
-        if mode not in ("auto", "on", "off"):
-            raise ValueError(
-                f"fused_tail must be auto/on/off, got {mode!r}")
-        if mode == "off":
-            return False
-        hostable = self.staged or F.resolve_strategy(
-            self.n, self.cfg.fft_strategy) != "monolithic"
-        if mode == "on":
-            if not hostable:
-                raise ValueError(
-                    "fused_tail=on requires a non-monolithic "
-                    "fft_strategy (the XLA R2C custom call cannot host "
-                    "the RFI/chirp epilogue)")
-            return True
-        if not hostable:
-            return False
-        # auto: bankless plans generate the chirp in-trace — gate on
-        # the proven size range (see FUSED_TAIL_DF64_MAX_SPECTRUM);
-        # "on" above overrides for the hardware experiments
-        bankless = self.staged or self.cfg.use_pallas
-        if bankless and self.n_spectrum > FUSED_TAIL_DF64_MAX_SPECTRUM:
-            return False
-        return True
+        the unfused fallback under "auto".  Under "auto", bankless
+        plans (staged / use_pallas, in-trace df64 chirp) additionally
+        gate on the proven size range
+        (FUSED_TAIL_DF64_MAX_SPECTRUM); "on" overrides for the
+        hardware experiments.  The rule itself lives in the module-
+        level :func:`fused_tail_resolves` (shared with the demotion
+        ladder)."""
+        return fused_tail_resolves(self.cfg, self.staged)
 
     def _resolve_ring(self) -> bool:
         """Resolve Config.ingest_ring ("auto"/"on"/"off") against the
@@ -369,10 +398,9 @@ class SegmentProcessor:
                 f"ingest_ring must be auto/on/off, got {mode!r}")
         if mode == "off":
             return False
-        bits = abs(self.cfg.baseband_input_bits)
-        usable = (self.nsamps_reserved > 0
-                  and (self.nsamps_reserved * bits) % 8 == 0
-                  and 0 < self.reserved_bytes < self._segment_bytes)
+        # the structural test is the shared module-level predicate
+        # (the demotion ladder consults the same rule)
+        usable = ring_usable(self.cfg)
         if mode == "on" and not usable:
             raise ValueError(
                 "ingest_ring=on requires overlap-save with a byte-"
@@ -1422,6 +1450,37 @@ class SegmentProcessor:
             # sanctioned holder  # srtb-lint: disable=use-after-donate
             S.expire_donated(raws, out)
         return out, next_carry
+
+    # ---------------------------------------- self-healing retirement
+
+    _RETIRED_PROGRAMS = (
+        "_jit_process", "_jit_process_batch", "_jit_stage_a",
+        "_jit_stage_b", "_jit_stage_c", "_jit_ring", "_jit_cold",
+        "_jit_stage_a_ring", "_jit_stage_a_cold", "_jit_batch_ring",
+        "_jit_batch_cold")
+
+    def retire(self) -> None:
+        """Disarm a processor the pipeline has replaced (plan demotion,
+        promotion probe, or device reinit — resilience/demote.py).
+
+        Every compiled-program handle is swapped for a loud failure:
+        after a device reinit the old handles (in-memory AOT
+        executables, jit caches) are bound to the dead backend, and a
+        stray dispatch through a stale reference must raise instead of
+        feeding a dead handle — or silently racing the replacement
+        plan.  Host-side state (the staging pool, retained buffers) is
+        left to the garbage collector: in-flight transfers may still
+        reference those buffers, and a fresh processor owns fresh
+        pools."""
+        def _dead(*_args, **_kwargs):
+            raise RuntimeError(
+                "SegmentProcessor retired (plan demotion / device "
+                "reinit replaced it) — dispatch through the "
+                "pipeline's active processor")
+        for name in self._RETIRED_PROGRAMS:
+            if getattr(self, name, None) is not None:
+                setattr(self, name, _dead)
+        self.aot_active = False
 
     @property
     def data_stream_count(self) -> int:

@@ -6,11 +6,13 @@ loss and slow consumers, and streamed GPU pipelines treat continuity
 under stalls as a first-class design constraint (PAPERS.md:
 arXiv:2101.00941 CUDA-streams AstroAccelerate; arXiv:1806.01556
 always-on FPGA modules).  This package gives the srtb_tpu runtime the
-same property, in five composable pieces:
+same property, in six composable pieces:
 
 - :mod:`errors` — the typed taxonomy every other piece dispatches on:
-  *transient* (retryable), *fatal* (escalate to clean shutdown), and
-  *data-loss* (retryable, but the occurrence is accounted);
+  *transient* (retryable), *fatal* (escalate to clean shutdown),
+  *data-loss* (retryable, but the occurrence is accounted), and
+  *device* (a compute-side OOM / compile failure / device halt —
+  never retried verbatim, handed to the self-healing ladder);
 - :mod:`retry` — configurable retry with exponential backoff,
   deterministic jitter and deadlines, applied by the pipeline to
   ingest reads, H2D staging, dispatch, fetch, sink writes, and
@@ -22,13 +24,21 @@ same property, in five composable pieces:
   sink backlog or accounted loss, shed waterfall dumps first, then
   baseband dumps, then whole segments (the existing
   ``DropOldestSegmentBuffer``), every step counted;
+- :mod:`demote` — self-healing compute: the plan-demotion ladder
+  (micro_batch -> ring -> skzap -> fused_tail -> staged -> monolithic)
+  that survives device OOM and compile faults on a cheaper plan, and
+  bounded device-reinit recovery for halt faults — the compute-side
+  twin of the supervisor;
 - :mod:`faults` — deterministic fault injection (``Config.fault_plan``)
-  arming named sites to raise/stall/corrupt on scheduled segment
-  indices, zero-cost when off (the same None-hook pattern as the
-  runtime sanitizer), so every recovery path above is testable on CPU
-  CI.
+  arming named sites to raise/stall/corrupt — or fail like the
+  accelerator runtime (oom / compile_fail / device_halt, with the real
+  jax exception strings) — on scheduled segment indices, zero-cost
+  when off (the same None-hook pattern as the runtime sanitizer), so
+  every recovery path above is testable on CPU CI
+  (``tools/chaos_soak.py`` composes them into randomized soaks).
 
-Everything is surfaced: retries, requeues, restarts, shed dumps and
-the degradation level are Prometheus counters/gauges and journal
-fields (telemetry schema v3).
+Everything is surfaced: retries, requeues, restarts, shed dumps, the
+degradation level, plan demotions/promotions, device reinits and the
+active-plan ladder level are Prometheus counters/gauges and journal
+fields (telemetry schema v4).
 """

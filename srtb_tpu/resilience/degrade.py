@@ -34,8 +34,16 @@ stop, because un-degrading while segments are still being dropped
 would trade science data for diagnostics.  Hysteresis (``hold``
 consecutive observations above ``high`` / below ``low``) keeps one
 slow flush from thrashing the ladder.  Every transition and every shed dump is a
-Prometheus counter and a v3 journal field — graceful degradation that
-is not accounted is just silent loss with better marketing.
+Prometheus counter and a journal field (schema v3) — graceful
+degradation that is not accounted is just silent loss with better
+marketing.
+
+This ladder is the SINK-side twin of the compute-side plan-demotion
+ladder (resilience/demote.py): the two are independent state machines
+over independent signals (sink backlog/loss here, device faults
+there) and compose freely — a run can be shedding waterfalls at
+degrade level 1 while computing on a demoted plan, and each journals
+its own level (``degrade_level`` vs ``plan_ladder_level``).
 """
 
 from __future__ import annotations

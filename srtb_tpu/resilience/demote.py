@@ -1,0 +1,373 @@
+"""Self-healing compute: the plan-demotion ladder and device reinit.
+
+PR 4's supervisor hardened the *host* side (sinks, watchdog,
+degradation); a compute-side failure — an XLA ``RESOURCE_EXHAUSTED``,
+a Mosaic compile error, a halted device — still killed the stream even
+though the repo has everything needed to recover: 20 audited plan
+families (plan_cards.json), retained host buffers that re-dispatch any
+segment cold and bit-identically, and checkpoint resume.  This module
+closes that gap with two mechanisms, both driven by the typed
+device-fault classification in :mod:`srtb_tpu.resilience.errors`:
+
+**Plan demotion** (oom / compile faults).  The ladder is an ordered
+list of progressively cheaper execution plans derived from the active
+config by switching off features in a fixed order::
+
+    micro_batch -> ring -> skzap -> fused_tail -> staged -> monolithic
+
+Each rung is CUMULATIVE (rung k applies every earlier step too) and
+rungs that would not change the active config are skipped, so the
+ladder a given run walks contains only real alternatives.  On a
+device fault at a dispatch/fetch site the engine demotes one rung,
+rebuilds the :class:`SegmentProcessor` from the rung's config (the
+rung changes trace-relevant knobs, so ``plan_signature()`` differs and
+any AOT cache misses cleanly and re-lowers), and re-dispatches the
+faulted segment COLD from its already-retained host buffer — the same
+recovery path the watchdog requeue proved bit-identical.  The rung
+order mirrors cost/fragility: the micro-batch multiplies the program's
+footprint by B; the ring adds the carry programs; skzap and the fused
+tail are the Pallas-heavy fusions (the likeliest Mosaic compile
+surface); the staged plan trades one big program for three small ones
+(each program's temporaries freed before the next — the proven answer
+to chain OOM at 2^30); monolithic is the minimal-feature floor that
+must run anywhere XLA runs.  Every demotion-ladder target must
+resolve to a plan family already carded in ``plan_cards.json``
+(``analysis/hlo_audit.audit_ladder``, gated in ci.sh): the run never
+demotes into an unaudited plan.
+
+**Device reinit** (halt faults).  A halted backend invalidates every
+in-flight device buffer and compiled-executable handle.  Recovery:
+drop all in-flight device state, ``jax.clear_caches()``, rebuild the
+processor at the CURRENT rung (a fresh processor holds no loaded AOT
+executables or jit caches bound to the dead backend handle, and the
+engine separately invalidates the warm ingest-ring carry), then
+re-dispatch every in-flight segment cold from its retained host
+buffer — in dispatch order, so journal order and checkpoint resume
+offsets are unchanged.  Reinits are budgeted by the same
+bounded-restart supervisor the sink pipe uses (``device_reinit_max``
+within ``device_reinit_window_s``): a flapping device escalates to a
+clean shutdown instead of flapping forever.
+
+**Promotion probe.**  With ``promote_after_segments = N > 0``, N
+consecutively healthy drained segments promote one rung back up; the
+next dispatch probes the richer plan, and if the fault recurs the
+engine simply demotes again (each further promotion needs another N
+healthy segments, so a persistent fault settles at the highest rung
+that works).  0 (default) sticks with the demoted plan for the rest
+of the run.
+
+Every transition is accounted: ``plan_demotions`` /
+``plan_promotions`` / ``device_reinits`` counters, the
+``plan_ladder_level`` gauge, and the v4 journal's ``active_plan``
+field (utils/telemetry.py) — a run that quietly survives on the
+monolithic floor must be visible on /metrics.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from srtb_tpu.resilience.errors import classify_device
+from srtb_tpu.resilience.supervisor import Supervisor
+from srtb_tpu.utils.logging import log
+from srtb_tpu.utils.metrics import metrics
+
+# canonical rung order, cheapest-to-drop first (see module docstring)
+LADDER_ORDER = ("micro_batch", "ring", "skzap", "fused_tail", "staged",
+                "monolithic")
+
+
+@dataclass(frozen=True)
+class Rung:
+    """One demotion target: the step that produced it, the demoted
+    config, and the explicit ``staged`` constructor override (None =
+    let the processor resolve from the segment size)."""
+
+    step: str
+    cfg: object
+    staged: bool | None
+
+    @property
+    def name(self) -> str:
+        return self.step
+
+
+def _resolved_staged(cfg, staged: bool | None) -> bool:
+    if staged is not None:
+        return staged
+    from srtb_tpu.pipeline.segment import STAGED_MIN_N
+    return int(getattr(cfg, "baseband_input_count", 0) or 0) \
+        >= STAGED_MIN_N
+
+
+def _ring_usable(cfg) -> bool:
+    """Whether the ingest ring can resolve ON for ``cfg`` (a rung
+    that demotes an already-off ring would burn a ladder level
+    changing nothing).  The structural rule is the SegmentProcessor's
+    own shared predicate — no mirror to drift."""
+    if str(getattr(cfg, "ingest_ring", "auto")).lower() == "off":
+        return False
+    from srtb_tpu.pipeline.segment import ring_usable
+    return ring_usable(cfg)
+
+
+def _resolves_fused_tail(cfg, staged: bool | None) -> bool:
+    """Whether ``fused_tail`` resolves ON for the (resolved) plan —
+    the SegmentProcessor's own shared predicate, so the fused_tail
+    rung is skipped exactly when the active plan already runs the
+    unfused chain (e.g. "auto" on a monolithic strategy)."""
+    from srtb_tpu.pipeline.segment import fused_tail_resolves
+    return fused_tail_resolves(cfg, _resolved_staged(cfg, staged))
+
+
+def _apply_step(cfg, step: str, staged: bool | None):
+    """(new_cfg, new_staged) after one ladder step, or None when the
+    step would not change the active RESOLVED plan (skipped rung —
+    demoting onto an identical plan would burn a ladder level while
+    recovering nothing)."""
+    if step == "micro_batch":
+        if int(getattr(cfg, "micro_batch_segments", 1) or 1) <= 1:
+            return None
+        return cfg.replace(micro_batch_segments=1), staged
+    if step == "ring":
+        if not _ring_usable(cfg):
+            return None
+        return cfg.replace(ingest_ring="off"), staged
+    if step == "skzap":
+        if not (getattr(cfg, "use_pallas_sk", False)
+                and getattr(cfg, "use_pallas", False)):
+            return None
+        return cfg.replace(use_pallas_sk=False), staged
+    if step == "fused_tail":
+        # drops the fused epilogue AND the Pallas kernels hosting it:
+        # this rung is the Mosaic-free fallback, so a kernel compile
+        # fault cannot survive it
+        if not (_resolves_fused_tail(cfg, staged)
+                or getattr(cfg, "use_pallas", False)):
+            return None
+        return cfg.replace(fused_tail="off", use_pallas=False), staged
+    if step == "staged":
+        if _resolved_staged(cfg, staged):
+            return None
+        # staged forbids micro-batching; force it off even when an
+        # explicit plan_ladder subset skipped the micro_batch rung
+        if int(getattr(cfg, "micro_batch_segments", 1) or 1) > 1:
+            cfg = cfg.replace(micro_batch_segments=1)
+        return cfg, True
+    if step == "monolithic":
+        from srtb_tpu.ops import fft as F
+        n = int(getattr(cfg, "baseband_input_count", 0) or 0)
+        already = (not _resolved_staged(cfg, staged) and n > 0
+                   and F.resolve_strategy(
+                       n, getattr(cfg, "fft_strategy", "auto"))
+                   == "monolithic")
+        if already:
+            return None
+        return cfg.replace(fft_strategy="monolithic"), False
+    raise ValueError(f"unknown ladder step {step!r} "
+                     f"(steps: {', '.join(LADDER_ORDER)})")
+
+
+def parse_ladder(text: str) -> tuple[str, ...]:
+    """``Config.plan_ladder`` -> ordered step tuple.  "auto" is the
+    full canonical order; an explicit comma list selects a subset (in
+    the given order); unknown step names raise at startup — a ladder
+    with a typo must fail loudly, not silently never demote."""
+    text = (text or "auto").strip().lower()
+    if text in ("auto", ""):
+        return LADDER_ORDER
+    if text == "off":
+        return ()
+    steps = tuple(s.strip() for s in text.split(",") if s.strip())
+    for s in steps:
+        if s not in LADDER_ORDER:
+            raise ValueError(
+                f"plan_ladder step {s!r} unknown "
+                f"(steps: {', '.join(LADDER_ORDER)}, or auto/off)")
+    return steps
+
+
+def ladder_rungs(cfg, base_staged: bool | None = None,
+                 steps: tuple[str, ...] = LADDER_ORDER) -> list[Rung]:
+    """The demotion rungs reachable from ``cfg``: cumulative configs in
+    ladder order, no-op steps skipped.  ``base_staged`` is the CURRENT
+    processor's resolved staged flag (so a run already on the staged
+    plan skips that rung)."""
+    rungs: list[Rung] = []
+    cur, staged = cfg, base_staged
+    for step in steps:
+        out = _apply_step(cur, step, staged)
+        if out is None:
+            continue
+        cur, staged = out
+        rungs.append(Rung(step, cur, staged))
+    return rungs
+
+
+class ComputeHealer:
+    """Per-run self-healing state machine: ladder position, promotion
+    counter, and the reinit budget.  Owned by the Pipeline; the engine
+    calls :meth:`classify` on any dispatch/fetch failure and then one
+    of :meth:`demote` / :meth:`reinit`, swapping in the processor each
+    returns.  ``factory(cfg, staged)`` builds the replacement
+    processor (the pipeline's hook, overridable in tests).
+
+    Zero-cost when healthy: the engine consults this object only from
+    exception handlers and one counter bump per drained segment."""
+
+    def __init__(self, cfg, factory, steps: tuple[str, ...] = None,
+                 base_staged: bool | None = None,
+                 promote_after: int = 0, reinit_max: int = 0,
+                 reinit_window_s: float = 300.0):
+        if steps is None:
+            steps = parse_ladder(getattr(cfg, "plan_ladder", "auto"))
+        self._cfg = cfg
+        self._factory = factory
+        self._steps = steps
+        self._rungs = ladder_rungs(cfg, base_staged, steps)
+        self._base_staged = base_staged
+        self._level = 0  # 0 = the configured (full) plan
+        self._healthy = 0
+        self.promote_after = int(promote_after)
+        self._reinit = None
+        if int(reinit_max) > 0:
+            # counter=None: reinits are accounted under their OWN
+            # device_reinits counter (in reinit()); riding the default
+            # worker_restarts would journal phantom worker restarts
+            self._reinit = Supervisor(
+                "device_reinit", max_restarts=int(reinit_max),
+                window_s=float(reinit_window_s), counter=None)
+        metrics.set("plan_ladder_level", 0)
+
+    @classmethod
+    def from_config(cls, cfg, factory) -> "ComputeHealer | None":
+        """None (zero-cost off) when both mechanisms are disabled:
+        ``plan_ladder = off`` AND ``device_reinit_max = 0``."""
+        steps = parse_ladder(getattr(cfg, "plan_ladder", "auto"))
+        reinit_max = int(getattr(cfg, "device_reinit_max", 0) or 0)
+        if not steps and reinit_max <= 0:
+            return None
+        return cls(
+            cfg, factory, steps=steps,
+            promote_after=int(getattr(cfg, "promote_after_segments",
+                                      0) or 0),
+            reinit_max=reinit_max,
+            reinit_window_s=float(getattr(cfg, "device_reinit_window_s",
+                                          300.0)))
+
+    # ------------------------------------------------------- state
+
+    @property
+    def level(self) -> int:
+        return self._level
+
+    @property
+    def rungs(self) -> list[Rung]:
+        return list(self._rungs)
+
+    @property
+    def active_cfg(self):
+        """The config of the active rung (the base config at level 0)."""
+        if self._level == 0:
+            return self._cfg
+        return self._rungs[self._level - 1].cfg
+
+    @property
+    def active_step(self) -> str:
+        return "full" if self._level == 0 \
+            else self._rungs[self._level - 1].step
+
+    @property
+    def micro_batch(self) -> int:
+        """Micro-batch size of the ACTIVE plan — the engine's dispatch
+        unit must follow demotions (the micro_batch rung drops it to
+        1, and the demoted processor has no batch programs)."""
+        return max(1, int(getattr(self.active_cfg,
+                                  "micro_batch_segments", 1) or 1))
+
+    def bind_base(self, base_staged: bool | None) -> None:
+        """Late-bind the resolved staged flag of the pipeline's actual
+        processor (the healer is built before the processor resolves
+        on a custom-processor pipeline) and rebuild the rungs."""
+        if base_staged != self._base_staged:
+            self._base_staged = base_staged
+            self._rungs = ladder_rungs(self._cfg, base_staged,
+                                       self._steps)
+
+    # -------------------------------------------------- transitions
+
+    def classify(self, exc: BaseException) -> str | None:
+        """Device-fault kind of ``exc`` (None = not a device fault).
+        Deliberately NOT filtered by remaining budget: the engine must
+        learn the kind even when nothing is left, so it can raise the
+        typed FATAL escalation (LadderExhausted /
+        ReinitBudgetExceeded) instead of letting a DEVICE-classified
+        exception escape — an outer supervisor would restart on
+        DEVICE, and a permanently OOMing run must escalate, not
+        flap."""
+        return classify_device(exc)
+
+    def _build(self, rung_level: int):
+        if rung_level == 0:
+            return self._factory(self._cfg, self._base_staged)
+        rung = self._rungs[rung_level - 1]
+        return self._factory(rung.cfg, rung.staged)
+
+    def demote(self, exc: BaseException, kind: str):
+        """One rung down: returns the replacement processor, or None
+        when the ladder is exhausted (the engine then escalates).
+        Every demotion resets the promotion counter."""
+        if self._level >= len(self._rungs):
+            return None
+        self._level += 1
+        self._healthy = 0
+        rung = self._rungs[self._level - 1]
+        metrics.add("plan_demotions")
+        metrics.set("plan_ladder_level", self._level)
+        log.warning(
+            f"[selfheal] device fault ({kind}) — demoting to ladder "
+            f"rung {self._level}/{len(self._rungs)} ({rung.step}): "
+            f"{exc!r}")
+        return self._build(self._level)
+
+    def reinit(self, exc: BaseException):
+        """Backend reinit at the current rung: returns the fresh
+        processor, or None when the reinit budget is spent within the
+        window (the engine then escalates — a flapping device must
+        not flap forever).  The caller owns the surrounding teardown
+        (jax.clear_caches, ring invalidation, pending re-dispatch)."""
+        if self._reinit is None or \
+                not self._reinit.should_restart(exc):
+            return None
+        metrics.add("device_reinits")
+        log.warning(
+            f"[selfheal] device halt — reinitializing backend at "
+            f"ladder rung {self._level} ({self.active_step}): {exc!r}")
+        return self._build(self._level)
+
+    # --------------------------------------------- promotion probe
+
+    def note_healthy(self) -> None:
+        """One successfully fetched segment on a demoted plan."""
+        if self._level > 0 and self.promote_after > 0:
+            self._healthy += 1
+
+    def promote_due(self) -> bool:
+        return (self._level > 0 and self.promote_after > 0
+                and self._healthy >= self.promote_after)
+
+    def promote(self):
+        """One rung back up (the promotion probe): returns the richer
+        processor; the NEXT dispatch probes it and a recurring fault
+        simply demotes again."""
+        if self._level <= 0:
+            return None
+        self._level -= 1
+        self._healthy = 0
+        metrics.add("plan_promotions")
+        metrics.set("plan_ladder_level", self._level)
+        log.info(
+            f"[selfheal] {self.promote_after} healthy segments — "
+            f"promotion probe back to rung {self._level} "
+            f"({self.active_step})")
+        return self._build(self._level)

@@ -10,13 +10,33 @@ Every recovery decision in this package dispatches on one question:
   or corrupted on the way (a torn packet block, a corrupted buffer).
   Retried like a transient, and additionally accounted in the
   ``data_loss_total`` counter: loss must never be silent.
-- ``FATAL`` — retrying cannot help (programming errors, resource
-  exhaustion, explicit escalations).  Propagates to a clean shutdown.
+- ``FATAL`` — retrying cannot help (programming errors, explicit
+  escalations).  Propagates to a clean shutdown.
+- ``DEVICE`` — the *accelerator side* failed in a way plain retry
+  cannot fix but the self-healing compute ladder can: an XLA
+  ``RESOURCE_EXHAUSTED``/OOM (re-running the identical program OOMs
+  identically — a cheaper plan may not), a Pallas/Mosaic or XLA
+  compile/lowering failure (same program recompiles to the same
+  failure — a different plan family lowers differently), or a
+  device halt mid-run (nothing dispatched to the dead handle can
+  succeed — a reinitialized backend can).  Never retried by
+  :mod:`srtb_tpu.resilience.retry`; handled by the plan-demotion /
+  device-reinit machinery in :mod:`srtb_tpu.resilience.demote` and
+  ``pipeline/runtime.py``, which escalates to FATAL when its budget
+  (ladder rungs, ``device_reinit_max``) is spent.
 
 Unknown exceptions default to FATAL: retrying an unclassified failure
 hides bugs, and the reference's fail-loudly philosophy
 (ref: util/termination_handler.hpp) applies whenever we cannot argue
 the retry is safe.
+
+Device-fault *kind* classification (:func:`classify_device`) works
+from the real exception strings jax raises — ``XlaRuntimeError``
+status prefixes (``RESOURCE_EXHAUSTED:``, ``INTERNAL: Mosaic
+failed...``, ``INTERNAL: Accelerator device halted...``) — because the
+runtime's failures arrive as opaque ``jaxlib`` types, not as anything
+this package can subclass.  Typed :class:`DeviceFault` subclasses
+exist for code that *knows* what happened (fault injection, tests).
 """
 
 from __future__ import annotations
@@ -26,6 +46,14 @@ import errno
 TRANSIENT = "transient"
 FATAL = "fatal"
 DATA_LOSS = "data_loss"
+DEVICE = "device"
+
+# device-fault kinds, ordered from cheapest recovery to heaviest:
+# oom/compile demote the plan, halt reinitializes the backend
+DEVICE_OOM = "oom"
+DEVICE_COMPILE = "compile"
+DEVICE_HALT = "halt"
+DEVICE_KINDS = (DEVICE_OOM, DEVICE_COMPILE, DEVICE_HALT)
 
 
 class PipelineError(Exception):
@@ -68,6 +96,46 @@ class RestartBudgetExceeded(FatalError):
     allows within the window."""
 
 
+class DeviceFault(PipelineError):
+    """A compute-side failure the self-healing ladder may recover:
+    ``kind`` is one of :data:`DEVICE_KINDS` and selects the recovery
+    (demote for oom/compile, reinit for halt)."""
+
+    category = DEVICE
+    kind = DEVICE_HALT
+
+
+class DeviceOOM(DeviceFault):
+    """XLA ``RESOURCE_EXHAUSTED``: the plan's HBM footprint does not
+    fit — re-running it verbatim OOMs again; a demoted plan may fit."""
+
+    kind = DEVICE_OOM
+
+
+class CompileFault(DeviceFault):
+    """A compile/lowering failure (Mosaic, XLA): deterministic for the
+    same program, so the recovery is a different plan family."""
+
+    kind = DEVICE_COMPILE
+
+
+class DeviceHalt(DeviceFault):
+    """The device halted / the runtime handle died mid-run: every
+    in-flight program is suspect; recovery is a backend reinit."""
+
+    kind = DEVICE_HALT
+
+
+class LadderExhausted(FatalError):
+    """A device fault persisted through every demotion rung."""
+
+
+class ReinitBudgetExceeded(FatalError):
+    """The device kept halting past ``device_reinit_max`` reinits in
+    the window — a flapping accelerator escalates, never flaps
+    forever."""
+
+
 # errnos that indicate a momentary condition, not a broken system
 _TRANSIENT_ERRNOS = frozenset(
     e for e in (
@@ -78,16 +146,91 @@ _TRANSIENT_ERRNOS = frozenset(
     if e is not None)
 
 
+# --- device-fault classification from the strings jax actually raises.
+# Matching is gated on the exception TYPE being XLA-runtime-shaped
+# (see _is_xla_exception): "RESOURCE_EXHAUSTED" inside a ValueError
+# from user code must stay FATAL, not turn into a plan demotion.
+
+# RESOURCE_EXHAUSTED status + the allocator phrasings of the CPU/GPU/
+# TPU backends ("Out of memory while trying to allocate ...",
+# "Program hbm requirement ... exceeds HBM capacity")
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory",
+                "out of memory", "exceeds HBM capacity",
+                "Attempting to allocate")
+# Mosaic/XLA compile + lowering failures ("INTERNAL: Mosaic failed to
+# compile TPU kernel", "Compilation failure", UNIMPLEMENTED lowerings)
+_COMPILE_MARKERS = ("Mosaic failed", "Compilation failure",
+                    "compilation failed", "failed to compile",
+                    "Failed to lower", "lowering failed",
+                    "UNIMPLEMENTED", "Unsupported HLO")
+# mid-run death of the device / runtime handle ("INTERNAL: Accelerator
+# device halted prematurely...", aborted streams, dead executables)
+_HALT_MARKERS = ("device halted", "halted prematurely", "ABORTED",
+                 "DATA_LOSS", "Device or handle", "device is in an",
+                 "failed to enqueue", "Stream is in an error state",
+                 "executable has been deleted", "backend was destroyed")
+
+# exception type names that ARE compile failures wherever they appear
+# (jax raises these from its own lowering paths, no status prefix)
+_COMPILE_TYPE_NAMES = ("MosaicError", "LoweringError",
+                       "XlaCompileError", "VerificationError")
+
+
+def _is_xla_exception(exc: BaseException) -> bool:
+    """Whether ``exc`` is the accelerator runtime speaking: jaxlib's
+    ``XlaRuntimeError`` (matched by name — the concrete class moved
+    between jaxlib releases) or any exception raised from jax/jaxlib
+    internals."""
+    for klass in type(exc).__mro__:
+        if klass.__name__ == "XlaRuntimeError":
+            return True
+        mod = getattr(klass, "__module__", "") or ""
+        if mod.startswith(("jaxlib", "jax.")) or mod == "jax":
+            return True
+    return False
+
+
+def classify_device(exc: BaseException) -> str | None:
+    """Device-fault kind of ``exc`` (:data:`DEVICE_KINDS`), or None
+    when it is not a device fault.  Typed :class:`DeviceFault`
+    subclasses carry their kind; real jax/jaxlib exceptions are
+    classified from their status strings (OOM checked first: a TPU OOM
+    message can mention compilation context, but RESOURCE_EXHAUSTED is
+    the authoritative status)."""
+    if isinstance(exc, DeviceFault):
+        return exc.kind
+    if isinstance(exc, PipelineError):
+        return None  # other typed errors already chose their category
+    name = type(exc).__name__
+    if any(t in name for t in _COMPILE_TYPE_NAMES):
+        return DEVICE_COMPILE
+    if not _is_xla_exception(exc):
+        return None
+    msg = str(exc)
+    if any(m in msg for m in _OOM_MARKERS):
+        return DEVICE_OOM
+    if any(m in msg for m in _COMPILE_MARKERS):
+        return DEVICE_COMPILE
+    if any(m in msg for m in _HALT_MARKERS):
+        return DEVICE_HALT
+    return None
+
+
 def classify(exc: BaseException) -> str:
     """Map any exception to a taxonomy category.
 
     Typed :class:`PipelineError` subclasses carry their own category;
-    the stdlib's momentary-condition types (timeouts, interrupted
-    syscalls, connection churn) are transient; everything else —
-    including plain programming errors — is FATAL, because retrying an
-    unclassified failure hides bugs instead of surviving faults."""
+    accelerator-runtime failures with a recognized device-fault
+    signature are DEVICE (handled by the self-healing ladder, not
+    retried); the stdlib's momentary-condition types (timeouts,
+    interrupted syscalls, connection churn) are transient; everything
+    else — including plain programming errors and unrecognized XLA
+    errors — is FATAL, because retrying an unclassified failure hides
+    bugs instead of surviving faults."""
     if isinstance(exc, PipelineError):
         return exc.category
+    if classify_device(exc) is not None:
+        return DEVICE
     if isinstance(exc, (TimeoutError, InterruptedError,
                         BlockingIOError, ConnectionError)):
         return TRANSIENT

@@ -16,9 +16,15 @@ Plan syntax (comma-separated entries)::
 - ``action``  ``raise`` (transient :class:`InjectedFault`),
               ``fatal`` (:class:`InjectedFatal`, escalates),
               ``corrupt`` (:class:`InjectedCorruption`, a data-loss
-              fault: retried AND accounted), or
+              fault: retried AND accounted),
               ``stall=SECONDS`` (sleeps — long enough trips the
-              segment watchdog);
+              segment watchdog), or a device-fault action ``oom`` |
+              ``compile_fail`` | ``device_halt`` (raises an exception
+              whose TYPE NAME and MESSAGE mimic the real jaxlib
+              ``XlaRuntimeError`` strings, so the self-healing
+              ladder's string classifier — not a typed shortcut — is
+              what recovers the run, the same code path a real TPU
+              fault takes);
 - ``index``   the segment index the fault fires on — dispatch-order
               within the run, 0-based, the SAME space at every site
               (a resumed run's journal numbering continues from the
@@ -45,7 +51,11 @@ from srtb_tpu.utils.metrics import metrics
 
 SITES = ("ingest", "h2d", "dispatch", "fetch", "sink_write",
          "checkpoint")
-ACTIONS = ("raise", "fatal", "corrupt", "stall")
+DEVICE_ACTIONS = ("oom", "compile_fail", "device_halt")
+ACTIONS = ("raise", "fatal", "corrupt", "stall") + DEVICE_ACTIONS
+# device faults only make sense where device work happens: staging,
+# program dispatch, result fetch
+DEVICE_SITES = ("h2d", "dispatch", "fetch")
 
 
 class InjectedFault(TransientError):
@@ -58,6 +68,32 @@ class InjectedFatal(FatalError):
 
 class InjectedCorruption(DataLossError):
     """A scheduled data-loss fault."""
+
+
+class _InjectedXlaError(Exception):
+    """Stand-in for jaxlib's ``XlaRuntimeError`` (which cannot be
+    constructed portably across jaxlib releases).  The classifier in
+    resilience/errors.py matches the TYPE NAME plus the status string,
+    so renaming this class makes the injected fault travel the exact
+    recognition path a real accelerator fault takes — no typed
+    shortcut, the string classifier is what the test proves."""
+
+
+_InjectedXlaError.__name__ = "XlaRuntimeError"
+_InjectedXlaError.__qualname__ = "XlaRuntimeError"
+
+# messages copied from the shapes jax actually raises (v5e / CPU
+# allocator / Mosaic), with an [injected] tag so a log reader is never
+# fooled into debugging phantom hardware
+_DEVICE_MESSAGES = {
+    "oom": ("RESOURCE_EXHAUSTED: Out of memory while trying to "
+            "allocate 68719476736 bytes. [injected fault at {spec}]"),
+    "compile_fail": ("INTERNAL: Mosaic failed to compile TPU kernel: "
+                     "injected compile fault at {spec}"),
+    "device_halt": ("INTERNAL: Accelerator device halted prematurely, "
+                    "perhaps due to an on-device check-failure. "
+                    "[injected fault at {spec}]"),
+}
 
 
 @dataclass
@@ -106,6 +142,11 @@ def parse_plan(text: str) -> list[FaultSpec]:
         if action == "stall" and arg <= 0:
             raise ValueError(f"fault_plan entry {entry!r}: stall needs "
                              "a positive duration (stall=SECONDS)")
+        if action in DEVICE_ACTIONS and site not in DEVICE_SITES:
+            raise ValueError(
+                f"fault_plan entry {entry!r}: device-fault action "
+                f"{action!r} only fires at a device site "
+                f"({', '.join(DEVICE_SITES)})")
         specs.append(FaultSpec(site, action, index, arg))
     return specs
 
@@ -152,6 +193,9 @@ class FaultInjector:
             raise InjectedFatal(f"injected fatal fault at {spec}")
         if spec.action == "corrupt":
             raise InjectedCorruption(f"injected corruption at {spec}")
+        if spec.action in DEVICE_ACTIONS:
+            raise _InjectedXlaError(
+                _DEVICE_MESSAGES[spec.action].format(spec=spec))
         raise InjectedFault(f"injected transient fault at {spec}")
 
     def unfired(self) -> list[FaultSpec]:

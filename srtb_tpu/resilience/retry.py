@@ -4,7 +4,10 @@ Applied by the pipeline to its six fault sites (ingest, h2d, dispatch,
 fetch, sink_write, checkpoint).  Only failures classified TRANSIENT or
 DATA_LOSS by :func:`srtb_tpu.resilience.errors.classify` are retried;
 FATAL failures and exhausted budgets propagate, which is how a retry
-escalates to the supervisor / clean shutdown.
+escalates to the supervisor / clean shutdown, and DEVICE failures
+propagate un-retried to the self-healing compute ladder
+(resilience/demote.py) — the recovery for an OOM or compile fault is a
+cheaper plan, not the same program again.
 
 Jitter is *deterministic* (a hash of site and attempt, not
 ``random``): a replayed run with a fault plan backs off identically,
@@ -20,7 +23,7 @@ import time
 import zlib
 from dataclasses import dataclass
 
-from srtb_tpu.resilience.errors import DATA_LOSS, FATAL, classify
+from srtb_tpu.resilience.errors import DATA_LOSS, TRANSIENT, classify
 from srtb_tpu.utils.logging import log
 from srtb_tpu.utils.metrics import metrics
 
@@ -78,7 +81,11 @@ def retry_call(fn, policy: RetryPolicy, site: str, sleep=time.sleep):
     attempt = 1
     while True:
         cat = classify(exc)
-        if cat == FATAL:
+        if cat not in (TRANSIENT, DATA_LOSS):
+            # FATAL escalates; DEVICE propagates to the self-healing
+            # ladder (pipeline/runtime.py): re-running an OOMing or
+            # uncompilable program verbatim fails verbatim — the
+            # recovery is a different plan, not a retry
             raise exc
         if cat == DATA_LOSS:
             # the retry may succeed, but the loss itself happened

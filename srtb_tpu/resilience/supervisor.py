@@ -7,7 +7,11 @@ observation.  A :class:`Supervisor` gives each supervised component a
 restart budget: crashes classified transient (or data-loss) are
 restarted while the budget inside the sliding window lasts; fatal
 crashes and exhausted budgets escalate to the clean-shutdown path the
-runtime already has.
+runtime already has.  The same budget machinery bounds the
+self-healing compute ladder's device reinits (resilience/demote.py:
+the "device_reinit" supervisor — device-classified faults are not
+FATAL, so they restart within budget like transients): a flapping
+accelerator escalates exactly like a flapping sink pipe.
 
 Every restart is accounted: ``worker_restarts`` plus a per-component
 counter, and the journal's v3 ``restarts`` field — a pipeline that is
@@ -35,15 +39,24 @@ class Supervisor:
     ``restart_fatal=True`` restarts regardless of classification —
     for best-effort components like the GUI server whose death must
     never take the observation down with it.
+
+    ``counter`` names the metrics counter an approved restart bumps
+    (plus its ``<counter>_<name>`` variant).  Pass None for budget
+    bookkeeping that is accounted elsewhere — the device-reinit
+    supervisor counts under ``device_reinits``, and bumping
+    ``worker_restarts`` too would journal phantom worker-thread
+    restarts for a run whose workers never crashed.
     """
 
     def __init__(self, name: str, max_restarts: int = 3,
                  window_s: float = 60.0, restart_fatal: bool = False,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 counter: str | None = "worker_restarts"):
         self.name = name
         self.max_restarts = int(max_restarts)
         self.window_s = float(window_s)
         self.restart_fatal = restart_fatal
+        self.counter = counter
         self._clock = clock
         self._restarts: collections.deque[float] = collections.deque()
 
@@ -66,8 +79,9 @@ class Supervisor:
                 " escalating to clean shutdown")
             return False
         self._restarts.append(now)
-        metrics.add("worker_restarts")
-        metrics.add(f"worker_restarts_{self.name}")
+        if self.counter:
+            metrics.add(self.counter)
+            metrics.add(f"{self.counter}_{self.name}")
         log.warning(
             f"[supervisor] {self.name}: crashed with {exc!r}; "
             f"restarting ({len(self._restarts)}/{self.max_restarts} "

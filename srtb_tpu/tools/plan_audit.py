@@ -111,14 +111,23 @@ def main(argv=None) -> int:
                   f"-> {c}", file=sys.stderr)
         return 0
 
-    regressions, new_plans, stale = [], [], []
+    regressions, new_plans, stale, ladder_failures = [], [], [], []
     if not args.no_baseline:
         baseline = HA.CardBaseline.load(args.baseline)
         regressions, new_plans, stale = HA.diff_cards(cards, baseline)
-        if set(keys) != set(HA.PLAN_KEYS):
+        if set(keys) == set(HA.PLAN_KEYS):
+            # the self-healing demotion ladder must only land on
+            # carded plan families — checked against the same baseline
+            # the cards diff against, so a --write-baseline accepting
+            # a new family also arms the ladder to use it.  Subset
+            # runs skip it (same convention as staleness: a partial
+            # baseline cannot judge the whole ladder).
+            ladder_failures = HA.audit_ladder(
+                baseline, log2n=args.log2n, channels=args.channels)
+        else:
             stale = []  # subset runs cannot judge staleness
 
-    problems = bad_checks + regressions \
+    problems = bad_checks + regressions + ladder_failures \
         + [f"{k}: not in baseline (run --write-baseline to accept)"
            for k in new_plans] \
         + [f"{k}: stale baseline entry (plan no longer audited)"
@@ -129,6 +138,7 @@ def main(argv=None) -> int:
             "cards": {k: HA.stable_view(c) for k, c in cards.items()},
             "failed_checks": bad_checks,
             "regressions": regressions,
+            "ladder_failures": ladder_failures,
             "new_plans": new_plans,
             "stale_baseline": stale,
         }, indent=2, sort_keys=True))
@@ -149,6 +159,7 @@ def main(argv=None) -> int:
         summary = (f"plan-audit: {len(cards)} plan(s), "
                    f"{len(bad_checks)} failed check(s), "
                    f"{len(regressions)} regression(s), "
+                   f"{len(ladder_failures)} uncarded ladder target(s), "
                    f"{len(new_plans)} unbaselined, {len(stale)} stale")
         print(summary, file=sys.stderr if problems else sys.stdout)
     return 1 if problems else 0

@@ -15,9 +15,13 @@ pipeline writes (one record per segment) and reports
   blocked the drain loop, plus in-flight depth statistics;
 - resilience activity (schema-v3 spans): cumulative retry / watchdog-
   requeue / worker-restart counts, shed dumps and the degradation-
-  level profile — how hard the run had to fight to stay alive.
+  level profile — how hard the run had to fight to stay alive;
+- compute health (schema-v4 spans): plan demotions / promotions /
+  device reinits, the ladder-level profile and the active-plan
+  timeline — which execution plan each part of the run actually
+  computed on after self-healing.
 
-Mixed v1/v2/v3 journals (rotation can leave an older-schema tail
+Mixed v1/v2/v3/v4 journals (rotation can leave an older-schema tail
 after an upgrade) are summarized tolerantly: records simply lack the
 newer fields and drop out of the sections that need them.
 
@@ -229,6 +233,39 @@ def resilience_stats(records: list[dict]) -> dict:
     }
 
 
+def compute_stats(records: list[dict]) -> dict:
+    """Compute health from v4 spans (the self-healing ladder).  The
+    counters are cumulative, so the LAST record carries run totals;
+    the per-record ladder level gives time-at-demoted, and the
+    ``active_plan`` change points give the plan timeline (which plan
+    family each stretch of the run computed on).  v1–v3 records (no
+    compute fields) are skipped; empty dict when none qualify."""
+    v4 = [r for r in records if "plan_demotions" in r
+          or "device_reinits" in r]
+    if not v4:
+        return {}
+    last = v4[-1]
+    levels = [int(r.get("plan_ladder_level", 0)) for r in v4]
+    timeline_plans: list[dict] = []
+    prev = None
+    for r in v4:
+        plan = r.get("active_plan")
+        if plan is not None and plan != prev:
+            timeline_plans.append({"segment": int(r.get("segment", -1)),
+                                   "plan": plan})
+            prev = plan
+    return {
+        "records": len(v4),
+        "plan_demotions": int(last.get("plan_demotions", 0)),
+        "plan_promotions": int(last.get("plan_promotions", 0)),
+        "device_reinits": int(last.get("device_reinits", 0)),
+        "ladder_level_max": max(levels),
+        "ladder_level_last": levels[-1],
+        "segments_demoted": sum(1 for lv in levels if lv > 0),
+        "plan_timeline": timeline_plans,
+    }
+
+
 def report(path: str, bin_s: float = 10.0) -> dict:
     records = load(path)
     return {
@@ -237,6 +274,7 @@ def report(path: str, bin_s: float = 10.0) -> dict:
         "stages": stage_stats(records),
         "overlap": overlap_stats(records),
         "resilience": resilience_stats(records),
+        "compute": compute_stats(records),
         "timeline": timeline(records, bin_s),
     }
 
@@ -274,6 +312,21 @@ def _md(rep: dict) -> str:
                   f"degradation: max level {rs['degrade_level_max']}, "
                   f"{rs['segments_degraded']}/{rs['records']} segments "
                   "drained at a degraded level"]
+    cs = rep.get("compute") or {}
+    if cs:
+        lines += ["", "## Compute health (self-healing ladder)", "",
+                  f"plan demotions: {cs['plan_demotions']}, "
+                  f"promotions: {cs['plan_promotions']}, "
+                  f"device reinits: {cs['device_reinits']}",
+                  f"ladder: max level {cs['ladder_level_max']}, final "
+                  f"level {cs['ladder_level_last']}, "
+                  f"{cs['segments_demoted']}/{cs['records']} segments "
+                  "drained on a demoted plan"]
+        if cs["plan_timeline"]:
+            lines += ["", "active-plan timeline:"]
+            for step in cs["plan_timeline"]:
+                lines.append(f"- segment {step['segment']}: "
+                             f"{step['plan']}")
     lines += ["", "## Throughput timeline", "",
               "| t (s) | segments | seg/s | Msamples/s | detections | "
               "dumps | pkts lost |", "|---|---|---|---|---|---|---|"]

@@ -33,10 +33,16 @@ from srtb_tpu.utils.metrics import metrics
 # ``retries`` / ``requeues`` / ``restarts`` / ``shed_waterfalls`` /
 # ``shed_baseband`` (same cumulative convention as
 # ``segments_dropped``: deltas between consecutive records localize a
-# recovery burst to a segment).  Readers must tolerate mixed
-# v1/v2/v3 journals: rotation can leave an older-schema tail in
+# recovery burst to a segment).
+# v4 (self-healing compute): adds the cumulative ``plan_demotions`` /
+# ``plan_promotions`` / ``device_reinits`` counters, the demotion-
+# ladder position at drain (``plan_ladder_level``, 0 = the configured
+# plan) and — when the writer knows it — ``active_plan`` (the
+# SegmentProcessor.plan_name active at drain time; consecutive-record
+# changes give the plan timeline).  Readers must tolerate mixed
+# v1/v2/v3/v4 journals: rotation can leave an older-schema tail in
 # ``<path>.1`` after an upgrade.
-SPAN_SCHEMA_VERSION = 3
+SPAN_SCHEMA_VERSION = 4
 
 # gauge names shared between the pipeline (writer) and health() (reader)
 LAST_SEGMENT_MONOTONIC = "last_segment_monotonic"
@@ -108,7 +114,8 @@ def segment_span(segment: int, stages_s: dict, queue_depth: int,
                  detections: int, dump: bool, samples: int,
                  timestamp_ns: int = 0, extra: dict | None = None,
                  overlap_hidden_s: float | None = None,
-                 inflight_depth: int | None = None) -> dict:
+                 inflight_depth: int | None = None,
+                 active_plan: str | None = None) -> dict:
     """One journal record.  ``stages_s`` maps stage name -> seconds for
     THIS segment; loss/drop counters are the cumulative registry values
     at drain time (deltas between consecutive records localize a loss
@@ -156,12 +163,25 @@ def segment_span(segment: int, stages_s: dict, queue_depth: int,
         # stride_bytes warm, segment_bytes cold)
         "h2d_bytes": int(metrics.get("h2d_bytes")),
         "ring_cold_dispatches": int(metrics.get("ring_cold_dispatches")),
+        # v4 self-healing compute fields (cumulative counters + the
+        # ladder position gauge at drain)
+        "plan_demotions": int(metrics.get("plan_demotions")),
+        "plan_promotions": int(metrics.get("plan_promotions")),
+        "device_reinits": int(metrics.get("device_reinits")),
+        "plan_ladder_level": int(metrics.get("plan_ladder_level")),
     }
     if overlap_hidden_s is not None:
         rec["overlap_hidden_ms"] = round(
             max(overlap_hidden_s, 0.0) * 1e3, 3)
     if inflight_depth is not None:
         rec["inflight_depth"] = int(inflight_depth)
+    if active_plan is not None:
+        # the plan ACTIVE AT DRAIN TIME (like every cumulative field
+        # above; in overlapped mode a demotion between this segment's
+        # dispatch and its drain stamps the newer plan).  Omitted when
+        # the writer has no plan-aware processor (duck-typed stubs) —
+        # never a fake placeholder.
+        rec["active_plan"] = str(active_plan)
     if extra:
         rec.update(extra)
     return rec

@@ -117,9 +117,10 @@ def test_overlapped_engine_bit_identical_to_serial(synth_file, tmp_path):
     # journal ordering identical and monotonic in both modes
     assert [r["segment"] for r in s_recs] == list(range(4))
     assert [r["segment"] for r in o_recs] == list(range(4))
-    # v2+v3 schema fields present (v3 adds the resilience counters)
+    # v2+v3+v4 schema fields present (v4 adds the compute-health
+    # counters)
     for r in o_recs:
-        assert r["v"] == 3
+        assert r["v"] == 4
         assert "overlap_hidden_ms" in r
         assert r["inflight_depth"] >= 1
         assert r["degrade_level"] == 0 and r["retries"] == 0

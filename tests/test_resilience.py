@@ -400,11 +400,11 @@ def test_all_six_sites_one_run_acceptance(synth_file, shared_processor,
     assert "srtb_retries_total 6" in prom
     assert "srtb_faults_injected 6" in prom
     assert "srtb_degrade_level" in prom
-    # ... and in the v3 journal
+    # ... and in the journal (schema v4 since the self-healing PR)
     recs = TR.load(cfg.telemetry_journal_path)
     assert len(recs) == stats.segments
     for r in recs:
-        assert r["v"] == 3
+        assert r["v"] == 4
         for key in ("degrade_level", "retries", "requeues", "restarts",
                     "shed_waterfalls", "shed_baseband"):
             assert key in r, (key, r)
@@ -1347,6 +1347,54 @@ def test_telemetry_report_tolerates_mixed_v2_v3(tmp_path):
     assert rs["degrade_level_max"] == 1 and rs["segments_degraded"] == 1
     md = TR._md(rep)
     assert "## Resilience" in md
+    assert TR.main([str(path), "--format", "json"]) == 0
+
+
+def test_telemetry_report_tolerates_mixed_v2_v3_v4(tmp_path):
+    """A v4 upgrade mid-rotation: stages cover every record, the
+    resilience section the v3+v4 ones, the compute-health section
+    only the v4 ones — and the active-plan timeline reads change
+    points off the v4 tail."""
+    from srtb_tpu.tools import telemetry_report as TR
+
+    path = tmp_path / "mixed234.jsonl"
+    base = {"type": "segment_span", "queue_depth": 1, "detections": 0,
+            "dump": False, "samples": 64,
+            "stages_ms": {"dispatch": 2.0, "fetch": 1.0},
+            "overlap_hidden_ms": 3.0, "inflight_depth": 2}
+    with open(path, "w") as f:
+        f.write(json.dumps({**base, "v": 2, "ts": 1000.0,
+                            "segment": 0}) + "\n")
+        f.write(json.dumps({**base, "v": 3, "ts": 1001.0, "segment": 1,
+                            "degrade_level": 0, "retries": 2,
+                            "requeues": 0, "restarts": 0,
+                            "shed_waterfalls": 0,
+                            "shed_baseband": 0}) + "\n")
+        for seg, plan, dem, lvl in ((2, "fused:four_step+ring", 0, 0),
+                                    (3, "fused:four_step", 1, 1),
+                                    (4, "fused:four_step", 1, 1)):
+            f.write(json.dumps({
+                **base, "v": 4, "ts": 1002.0 + seg, "segment": seg,
+                "degrade_level": 0, "retries": 2, "requeues": 0,
+                "restarts": 0, "shed_waterfalls": 0,
+                "shed_baseband": 0, "plan_demotions": dem,
+                "plan_promotions": 0, "device_reinits": 0,
+                "plan_ladder_level": lvl,
+                "active_plan": plan}) + "\n")
+    rep = TR.report(str(path))
+    assert rep["records"] == 5
+    assert rep["stages"]["dispatch"]["count"] == 5
+    assert rep["resilience"]["records"] == 4  # v3 + v4
+    cs = rep["compute"]
+    assert cs["records"] == 3  # v4 only
+    assert cs["plan_demotions"] == 1 and cs["device_reinits"] == 0
+    assert cs["ladder_level_max"] == 1 and cs["segments_demoted"] == 2
+    assert cs["plan_timeline"] == [
+        {"segment": 2, "plan": "fused:four_step+ring"},
+        {"segment": 3, "plan": "fused:four_step"}]
+    md = TR._md(rep)
+    assert "## Compute health" in md
+    assert "fused:four_step+ring" in md
     assert TR.main([str(path), "--format", "json"]) == 0
 
 
