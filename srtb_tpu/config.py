@@ -107,6 +107,29 @@ class Config:
     use_emulated_fp64: bool = False
     # resume state file for file-mode streaming ("" = disabled)
     checkpoint_path: str = ""
+    # durable exactly-once outputs (io/manifest.py): append-only,
+    # CRC'd run-manifest WAL recording intent->commit for every sink
+    # artifact plus the checkpoint consistency point.  On startup the
+    # manifest is recovered (torn tail truncated, uncommitted intents
+    # rolled back, committed segments rebuilt into a done-set so a
+    # resumed run skips already-written artifacts instead of
+    # duplicating them).  Verify/repair offline with
+    # `python -m srtb_tpu.tools.fsck`.  "" = disabled.
+    run_manifest_path: str = ""
+    # arm the WAL's two durability points (io/manifest.py): the
+    # publish barrier (pending intents fdatasync'd between an
+    # artifact's temp write and its atomic rename — no artifact
+    # reaches its final name before the WAL durably holds the intent)
+    # and the checkpoint consistency-point record.  0 drops both:
+    # process-death (SIGKILL) recovery is unaffected — the page cache
+    # survives the process — but power loss may then leak an
+    # untracked renamed artifact.
+    manifest_fsync: bool = True
+    # record a CRC32 of every committed artifact's content in the WAL
+    # (fsck's deep bit-rot check).  Costs ~1 ms per dumped MB on the
+    # sink path; 0 drops to existence+size verification — worth it
+    # only for deployments dumping multi-GB baseband per candidate.
+    manifest_hash: bool = True
     # persistent XLA compile cache dir; the FFTW-wisdom analog
     # ("" = default ~/.cache location, "off" = disabled)
     fft_fftw_wisdom_path: str = ""
@@ -351,7 +374,8 @@ class Config:
     _BOOL_FIELDS = frozenset({
         "baseband_reserve_sample", "baseband_write_all", "gui_enable",
         "use_emulated_fp64", "use_pallas", "use_pallas_sk", "sanitize",
-        "degrade_enable", "chirp_exact",
+        "degrade_enable", "chirp_exact", "manifest_fsync",
+        "manifest_hash",
     })
     _LIST_FIELDS = frozenset({
         "udp_receiver_address", "udp_receiver_port",

@@ -143,6 +143,11 @@ class AsyncWriterPool:
         self._py_errors = 0
         self._py_jobs = 0
         self._py_bytes = 0
+        # native pool only: manifest commit callbacks deferred to the
+        # drain barrier (see submit); _done_err_base is the error
+        # count the pending batch started from
+        self._pending_done: list = []
+        self._done_err_base = 0
         if prefer_native and _NATIVE is not None:
             self._lib = _NATIVE
             self._h = self._lib.srtb_writer_create(self.n_threads,
@@ -172,13 +177,37 @@ class AsyncWriterPool:
     # ------------------------------------------------------------------
 
     def submit(self, path: str, data, *, fsync: bool = False,
-               append: bool = False) -> None:
+               append: bool = False, on_done=None,
+               pre_publish=None) -> None:
         """Queue one write. ``data`` is bytes or a numpy array; it is
         copied at submission, so the caller may reuse its buffer.
 
         ``append`` requires a single-thread pool: with more workers the
         append order would be nondeterministic.
-        """
+
+        ``on_done`` (the manifest commit hook, io/manifest.py) fires
+        after the write durably landed: the Python pool calls it from
+        the worker thread right after the successful atomic rename /
+        append; the native C++ pool has no per-job completion hook, so
+        callbacks are deferred to the next ``drain()`` barrier.  When
+        that drain observed new write errors, the native counter
+        cannot say WHICH job failed — so each pending ATOMIC job is
+        attributed through the filesystem instead (the C++ pool's
+        temp+rename is all-or-nothing: the final file exists at the
+        submitted size iff the job succeeded) and commits fire only
+        for verified jobs; append commits in an errored batch are
+        dropped wholesale (a failed append can leave partial bytes a
+        later append papers over, so per-range verification is
+        unsound — the committed-prefix truncation heals them on
+        resume).  An uncommitted-but-written artifact is rolled back
+        and regenerated on resume; a committed-but-failed one would be
+        silent loss — every ambiguity errs on the recoverable side.
+
+        ``pre_publish`` (the manifest's publish barrier,
+        ``RunManifest.sync``) runs between the worker's temp write and
+        its atomic rename on the Python pool; the native C++ pool
+        renames in C++, so the barrier runs AT SUBMIT instead — the
+        intent is durable before the job exists."""
         if append and self.n_threads > 1:
             raise ValueError(
                 "append=True needs n_threads=1 (ordered appends)")
@@ -186,12 +215,18 @@ class AsyncWriterPool:
             if isinstance(data, np.ndarray) else \
             np.frombuffer(bytes(data), dtype=np.uint8)
         if self._h is not None:
+            if pre_publish is not None:
+                pre_publish()
             ptr = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
             rc = self._lib.srtb_writer_submit(
                 self._h, path.encode(), ptr, buf.size,
                 1 if fsync else 0, 1 if append else 0)
             if rc != 0:
                 raise RuntimeError(f"srtb_writer_submit failed for {path}")
+            if on_done is not None:
+                with self._lock:
+                    self._pending_done.append(
+                        (on_done, path, int(buf.size), append))
             return
         payload = buf.tobytes()  # copy-at-submit, like the native pool
         with self._space:
@@ -209,11 +244,11 @@ class AsyncWriterPool:
             self._futures = [f for f in self._futures
                              if not f.done() or f.exception() is not None]
             fut = self._pool.submit(self._py_write, path, payload, fsync,
-                                    append)
+                                    append, on_done, pre_publish)
             self._futures.append(fut)
 
     def _py_write(self, path: str, payload: bytes, fsync: bool,
-                  append: bool) -> None:
+                  append: bool, on_done=None, pre_publish=None) -> None:
         # accounting must run for ANY exception type, or the backpressure
         # window shrinks permanently and later submits block forever
         ok = False
@@ -232,7 +267,14 @@ class AsyncWriterPool:
                 # startup by io.writers.recover_orphan_temps), not a
                 # torn file.  Appends stay in-place by nature.
                 from srtb_tpu.io.writers import atomic_write
-                atomic_write(path, payload, fsync=fsync)
+                atomic_write(path, payload, fsync=fsync,
+                             pre_rename=pre_publish)
+            # manifest commit, only once the bytes durably landed; a
+            # failing commit (the WAL append itself errored) leaves
+            # the artifact uncommitted — rolled back + regenerated on
+            # resume, never silently trusted
+            if on_done is not None:
+                on_done()
             ok = True
         except OSError:
             # counted below; surfaced via raise_new_errors().  Anything
@@ -255,6 +297,36 @@ class AsyncWriterPool:
         """Block until every submitted job has been written (or failed)."""
         if self._h is not None:
             self._lib.srtb_writer_drain(self._h)
+            with self._lock:
+                pending, self._pending_done = self._pending_done, []
+                errors = int(self._lib.srtb_writer_errors(self._h))
+                base, self._done_err_base = self._done_err_base, errors
+            if pending:
+                if errors > base:
+                    # per-job attribution through the filesystem (see
+                    # submit): atomic jobs verify final-file size,
+                    # append commits drop wholesale
+                    fired = dropped = 0
+                    for cb, path, size, append in pending:
+                        ok = False
+                        if not append:
+                            try:
+                                ok = os.path.getsize(path) == size
+                            except OSError:
+                                ok = False
+                        if ok:
+                            cb()
+                            fired += 1
+                        else:
+                            dropped += 1
+                    log.warning(
+                        f"[writer_pool] {errors - base} native write "
+                        f"error(s) in this drain: {fired} commit(s) "
+                        f"verified on disk, {dropped} dropped "
+                        "(uncommitted artifacts regenerate on resume)")
+                else:
+                    for cb, _path, _size, _append in pending:
+                        cb()
             return
         with self._lock:
             futures, self._futures = self._futures, []
@@ -294,6 +366,8 @@ class AsyncWriterPool:
         left to die with the process."""
         if self._h is not None:
             if drain:
+                if self._pending_done:
+                    self.drain()  # fire deferred manifest commits
                 self._finalizer()  # idempotent drain + destroy
             else:
                 self._finalizer.detach()

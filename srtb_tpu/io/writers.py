@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import os
 import time
+import zlib
 from collections import deque
 from dataclasses import dataclass
 
@@ -80,13 +81,49 @@ def recover_orphan_temps(prefix: str,
     return removed
 
 
-def atomic_write(path: str, payload, *, fsync: bool = False) -> None:
+def fsync_dir(path: str) -> None:
+    """fsync the PARENT DIRECTORY of ``path``: an ``os.replace`` makes
+    the rename atomic but not durable — the directory entry itself can
+    vanish on power loss until the directory inode is synced.  Best
+    effort: filesystems that refuse directory fds (some network
+    mounts) degrade to the rename-only guarantee."""
+    d = os.path.dirname(path) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    except OSError as e:
+        log.debug(f"[writers] cannot open dir {d} for fsync: {e}")
+        return
+    try:
+        os.fsync(fd)
+    except OSError as e:
+        log.debug(f"[writers] dir fsync of {d} failed: {e}")
+    finally:
+        os.close(fd)
+
+
+# crash-window steering hook for the durability harnesses
+# (tools/crash_soak.py, tests/test_durability.py): when set, called
+# with the destination path after the temp write and BEFORE the atomic
+# rename — a SIGKILL landing inside the hook is a deterministic
+# mid-rename crash.  None in production (one global read per write).
+_PRE_RENAME_HOOK = None
+
+
+def atomic_write(path: str, payload, *, fsync: bool = False,
+                 pre_rename=None) -> None:
     """Crash-consistent write: temp + flush (+ optional fdatasync) +
-    atomic rename.  A crash mid-write leaves only the orphan temp for
-    the startup sweep; a *failed* write from a live run drops its temp
-    so it cannot read as an interrupted-run orphan next startup.  The
-    native C++ pool implements the same sequence with the same suffix
-    (native/file_writer.cpp)."""
+    atomic rename (+ parent-directory fsync, so the rename survives
+    power loss — opt out via the same ``fsync`` knob).  A crash
+    mid-write leaves only the orphan temp for the startup sweep; a
+    *failed* write from a live run drops its temp so it cannot read as
+    an interrupted-run orphan next startup.  The native C++ pool
+    implements the same sequence with the same suffix
+    (native/file_writer.cpp).
+
+    ``pre_rename`` is the manifest's publish barrier
+    (``RunManifest.sync``): invoked between the temp write and the
+    rename, so no artifact reaches its final name before the WAL
+    durably holds its intent."""
     tmp = path + TMP_SUFFIX
     try:
         with open(tmp, "wb") as f:
@@ -94,13 +131,67 @@ def atomic_write(path: str, payload, *, fsync: bool = False) -> None:
             f.flush()
             if fsync:
                 os.fdatasync(f.fileno())
+        if pre_rename is not None:
+            pre_rename()
+        if _PRE_RENAME_HOOK is not None:
+            _PRE_RENAME_HOOK(path)
         os.replace(tmp, path)
+        if fsync:
+            fsync_dir(path)
     except BaseException:
         try:
             os.unlink(tmp)
         except OSError:
             pass  # never created, or the disk is truly gone
         raise
+
+
+def manifest_stage(manifest, key, path: str, data: np.ndarray):
+    """Stage one atomic artifact write against the run manifest: log
+    the intent NOW — before any byte reaches the temp file — and
+    return the commit callback to fire once the atomic rename has
+    published the artifact (synchronously, or from a writer-pool
+    thread via ``AsyncWriterPool.submit(on_done=...)``).  The intent
+    append is buffered; the durability point is the PUBLISH BARRIER
+    (``manifest.sync``), which the writer runs between the temp write
+    and the rename — see io/manifest.py.  None when no manifest is
+    bound (zero cost)."""
+    if manifest is None or key is None:
+        return None
+    buf = np.ascontiguousarray(data)
+    length = int(buf.nbytes)
+    # content CRC is the deep fsck check, ~1 ms per dumped MB;
+    # Config.manifest_hash=0 drops to existence+size verification
+    crc = zlib.crc32(buf) if getattr(manifest, "hash_content", True) \
+        else None
+    manifest.intent(key, path)
+
+    def commit():
+        manifest.commit(key, path, length, crc)
+
+    return commit
+
+
+def stage_write(path: str, payload, *, fsync: bool = False) -> str:
+    """First half of :func:`atomic_write`: write the temp (+ optional
+    fdatasync) WITHOUT publishing it.  Returns the temp path; the
+    caller renames after its publish barrier — letting one barrier
+    cover a whole segment's artifacts (see
+    ``WriteSignalSink._publish_staged``)."""
+    tmp = path + TMP_SUFFIX
+    try:
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            if fsync:
+                os.fdatasync(f.fileno())
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass  # never created, or the disk is truly gone
+        raise
+    return tmp
 
 
 def _npy_bytes(arr: np.ndarray) -> np.ndarray:
@@ -154,11 +245,37 @@ class WriteSignalSink:
         # object itself is not stable across attempts
         self._inflight_key: tuple | None = None
         self._inflight_npy: dict[int, str] = {}
+        # durable exactly-once (io/manifest.py): when bound, every
+        # artifact logs intent before its temp write and commit after
+        # the atomic rename; the runtime sets the (stream, seg, sink)
+        # key per push.  None = manifest off, zero cost.
+        self.manifest = None
+        self._manifest_key = None
+        # segment-transaction staging (synchronous path only): with a
+        # manifest bound, one segment's artifacts are temp-written
+        # first, then published together behind ONE publish barrier —
+        # one fdatasync per segment instead of one per artifact.  None
+        # when no transaction is open.
+        self._tx_staged = None
+        # whether the LAST push wrote any artifact: the runtime skips
+        # the durable done record for empty pushes (a replayed
+        # negative segment recomputes the same decision and writes
+        # nothing — nothing to protect, and the common all-negative
+        # observation keeps its WAL one record per segment)
+        self.last_push_wrote = False
         # check directory writability up front (ref: write_signal_pipe.hpp:62-75)
         check_path = cfg.baseband_output_file_prefix + ".check"
         with open(check_path, "wb"):
             pass
         os.unlink(check_path)
+
+    # ------------------------------------------------------------------
+
+    def bind_manifest(self, manifest) -> None:
+        self.manifest = manifest
+
+    def set_manifest_key(self, key) -> None:
+        self._manifest_key = key
 
     # ------------------------------------------------------------------
 
@@ -174,6 +291,7 @@ class WriteSignalSink:
 
     def push(self, work: SegmentResultWork, has_signal: bool) -> None:
         """Feed one processed segment; writes to disk when warranted."""
+        self.last_push_wrote = False
         real_time = self.cfg.input_file_path == ""
         w = self._overlap_window_ns()
         ts = work.segment.timestamp
@@ -247,8 +365,29 @@ class WriteSignalSink:
         if self._inflight_key != key:
             self._inflight_key = key
             self._inflight_npy = {}
+        self.last_push_wrote = True
         log.info(f"[write_signal] begin writing, file_counter = {counter}")
 
+        # open the segment transaction: synchronous manifest-armed
+        # writes stage temps and publish together after one barrier
+        # (the pool path self-batches worker-side instead)
+        if self.manifest is not None and self._manifest_key is not None \
+                and self.pool is None:
+            self._tx_staged = []
+        try:
+            self._write_artifacts(work, base)
+            self._publish_staged()
+        except BaseException:
+            self._tx_abort()
+            raise
+        # completed: the next _write (even for a same-counter
+        # piggyback) must pick fresh indices, not reuse these
+        self._inflight_key = None
+        self._inflight_npy = {}
+        log.info(f"[write_signal] finished writing, file_counter = {counter}")
+
+    def _write_artifacts(self, work: SegmentResultWork,
+                         base: str) -> None:
         bin_path = base + ".bin"
         self._write_bytes(bin_path,
                           np.ascontiguousarray(work.segment.data),
@@ -271,10 +410,14 @@ class WriteSignalSink:
                 if path is None:
                     # pick first non-existing index (ref: 230-235);
                     # with an async pool queued-but-unwritten paths
-                    # count as taken
+                    # count as taken, as do staged-but-unpublished
+                    # ones inside the open segment transaction
+                    staged_paths = {p for p, *_ in self._tx_staged} \
+                        if self._tx_staged else set()
                     j = i
                     while (os.path.exists(f"{base}.{j}.npy")
-                           or f"{base}.{j}.npy" in self._assigned_paths):
+                           or f"{base}.{j}.npy" in self._assigned_paths
+                           or f"{base}.{j}.npy" in staged_paths):
                         j += 1
                     path = f"{base}.{j}.npy"
                     self._inflight_npy[i] = path
@@ -304,14 +447,52 @@ class WriteSignalSink:
                         tim_paths.append(path)
 
         self.written.append(CandidateFiles(bin_path, npy_paths, tim_paths))
-        # completed: the next _write (even for a same-counter
-        # piggyback) must pick fresh indices, not reuse these
-        self._inflight_key = None
-        self._inflight_npy = {}
-        log.info(f"[write_signal] finished writing, file_counter = {counter}")
+
+    def _publish_staged(self) -> None:
+        """Close the segment transaction: ONE publish barrier (all
+        pending intents durable), then rename + commit every staged
+        artifact.  A crash before the barrier leaves only temps
+        (rolled back); between barrier and a rename, temps with
+        durable intents (rolled back); after a rename, a committed or
+        regenerable artifact — never an untracked final file."""
+        staged, self._tx_staged = self._tx_staged, None
+        if not staged:
+            return
+        self.manifest.sync()
+        try:
+            for path, tmp, fsync, commit in staged:
+                if _PRE_RENAME_HOOK is not None:
+                    _PRE_RENAME_HOOK(path)
+                os.replace(tmp, path)
+                if fsync:
+                    fsync_dir(path)
+                if commit is not None:
+                    commit()
+        except BaseException:
+            for _path, tmp, _fsync, _commit in staged:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass  # already renamed, or the disk is truly gone
+            raise
+
+    def _tx_abort(self) -> None:
+        staged, self._tx_staged = self._tx_staged, None
+        for _path, tmp, _fsync, _commit in staged or ():
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass  # this artifact never reached its temp write
 
     def _write_bytes(self, path: str, data: np.ndarray, *,
                      fsync: bool = False) -> None:
+        commit = manifest_stage(self.manifest, self._manifest_key,
+                                path, data)
+        barrier = self.manifest.sync if commit is not None else None
+        if self._tx_staged is not None:
+            tmp = stage_write(path, data.tobytes(), fsync=fsync)
+            self._tx_staged.append((path, tmp, fsync, commit))
+            return
         if self.pool is not None:
             if path in self._assigned_paths:
                 # same target queued again (e.g. a piggybacked segment
@@ -320,11 +501,15 @@ class WriteSignalSink:
                 self.pool.drain()
                 self._assigned_paths.clear()
             self._assigned_paths.add(path)
-            self.pool.submit(path, data, fsync=fsync)
+            self.pool.submit(path, data, fsync=fsync, on_done=commit,
+                             pre_publish=barrier)
             return
         # crash-consistent: a crash mid-write leaves an orphan temp
         # (swept at startup), never a torn candidate file
-        atomic_write(path, data.tobytes(), fsync=fsync)
+        atomic_write(path, data.tobytes(), fsync=fsync,
+                     pre_rename=barrier)
+        if commit is not None:
+            commit()
 
     def drain(self) -> None:
         """Wait for queued async writes to land (no-op when synchronous).
@@ -352,6 +537,7 @@ class WriteAllSink:
     """
 
     sheddable = True  # degradation ladder: baseband dumps shed at L2
+    last_push_wrote = True  # every push appends: always seal done
 
     def __init__(self, cfg: Config, reserved_bytes: int,
                  data_stream_id: int = 0, writer_pool=None):
@@ -364,6 +550,27 @@ class WriteAllSink:
             raise ValueError("WriteAllSink needs a 1-thread pool "
                              "(ordered appends)")
         self._f = None if writer_pool is not None else open(path, "ab")
+        # durable exactly-once (io/manifest.py): appends log an intent
+        # carrying the pre-append file length, so recovery can
+        # truncate a torn append back to the committed prefix.
+        # _append_off tracks the SUBMITTED length (appends are
+        # ordered); the manifest's committed length only advances at
+        # each commit record.
+        self.manifest = None
+        self._manifest_key = None
+        self._append_off = 0
+
+    def bind_manifest(self, manifest) -> None:
+        self.manifest = manifest
+        try:
+            # manifest recovery already truncated any torn tail, so
+            # the current size IS the durable committed prefix
+            self._append_off = os.path.getsize(self.path)
+        except OSError:
+            self._append_off = 0
+
+    def set_manifest_key(self, key) -> None:
+        self._manifest_key = key
 
     def push(self, work: SegmentResultWork, has_signal: bool = False) -> None:
         data = work.segment.data
@@ -371,11 +578,28 @@ class WriteAllSink:
         if end <= 0:
             end = len(data)
         chunk = np.ascontiguousarray(data[:end])
+        m, key = self.manifest, self._manifest_key
+        commit = None
+        if m is not None and key is not None:
+            off = self._append_off
+            length = int(chunk.nbytes)
+            crc = zlib.crc32(chunk) \
+                if getattr(m, "hash_content", True) else None
+            m.intent(key, self.path, mode="append", offset=off)
+
+            def commit(m=m, key=key, path=self.path, length=length,
+                       crc=crc, off=off):
+                m.commit(key, path, length, crc, offset=off)
+
+            self._append_off = off + length
         if self.pool is not None:
-            self.pool.submit(self.path, chunk, append=True)
+            self.pool.submit(self.path, chunk, append=True,
+                             on_done=commit)
             return
         self._f.write(chunk.tobytes())
         self._f.flush()
+        if commit is not None:
+            commit()
 
     def drain(self) -> None:
         if self.pool is not None:

@@ -7,6 +7,26 @@ Here resume is first-class: a small JSON state file tracks the logical
 file offset and segment counter so a crashed/restarted file-mode run
 continues where it stopped, and the persistent XLA compile cache
 (utils.compile_cache) removes the recompilation cost on restart.
+
+Durability (ISSUE 10):
+
+- the state file carries a CRC32 of its canonical JSON (shared
+  encoding with the run manifest, io/manifest.py), so a torn or
+  bit-rotted checkpoint is DETECTED instead of silently parsed;
+- every update keeps the previous generation as ``<path>.bak``; a
+  corrupt/unreadable/missing primary falls back to it with a loud
+  warning — at worst one segment of progress is repeated, and the run
+  manifest's done-set makes that repeat idempotent.  Only when BOTH
+  generations are dead does the run restart from segment 0, as an
+  ERROR, never silently;
+- with a run manifest bound, ``update`` logs the manifest's ``ckpt``
+  consistency-point record BEFORE rewriting the state file: the
+  checkpoint can never claim progress the manifest has not sealed
+  ("checkpoint ahead of manifest" is always corruption — fsck flags
+  it);
+- the renames are followed by a parent-directory fsync
+  (io/writers.fsync_dir) so a published checkpoint survives power
+  loss, not just process death.
 """
 
 from __future__ import annotations
@@ -18,8 +38,9 @@ from srtb_tpu.utils.logging import log
 
 
 class StreamCheckpoint:
-    def __init__(self, path: str):
+    def __init__(self, path: str, manifest=None):
         self.path = path
+        self.manifest = manifest
         self.state = {"segments_done": 0, "file_offset_bytes": 0}
         # recovery sweep: a crash between the temp write and the
         # atomic rename in update() leaves a stale <path>.tmp; the
@@ -33,14 +54,50 @@ class StreamCheckpoint:
                             "from an interrupted update")
             except OSError as e:
                 log.warning(f"[checkpoint] cannot remove {tmp}: {e}")
-        if os.path.exists(path):
-            try:
-                with open(path) as f:
-                    self.state.update(json.load(f))
-                log.info(f"[checkpoint] resuming from {path}: "
-                         f"{self.state}")
-            except (json.JSONDecodeError, OSError) as e:
-                log.warning(f"[checkpoint] unreadable {path}: {e}")
+        loaded = self._load(path)
+        if loaded is None and (os.path.exists(path)
+                               or os.path.exists(path + ".bak")):
+            loaded = self._load(path + ".bak")
+            if loaded is not None:
+                log.warning(
+                    f"[checkpoint] primary {path} corrupt or missing: "
+                    f"resuming from previous generation {path}.bak "
+                    f"(at worst one segment of progress is repeated)")
+            else:
+                log.error(
+                    f"[checkpoint] BOTH {path} and {path}.bak are "
+                    "unreadable/corrupt: restarting from segment 0 — "
+                    "expect the run manifest (if armed) to skip "
+                    "already-committed artifacts")
+        if loaded is not None:
+            self.state.update(loaded)
+            log.info(f"[checkpoint] resuming from {path}: "
+                     f"{self.state}")
+
+    @staticmethod
+    def _load(path: str) -> dict | None:
+        """Parse + CRC-verify one checkpoint generation; None when
+        missing, unparseable, or failing its integrity check.
+        Pre-CRC-era files (no ``crc`` key) are accepted as legacy."""
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except FileNotFoundError:
+            return None
+        except (json.JSONDecodeError, OSError, ValueError) as e:
+            log.warning(f"[checkpoint] unreadable {path}: {e}")
+            return None
+        if not isinstance(data, dict):
+            log.warning(f"[checkpoint] malformed {path}: not an object")
+            return None
+        crc = data.pop("crc", None)
+        if crc is not None:
+            from srtb_tpu.io.manifest import record_crc
+            if record_crc(data) != crc:
+                log.warning(f"[checkpoint] CRC mismatch in {path}: "
+                            "corrupt state rejected")
+                return None
+        return data
 
     @property
     def segments_done(self) -> int:
@@ -51,15 +108,34 @@ class StreamCheckpoint:
         return self.state["file_offset_bytes"]
 
     def update(self, segments_done: int, file_offset_bytes: int) -> None:
+        from srtb_tpu.io.manifest import record_crc
+        from srtb_tpu.io.writers import fsync_dir
         self.state["segments_done"] = segments_done
         self.state["file_offset_bytes"] = file_offset_bytes
+        if self.manifest is not None:
+            # consistency point FIRST: a crash between here and the
+            # file rename leaves the checkpoint file one generation
+            # behind the manifest — safe (the resume re-drains one
+            # segment and the manifest done-set skips its sinks).
+            # The reverse order could leave a checkpoint claiming
+            # progress the manifest never sealed.
+            self.manifest.checkpoint(segments_done, file_offset_bytes)
+        body = dict(self.state)
+        body["crc"] = record_crc(self.state)
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump(self.state, f)
+            json.dump(body, f)
             f.flush()
             os.fsync(f.fileno())
+        if os.path.exists(self.path):
+            # keep the previous generation: a crash between these two
+            # renames leaves no primary but a valid .bak (the loader's
+            # fallback) plus the fsync'd tmp — never zero generations
+            os.replace(self.path, self.path + ".bak")
         os.replace(tmp, self.path)  # atomic, like the fdatasync'd writers
+        fsync_dir(self.path)
 
     def clear(self) -> None:
-        if os.path.exists(self.path):
-            os.unlink(self.path)
+        for p in (self.path, self.path + ".bak"):
+            if os.path.exists(p):
+                os.unlink(p)

@@ -225,10 +225,37 @@ class Pipeline:
                                          donate_input=on_accelerator())
         self.processor = processor
         self._owned_writer_pool = None
+        # durable exactly-once outputs (io/manifest.py): opening the
+        # manifest RUNS RECOVERY — torn WAL tail truncated,
+        # uncommitted artifact groups rolled back, the done-set of
+        # committed (stream, segment, sink) groups rebuilt so the
+        # replay below skips them.  Must happen before sinks open the
+        # prefix and before the checkpoint loads (recovery may
+        # truncate files the sinks are about to append to).
+        self.manifest = None
+        if getattr(cfg, "run_manifest_path", ""):
+            from srtb_tpu.io.manifest import RunManifest
+            from srtb_tpu.pipeline.checkpoint import StreamCheckpoint
+            # peek the checkpoint FILE (the resume authority) before
+            # recovery: a WAL that lost its ckpt records to corruption
+            # must not roll back artifacts in segments the checkpoint
+            # says are done — the resume would never regenerate them
+            hint = 0
+            if cfg.checkpoint_path:
+                state = (StreamCheckpoint._load(cfg.checkpoint_path)
+                         or StreamCheckpoint._load(
+                             cfg.checkpoint_path + ".bak") or {})
+                hint = int(state.get("segments_done", 0))
+            self.manifest = RunManifest.open(
+                cfg.run_manifest_path,
+                fsync=bool(getattr(cfg, "manifest_fsync", True)),
+                hash_content=bool(getattr(cfg, "manifest_hash", True)),
+                checkpoint_floor_hint=hint)
         self.checkpoint = None
         if cfg.checkpoint_path:
             from srtb_tpu.pipeline.checkpoint import StreamCheckpoint
-            self.checkpoint = StreamCheckpoint(cfg.checkpoint_path)
+            self.checkpoint = StreamCheckpoint(cfg.checkpoint_path,
+                                               manifest=self.manifest)
         if source is None:
             if not cfg.input_file_path:
                 raise ValueError("no input_file_path and no source given")
@@ -252,6 +279,16 @@ class Pipeline:
                 sinks = [WriteSignalSink(
                     cfg, writer_pool=self._owned_writer_pool)]
         self.sinks = sinks
+        # manifest sink names must be stable across process restarts
+        # (the done-set keys on them): position + class, both
+        # config-determined
+        self._sink_names = [f"{i}:{type(s).__name__}"
+                            for i, s in enumerate(sinks)]
+        if self.manifest is not None:
+            for s in sinks:
+                bind = getattr(s, "bind_manifest", None)
+                if bind is not None:
+                    bind(self.manifest)
         self.keep_waterfall = keep_waterfall
         self.stats = PipelineStats()
         # set when a bounded shutdown gave up on a wedged sink: close()
@@ -723,6 +760,12 @@ class Pipeline:
         # JOURNAL keeps the drain counter below, which is resume-
         # continuous across checkpointed runs
         seg_index = index
+        # durable exactly-once key: the RESUME-CONTINUOUS drain index
+        # (what the checkpoint counts), not the per-run dispatch
+        # index — a replayed segment after a crash+resume must land on
+        # the same manifest key its first life used
+        mkey = (None if self.manifest is None
+                else (getattr(seg, "data_stream_id", 0), drained[0]))
         with self._stage("sink"):
             # ``sinks_done`` rides with the item: a retry (or a
             # supervisor replay) re-enters _push_sinks but skips the
@@ -731,7 +774,8 @@ class Pipeline:
             self._op("sink_write", seg_index,
                      lambda: self._push_sinks(seg, wf, det_res,
                                               positive, degrade_level,
-                                              done=sinks_done))
+                                              done=sinks_done,
+                                              seg_key=mkey))
         span["sink"] = self.stage_timer.last["sink"]
         # host staging-buffer pool: copies staged for this segment
         # (micro-batch stacks, non-contiguous inputs) are reusable once
@@ -1498,7 +1542,8 @@ class Pipeline:
     # fail-fast philosophy, ref: util/termination_handler.hpp:38-113)
     def _push_sinks(self, seg, wf, det_res, positive,
                     degrade_level: int = 0,
-                    done: set | None = None) -> None:
+                    done: set | None = None,
+                    seg_key: tuple | None = None) -> None:
         """Push to every sink, handing the waterfall only to sinks
         entitled to it: all of them under ``keep_waterfall``, else only
         sinks declaring ``wants_waterfall`` (a lossy GUI tap must not
@@ -1517,7 +1562,15 @@ class Pipeline:
         already received this segment, and completed ones are skipped
         on re-entry: a retried or replayed push is exactly-once per
         sink, never a duplicate — an in-place appender
-        (``WriteAllSink``) would otherwise corrupt its stream."""
+        (``WriteAllSink``) would otherwise corrupt its stream.
+
+        ``seg_key`` is the durable half of the same guarantee: the
+        ``(data_stream_id, drain index)`` the run manifest keys on.
+        A sink whose group the manifest recovered as committed is
+        skipped entirely (``replayed_skips`` — the in-memory done-set
+        died with the crashed process, the manifest did not); every
+        completed push seals a durable ``done`` record, and the sink
+        logs intent/commit per artifact in between (io/manifest.py)."""
         if degrade_level >= 1 and wf is not None:
             wf = None
             # the "wf" marker in ``done`` (sink entries are ints, no
@@ -1531,17 +1584,45 @@ class Pipeline:
                                  detect=det_res)
         light = full if self.keep_waterfall else SegmentResultWork(
             segment=seg, waterfall=None, detect=det_res)
+        m = self.manifest
         for i, sink in enumerate(self.sinks):
             if done is not None and i in done:
                 continue
+            key = None
+            if m is not None and seg_key is not None:
+                key = (seg_key[0], seg_key[1], self._sink_names[i])
+                if m.is_done(key):
+                    # committed by a previous life of this run: the
+                    # crash landed between this sink's commit and the
+                    # covering checkpoint, and replaying the push
+                    # would duplicate the artifacts under fresh names
+                    metrics.add("replayed_skips")
+                    log.info(f"[manifest] segment {seg_key[1]} sink "
+                             f"{self._sink_names[i]}: already "
+                             "committed, skipping replay")
+                    if done is not None:
+                        done.add(i)
+                    continue
             if degrade_level >= 2 and getattr(sink, "sheddable", False):
                 metrics.add("shed_baseband")
                 if done is not None:
                     done.add(i)
                 continue
+            if key is not None:
+                setk = getattr(sink, "set_manifest_key", None)
+                if setk is not None:
+                    setk(key)
             give = self.keep_waterfall or getattr(
                 sink, "wants_waterfall", False)
             sink.push(full if give else light, positive)
+            if key is not None and getattr(sink, "last_push_wrote",
+                                           True):
+                # empty pushes skip the durable done record: a
+                # replayed negative segment recomputes the same
+                # decision and writes nothing — nothing to protect,
+                # and the common all-negative observation keeps its
+                # WAL to one record per segment
+                m.sink_done(key)
             self._sink_heartbeat += 1
             if done is not None:
                 done.add(i)
@@ -1600,6 +1681,9 @@ class Pipeline:
         if self._owned_writer_pool is not None:
             self._owned_writer_pool.close(drain=not self._sink_wedged)
             self._owned_writer_pool = None
+        if self.manifest is not None:
+            self.manifest.close()
+            self.manifest = None
         if self.journal is not None:
             self.journal.close()
             self.journal = None
@@ -1822,10 +1906,14 @@ class ThreadedPipeline(Pipeline):
             # numbering, same split as the async engine)
             seg_index = index
             done = set()  # retries stay exactly-once per sink
+            mkey = (None if self.manifest is None
+                    else (getattr(seg, "data_stream_id", 0),
+                          drained[0]))
             with self._stage("sink"):
                 self._op("sink_write", seg_index,
                          lambda: self._push_sinks(seg, wf, det_res,
-                                                  positive, done=done))
+                                                  positive, done=done,
+                                                  seg_key=mkey))
             span["sink"] = self.stage_timer.last["sink"]
             pool = getattr(self.source, "pool", None)
             if pool is not None and cfg.input_file_path:

@@ -20,8 +20,12 @@ pipeline writes (one record per segment) and reports
   device reinits, the ladder-level profile and the active-plan
   timeline — which execution plan each part of the run actually
   computed on after self-healing.
+- durability (schema-v5 spans): manifest crash-recovery activity —
+  segments recovered beyond the checkpoint, sink pushes skipped on
+  replay, uncommitted intents rolled back (all zero on a run that
+  never crashed).
 
-Mixed v1/v2/v3/v4 journals (rotation can leave an older-schema tail
+Mixed v1-v5 journals (rotation can leave an older-schema tail
 after an upgrade) are summarized tolerantly: records simply lack the
 newer fields and drop out of the sections that need them.
 
@@ -266,6 +270,38 @@ def compute_stats(records: list[dict]) -> dict:
     }
 
 
+def durability_stats(records: list[dict]) -> dict:
+    """Crash-recovery activity from v5 spans (the run manifest,
+    io/manifest.py).  Unlike the other cumulative sections, a
+    crash-recovered run spans SEVERAL processes and the counters
+    reset with each one — the very runs this section describes —
+    so totals are summed per process generation (a counter DECREASE
+    between consecutive records marks a restart boundary).  v1-v4
+    records (no durability fields) are skipped; empty dict when none
+    qualify."""
+    v5 = [r for r in records if "replayed_skips" in r
+          or "rolled_back_intents" in r]
+    if not v5:
+        return {}
+
+    def total(field: str) -> int:
+        out = 0
+        prev = 0
+        for r in v5:
+            cur = int(r.get(field, 0))
+            if cur < prev:  # process restart: bank the finished life
+                out += prev
+            prev = cur
+        return out + prev
+
+    return {
+        "records": len(v5),
+        "recovered_segments": total("recovered_segments"),
+        "replayed_skips": total("replayed_skips"),
+        "rolled_back_intents": total("rolled_back_intents"),
+    }
+
+
 def report(path: str, bin_s: float = 10.0) -> dict:
     records = load(path)
     return {
@@ -275,6 +311,7 @@ def report(path: str, bin_s: float = 10.0) -> dict:
         "overlap": overlap_stats(records),
         "resilience": resilience_stats(records),
         "compute": compute_stats(records),
+        "durability": durability_stats(records),
         "timeline": timeline(records, bin_s),
     }
 
@@ -327,6 +364,12 @@ def _md(rep: dict) -> str:
             for step in cs["plan_timeline"]:
                 lines.append(f"- segment {step['segment']}: "
                              f"{step['plan']}")
+    ds = rep.get("durability") or {}
+    if ds:
+        lines += ["", "## Durability (run manifest)", "",
+                  f"recovered segments: {ds['recovered_segments']}, "
+                  f"replayed skips: {ds['replayed_skips']}, "
+                  f"rolled-back intents: {ds['rolled_back_intents']}"]
     lines += ["", "## Throughput timeline", "",
               "| t (s) | segments | seg/s | Msamples/s | detections | "
               "dumps | pkts lost |", "|---|---|---|---|---|---|---|"]

@@ -39,10 +39,16 @@ from srtb_tpu.utils.metrics import metrics
 # ladder position at drain (``plan_ladder_level``, 0 = the configured
 # plan) and — when the writer knows it — ``active_plan`` (the
 # SegmentProcessor.plan_name active at drain time; consecutive-record
-# changes give the plan timeline).  Readers must tolerate mixed
-# v1/v2/v3/v4 journals: rotation can leave an older-schema tail in
-# ``<path>.1`` after an upgrade.
-SPAN_SCHEMA_VERSION = 4
+# changes give the plan timeline).
+# v5 (durable outputs): adds the cumulative crash-recovery counters
+# ``recovered_segments`` (committed segments the manifest rescued
+# beyond the checkpoint at startup), ``replayed_skips`` (sink pushes
+# skipped on replay because the manifest already holds their commit)
+# and ``rolled_back_intents`` (uncommitted artifacts rolled back by
+# manifest recovery) — all zero on a run that never crashed.  Readers
+# must tolerate mixed v1-v5 journals: rotation can leave an
+# older-schema tail in ``<path>.1`` after an upgrade.
+SPAN_SCHEMA_VERSION = 5
 
 # gauge names shared between the pipeline (writer) and health() (reader)
 LAST_SEGMENT_MONOTONIC = "last_segment_monotonic"
@@ -169,6 +175,10 @@ def segment_span(segment: int, stages_s: dict, queue_depth: int,
         "plan_promotions": int(metrics.get("plan_promotions")),
         "device_reinits": int(metrics.get("device_reinits")),
         "plan_ladder_level": int(metrics.get("plan_ladder_level")),
+        # v5 durable-output fields (cumulative at drain)
+        "recovered_segments": int(metrics.get("recovered_segments")),
+        "replayed_skips": int(metrics.get("replayed_skips")),
+        "rolled_back_intents": int(metrics.get("rolled_back_intents")),
     }
     if overlap_hidden_s is not None:
         rec["overlap_hidden_ms"] = round(
