@@ -299,6 +299,27 @@ class Config:
     # for real-time deployments that prefer bounded exit over
     # completeness (recommended 120-300 there).
     shutdown_join_timeout_s: float = 0.0
+    # ---- multi-tenant stream fleet (pipeline/fleet.py) ----
+    # label of THIS stream in a fleet: stamps telemetry spans (v6
+    # ``stream`` field), per-stream Prometheus labels, /healthz
+    # per-stream staleness, and scopes fault_plan entries carrying a
+    # stream selector ("stream0:dispatch:oom@3").  "" = unnamed
+    # single-stream run (everything reads exactly as before).
+    stream_name: str = ""
+    # admission/shedding priority of this stream (higher = more
+    # important): when the fleet is over capacity, lower-priority
+    # streams are queued/rejected first, and under fleet-wide sink
+    # pressure the lowest-priority REAL-TIME stream is shed first
+    # (resilience/degrade.FleetShedPolicy).
+    stream_priority: int = 0
+    # max concurrently admitted streams in a StreamFleet (0 = no
+    # admission limit); streams beyond capacity are queued (up to
+    # fleet_queue_limit, priority order) or rejected.  Read from the
+    # FLEET config (the first spec's cfg), not per stream.
+    fleet_max_streams: int = 0
+    # queued-stream slots behind the admission gate (0 = reject
+    # immediately when over capacity)
+    fleet_queue_limit: int = 0
     # segment-span telemetry journal: one JSONL record per processed
     # segment (per-stage wall clock, queue depth, loss counters,
     # detection count, dump decision — utils/telemetry.py); "" disables.
@@ -357,7 +378,8 @@ class Config:
         "micro_batch_segments", "retry_max_attempts",
         "segment_watchdog_requeues", "supervisor_max_restarts",
         "degrade_hold_segments", "promote_after_segments",
-        "device_reinit_max",
+        "device_reinit_max", "stream_priority", "fleet_max_streams",
+        "fleet_queue_limit",
     })
     _FLOAT_FIELDS = frozenset({
         "baseband_freq_low", "baseband_bandwidth", "baseband_sample_rate",

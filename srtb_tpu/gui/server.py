@@ -203,7 +203,13 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         if self.path == "/healthz":
             # last-segment-age staleness: 503 while the pipeline is
             # wedged (no cooperation needed from the stuck thread),
-            # 200 when segments flow or before the first one (startup)
+            # 200 when segments flow or before the first one (startup).
+            # Multi-tenant fleet: the payload carries a per-stream
+            # breakdown ("streams": {name: {last_segment_age_s, ok}})
+            # for every ADMITTED stream, and the endpoint goes 503
+            # when ANY of them is stale — one wedged tenant must flip
+            # health even while its neighbors keep the global last-
+            # segment stamp fresh (utils/telemetry.health).
             from srtb_tpu.utils.telemetry import health
 
             h = health(stale_after_s=self.health_stale_after_s)

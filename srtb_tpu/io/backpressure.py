@@ -44,13 +44,21 @@ class DropOldestSegmentBuffer:
     """
 
     def __init__(self, source, capacity: int = 4,
-                 name: str = "segment_buffer"):
+                 name: str = "segment_buffer", stream: str = ""):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         self.source = source
         self.capacity = int(capacity)
         self.name = name
+        # tenant label for drop attribution: the fleet passes the
+        # owning Config.stream_name; unnamed buffers fall back to the
+        # victim segment's data_stream_id so multi-receiver loss is
+        # still auditable per origin stream
+        self.stream = stream
         self.dropped = 0
+        # per-origin drop counts (data_stream_id or stream label ->
+        # count), mirrored into labeled segments_dropped series
+        self.dropped_by_stream: dict[str, int] = {}
         self._buf: collections.deque = collections.deque()
         self._cv = threading.Condition()
         self._done = False
@@ -70,6 +78,15 @@ class DropOldestSegmentBuffer:
                         self.dropped += 1
                         metrics.add("segments_dropped")
                         metrics.window("segments_dropped").add(1)
+                        # attribute the loss to the ORIGINATING stream
+                        # (not just the process-wide total): fleet
+                        # shedding must be auditable per tenant
+                        origin = self.stream or str(
+                            getattr(victim, "data_stream_id", 0))
+                        self.dropped_by_stream[origin] = \
+                            self.dropped_by_stream.get(origin, 0) + 1
+                        metrics.add("segments_dropped",
+                                    labels={"stream": origin})
                         # a pooled source's buffer must go back to the
                         # pool: the pipeline only releases segments it
                         # actually drains

@@ -786,6 +786,15 @@ class UdpReceiverSource:
         # visible while it happens, not diluted into the lifetime ratio
         metrics.window("packets_total").add(total)
         metrics.window("packets_lost").add(lost)
+        # per-tenant attribution (multi-tenant fleet): receiver loss
+        # labeled by the owning stream (Config.stream_name when the
+        # fleet named this lane, else the receiver id) so /metrics can
+        # answer "whose packets" — the same rule as segments_dropped
+        if lost:
+            origin = (str(getattr(self.cfg, "stream_name", "") or "")
+                      or str(self.data_stream_id))
+            metrics.add("packets_lost", lost,
+                        labels={"stream": origin})
         depth = getattr(self.receiver, "queue_depth", None)
         if depth is not None:
             metrics.set(f"udp_rx{self.data_stream_id}_queue_packets",

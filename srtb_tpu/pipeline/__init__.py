@@ -1,2 +1,5 @@
 from srtb_tpu.pipeline.work import SegmentWork, SegmentResultWork  # noqa: F401
 from srtb_tpu.pipeline.segment import SegmentProcessor  # noqa: F401
+# fleet (StreamFleet/StreamSpec) is imported lazily from
+# srtb_tpu.pipeline.fleet — it pulls in the full runtime, which this
+# package __init__ deliberately does not

@@ -1,0 +1,918 @@
+"""Multi-tenant stream fleet: N concurrent streams on one device.
+
+The reference backend serves one stream per process; the production
+target (ROADMAP item 1) is one engine serving many concurrent beams
+and replay jobs from one device — the concurrent-streams architecture
+of *Implementing CUDA Streams into AstroAccelerate* (arXiv:2101.00941),
+where independent streams hide each other's transfer/compute gaps.
+This module makes that multi-tenancy SAFE before it is fast:
+
+- **Round-robin scheduler**: one scheduler thread multiplexes every
+  admitted stream's in-flight window onto the shared device dispatch
+  queue — each :class:`_StreamLane` is a cooperative state machine
+  (``step()``) over the same Pipeline building blocks the solo engine
+  uses (``_dispatch_segment`` / ``_fetch_inflight`` / ``_drain_body``),
+  so lane outputs are bit-identical to solo runs by construction.
+
+- **Shared AOT plan cache** (:class:`SharedPlanCache`): streams whose
+  trace-relevant config projects identically
+  (``SegmentProcessor.plan_cache_key``) share ONE ``SegmentProcessor``
+  — one jit cache, one set of compiled programs; the second stream of
+  a plan family compiles nothing.  Shared processors are
+  ``mark_shared()``-ed so a single lane's plan demotion can never
+  retire the programs its neighbors are dispatching through.
+
+- **Per-stream bulkheads**: every lane owns its OWN Pipeline instance
+  and with it its own ComputeHealer ladder position, degradation
+  ladder, retry policy, fault injector (stream-selector scoped),
+  supervisor restart budget, ring carry, checkpoint, telemetry
+  journal and RunManifest namespace — a DEVICE fault, sink wedge or
+  manifest rollback on stream A demotes/sheds/rolls back A only.  The
+  one deliberately SHARED failure domain is a true device halt: the
+  device under every lane died, so the fleet makes one budgeted
+  reinit decision and cold-restarts every lane from its retained host
+  buffers (journal order and exactly-once outputs preserved per
+  stream, like the solo engine's reinit).
+
+- **Admission control + priority shedding**: the
+  :class:`~srtb_tpu.resilience.admission.AdmissionController` gates
+  stream starts (``fleet_max_streams`` / ``fleet_queue_limit``,
+  priority-ordered), and under fleet-wide sink pressure the
+  :class:`~srtb_tpu.resilience.degrade.FleetShedPolicy` force-sheds
+  the lowest-priority REAL-TIME stream first (hysteretic, loss
+  accounted per stream) instead of letting the overload land on an
+  arbitrary tenant.
+
+Every per-stream quantity is labeled: loss counters, degrade /
+ladder levels, in-flight depth (``{stream="..."}`` series on
+/metrics), the v6 journal's ``stream`` field, and /healthz per-stream
+staleness.  The fleet chaos gate is ``tools/fleet_soak.py``.
+
+Limits (documented, enforced loudly): lanes are single-segment
+dispatch units (``micro_batch_segments`` must be 1 — the solo engine
+keeps micro-batch), and ``Config.sanitize`` is unsupported inside a
+fleet (the sanitizer's thread-ownership guards assume one engine per
+process).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from srtb_tpu.config import Config
+from srtb_tpu.pipeline import framework as fw
+from srtb_tpu.pipeline.runtime import Pipeline, PipelineStats
+from srtb_tpu.pipeline.segment import SegmentProcessor
+from srtb_tpu.resilience.admission import (ADMIT, QUEUE,
+                                           AdmissionController)
+from srtb_tpu.resilience.degrade import FleetShedPolicy
+from srtb_tpu.resilience.errors import (DEVICE_HALT, LadderExhausted,
+                                        ReinitBudgetExceeded)
+from srtb_tpu.resilience.supervisor import Supervisor
+from srtb_tpu.utils import telemetry
+from srtb_tpu.utils.logging import log
+from srtb_tpu.utils.metrics import metrics
+
+
+@dataclass
+class StreamSpec:
+    """One stream's identity + wiring handed to the fleet.  ``cfg``
+    is the stream's OWN config: per-stream paths (output prefix,
+    checkpoint, manifest, journal) are its bulkhead namespace;
+    trace-relevant fields shared with other streams let them share a
+    compiled plan."""
+    name: str
+    cfg: Config
+    source: Any = None
+    sinks: Any = None
+    keep_waterfall: bool = True
+    max_segments: int | None = None
+
+    @property
+    def priority(self) -> int:
+        return int(getattr(self.cfg, "stream_priority", 0) or 0)
+
+
+@dataclass
+class StreamResult:
+    """Per-stream outcome of a fleet run."""
+    name: str
+    status: str                  # done | failed | rejected
+    stats: PipelineStats | None = None
+    error: BaseException | None = None
+    drained: int = 0
+    dropped: int = 0
+    extras: dict = field(default_factory=dict)
+
+
+class SharedPlanCache:
+    """One ``SegmentProcessor`` per plan family, shared across every
+    stream whose trace-relevant config projects identically
+    (``SegmentProcessor.plan_cache_key``).  ``compiles`` counts
+    processor builds (one per family — the proof the fleet soak
+    gates on), ``hits`` counts streams served an existing plan."""
+
+    def __init__(self):
+        self._by_key: dict[str, SegmentProcessor] = {}
+        self.compiles = 0
+        self.hits = 0
+
+    def get(self, cfg: Config,
+            donate_input: bool = False) -> SegmentProcessor:
+        key = SegmentProcessor.plan_cache_key(cfg,
+                                              donate_input=donate_input)
+        proc = self._by_key.get(key)
+        if proc is None:
+            proc = SegmentProcessor(
+                cfg, donate_input=donate_input).mark_shared()
+            self._by_key[key] = proc
+            self.compiles += 1
+            metrics.add("fleet_plan_compiles")
+            log.info(f"[fleet] plan cache MISS: built shared plan "
+                     f"{proc.plan_name} ({self.compiles} families)")
+        else:
+            self.hits += 1
+            metrics.add("fleet_plan_cache_hits")
+        return proc
+
+    def invalidate(self) -> None:
+        """Retire every shared plan (force past the shared guard) and
+        forget it: after a device reinit the compiled handles are
+        bound to the dead backend, and the next ``get`` rebuilds."""
+        for proc in self._by_key.values():
+            proc.retire(force=True)
+        self._by_key.clear()
+
+
+class _StreamLane:
+    """One admitted stream's cooperative engine: a step()-driven
+    in-flight window over the lane's own Pipeline, with sink work on
+    a per-lane pipe thread (the bulkhead: a wedged or crashed sink
+    stalls/sheds THIS lane only)."""
+
+    def __init__(self, fleet: "StreamFleet", spec: StreamSpec):
+        cfg = spec.cfg
+        if int(getattr(cfg, "micro_batch_segments", 1) or 1) > 1:
+            raise ValueError(
+                f"stream {spec.name!r}: micro_batch_segments > 1 is "
+                "not supported in a fleet lane (use the solo engine)")
+        if getattr(cfg, "sanitize", False):
+            raise ValueError(
+                f"stream {spec.name!r}: Config.sanitize is "
+                "incompatible with fleet scheduling (single-engine "
+                "thread-ownership guards)")
+        self.fleet = fleet
+        self.spec = spec
+        self.name = spec.name
+        self.priority = spec.priority
+        from srtb_tpu.utils.platform import on_accelerator
+        self.pipe = Pipeline(
+            cfg, source=spec.source, sinks=spec.sinks,
+            keep_waterfall=spec.keep_waterfall,
+            processor=fleet.plans.get(
+                cfg, donate_input=on_accelerator()))
+        self.window = max(1, int(getattr(cfg, "inflight_segments", 2)
+                                 or 1))
+        self.real_time = not cfg.input_file_path
+        self.max_segments = spec.max_segments
+        self.deadline_s = float(cfg.segment_deadline_s or 0.0)
+        self.join_s = float(getattr(cfg, "shutdown_join_timeout_s", 0)
+                            or 0)
+        self.pending: collections.deque = collections.deque()
+        self._it = iter(self.pipe.source)
+        self.dispatched = 0
+        self.exhausted = False
+        self.drained = [self.pipe.checkpoint.segments_done
+                        if self.pipe.checkpoint else 0]
+        self._drained0 = self.drained[0]
+        self.done = False
+        self.status = "running"
+        self.error: BaseException | None = None
+        # fleet fairness: force-shed (ingest-and-account, no dispatch)
+        self.forced_shed = False
+        # "this lane waited on its sink since the fleet's last
+        # fairness observation" — the pressure signal
+        self.sink_wait = False
+        self._emitted_since_obs = 0
+        # fetched item awaiting sink-queue space (the lane's emit
+        # backpressure point)
+        self._staged_emit = None
+        self._wedge_t0 = None
+        self._wedge_mark = None
+        # parked-window watchdog (whole window stuck behind the sink)
+        self._park_t0 = None
+        self._park_mark = None
+        # lane-local loss recency (the engine's 10 s loss window,
+        # scoped to THIS stream's labeled counter): when this lane
+        # last saw its own accounted loss grow
+        self._loss_seen = 0.0
+        self._loss_t = None
+        # bounded sentinel push at close
+        self._sentinel_t0 = None
+        self._t_start = time.perf_counter()
+        self._t_close = None
+        # dispatched-through-sink count (the lane's live window);
+        # written by the scheduler thread and the lane's sink thread
+        import threading
+        self._live_lock = threading.Lock()
+        self._live = 0
+        # per-lane sink pipe + bounded-restart supervision (each
+        # stream its own restart budget)
+        self._stop = fw.StopToken()
+        self._q_sink = fw.WorkQueue(capacity=self.window)
+        self._current = [None]
+        self._progress = [self.drained[0]]
+        self._supervisor = None
+        if int(getattr(cfg, "supervisor_max_restarts", 0)) > 0:
+            self._supervisor = Supervisor(
+                f"sink_drain_{self.name}",
+                max_restarts=cfg.supervisor_max_restarts,
+                window_s=getattr(cfg, "supervisor_window_s", 60.0))
+        self._sink_pipe = fw.start_pipe(
+            self._sink_f, self._q_sink, None, self._stop,
+            f"sink_drain:{self.name}")
+        telemetry.register_stream(self.name)
+
+    # ------------------------------------------------------ accounting
+
+    def _live_add(self, n: int) -> None:
+        with self._live_lock:
+            self._live += n
+            metrics.set("inflight_depth", self._live,
+                        labels={"stream": self.name})
+
+    def _live_count(self) -> int:
+        with self._live_lock:
+            return self._live
+
+    # ------------------------------------------------------- sink side
+
+    def _sink_f(self, _stop, item):
+        self._current[0] = item
+        self._progress[0] = self.drained[0]
+        try:
+            self.pipe._drain_body(item, self.drained)
+        finally:
+            if "abandoned" not in item[-1]:
+                self._live_add(-1)
+        self._current[0] = None
+
+    def _sink_alive(self) -> bool:
+        """True while this lane's sink side can make progress;
+        restarts a supervised crashed pipe (replaying the unaccounted
+        item inline first — journal order kept, same contract as the
+        solo engine)."""
+        if self._sink_pipe.exception is None:
+            return True
+        if self._supervisor is None or \
+                not self._supervisor.should_restart(
+                    self._sink_pipe.exception):
+            return False
+        failed, self._current[0] = self._current[0], None
+        if failed is not None and failed is not fw.SENTINEL:
+            if self.drained[0] == self._progress[0]:
+                self.pipe._drain_body(failed, self.drained)
+            else:
+                log.warning(
+                    f"[fleet:{self.name}] sink crashed after its "
+                    "segment was accounted; skipping replay")
+        self._sink_pipe = fw.start_pipe(
+            self._sink_f, self._q_sink, None, self._stop,
+            f"sink_drain:{self.name}")
+        return True
+
+    # ------------------------------------------------------ heal hooks
+
+    def _heal(self, exc: BaseException) -> bool:
+        """Device-fault recovery with the fleet's blast-radius rules:
+        OOM/compile faults demote THIS lane's plan only (the shared
+        processor is swapped out for an unshared demoted one — and
+        never retired under the neighbors); a device HALT is the one
+        shared failure domain and goes to the fleet's single budgeted
+        reinit."""
+        h = self.pipe.healer
+        if h is None:
+            return False
+        kind = h.classify(exc)
+        if kind is None:
+            return False
+        if kind == DEVICE_HALT:
+            if self.fleet._reinit_all(exc, faulting=self.name):
+                return True
+            raise ReinitBudgetExceeded(
+                "device halt beyond fleet reinit recovery "
+                f"(budget spent or disabled): {exc}") from exc
+        newp = h.demote(exc, kind)
+        if newp is None:
+            raise LadderExhausted(
+                f"stream {self.name!r}: device fault survived every "
+                f"demotion rung: {exc}") from exc
+        self.pipe._swap_processor(newp)
+        return True
+
+    def _dispatch(self, seg, ingest_s, offset_after, index,
+                  requeue=False):
+        while True:
+            try:
+                return self.pipe._dispatch_segment(
+                    seg, ingest_s, offset_after, index,
+                    requeue=requeue)
+            except BaseException as e:  # noqa: BLE001 — classified
+                if not self._heal(e):
+                    raise
+                requeue = True
+
+    def reinit_cold(self) -> None:
+        """Fleet-wide device reinit, this lane's share: swap in a
+        fresh processor at the lane's current ladder rung and
+        re-dispatch every in-flight segment cold from its retained
+        host buffer, in dispatch order."""
+        h = self.pipe.healer
+        if h is not None:
+            newp = h.rebuild()
+        else:
+            from srtb_tpu.utils.platform import on_accelerator
+            newp = self.fleet.plans.get(
+                self.pipe.cfg, donate_input=on_accelerator())
+        self.pipe._swap_processor(newp)
+        for i in range(len(self.pending)):
+            seg, _wf, _det, offset_after, span, _t0, idx = \
+                self.pending[i]
+            self.pending[i] = self.pipe._dispatch_segment(
+                seg, span["ingest"], offset_after, idx, requeue=True)
+
+    # ----------------------------------------------------- engine step
+
+    def _want_more(self) -> bool:
+        return (not self.exhausted
+                and (self.max_segments is None
+                     or self.dispatched < self.max_segments))
+
+    def _ingest_one(self, index: int):
+        seg = self.pipe._timed_ingest(self._it, index)
+        if seg is None:
+            self.exhausted = True
+            return None
+        return (seg, self.pipe.stage_timer.last["ingest"],
+                getattr(self.pipe.source, "logical_offset", 0))
+
+    def _observe_level(self) -> int:
+        """Per-lane degradation observation at emit (the solo engine's
+        emit() signal, lane-scoped): occupancy 1.0 when this lane
+        waited on its sink since the last emit, plus the lane's own
+        recent accounted loss."""
+        ladder = self.pipe._ladder
+        if ladder is None:
+            return 0
+        if not self.real_time:
+            occupancy = 0.0
+        elif self.sink_wait:
+            occupancy = 1.0
+        else:
+            occupancy = self._q_sink.qsize() / self.window
+        # loss signal scoped to THIS stream: the process-wide window
+        # would let a noisy neighbor's drops degrade a healthy lane —
+        # exactly the blast radius the bulkheads exist to prevent
+        cur = metrics.get("segments_dropped",
+                          labels={"stream": self.name})
+        if cur > self._loss_seen:
+            self._loss_seen = cur
+            self._loss_t = time.perf_counter()
+        loss = (self._loss_t is not None
+                and time.perf_counter() - self._loss_t < 10.0)
+        return ladder.observe(occupancy, loss)
+
+    def _shed_item(self, item) -> None:
+        """Account one fetched-but-unsunk item as this stream's loss
+        and release its buffers (the solo engine's shed_segment,
+        lane-scoped)."""
+        pipe = self.pipe
+        pipe._account_dropped()
+        pipe._ring_invalidate()
+        self._live_add(-1)
+        rel = getattr(pipe.processor, "release_staging", None)
+        if rel is not None:
+            rel(item[0].data)
+        pool = getattr(pipe.source, "pool", None)
+        if pool is not None and pipe.cfg.input_file_path:
+            pool.release(item[0].data)
+
+    def _try_emit(self) -> bool:
+        """Push the staged fetched item to this lane's sink pipe.
+        Queue full = lane-local backpressure (flagged for the fleet's
+        fairness observation); a sink wedged past the deadline with
+        zero per-push progress sheds the item as accounted per-stream
+        loss (real-time lanes only — a file-mode lane throttles
+        losslessly, exactly like the solo engine)."""
+        item = self._staged_emit
+        if self._q_sink.push_lossy(item):
+            self._staged_emit = None
+            self._wedge_t0 = None
+            self._emitted_since_obs += 1
+            return True
+        self.sink_wait = True
+        if self.deadline_s > 0 and self.real_time:
+            cur = (self.drained[0], self.pipe._sink_heartbeat)
+            if self._wedge_t0 is None or cur != self._wedge_mark:
+                self._wedge_t0 = time.perf_counter()
+                self._wedge_mark = cur
+            elif time.perf_counter() - self._wedge_t0 \
+                    > self.deadline_s:
+                log.error(
+                    f"[fleet:{self.name}] sink wedged past "
+                    f"{self.deadline_s:g}s with no drain progress: "
+                    "shedding segment as accounted loss")
+                self._shed_item(item)
+                self._staged_emit = None
+                self._wedge_t0 = None
+                return True
+        return False
+
+    def _drain_head(self, block: bool) -> bool:
+        """Fetch the oldest in-flight segment (device-fault healed)
+        and stage it for emit.  ``block`` allows a blocking fetch;
+        otherwise only a device-ready head is fetched."""
+        if not block and not Pipeline._result_ready(self.pending[0][2]):
+            return False
+        depth = len(self.pending)
+        live_now = self._live_count()
+        item = self.pending.popleft()
+        while True:
+            try:
+                fetched = self.pipe._fetch_inflight(item, depth,
+                                                    live_now)
+                break
+            except BaseException as e:  # noqa: BLE001 — classified
+                if not self._heal(e):
+                    raise
+                seg, _wf, _det, offset_after, span, _t0, idx = item
+                item = self._dispatch(seg, span["ingest"],
+                                      offset_after, idx, requeue=True)
+        h = self.pipe.healer
+        if h is not None:
+            h.note_healthy()
+        level = self._observe_level()
+        self.sink_wait = False
+        self._staged_emit = fetched + (level, set())
+        self._try_emit()
+        return True
+
+    def step(self, allow_block: bool = False) -> bool:
+        """One cooperative scheduler slice; returns True when the lane
+        made progress.  Any escaping failure is contained to this
+        lane (the fleet's bulkhead): the lane fails, accounts its
+        in-flight segments as per-stream loss, and its neighbors
+        never observe it."""
+        if self.done:
+            return False
+        try:
+            return self._step_inner(allow_block)
+        except (KeyboardInterrupt, SystemExit):
+            # operator interrupts are NOT lane faults: containing one
+            # would shed a tenant's data and leave the fleet running
+            # un-interruptibly — propagate to stop the whole run
+            raise
+        except BaseException as e:  # noqa: BLE001 — bulkhead boundary
+            self._fail(e)
+            return True
+
+    def _step_inner(self, allow_block: bool) -> bool:
+        if self.status == "closing":
+            return self._step_close()
+        if not self._sink_alive():
+            raise self._sink_pipe.exception
+        # 0) a fetched item waiting for sink-queue space blocks the
+        #    lane's drain (in-order) but nothing else
+        if self._staged_emit is not None:
+            if not self._try_emit():
+                return False
+        # 1) fleet fairness force-shed: keep draining the source,
+        #    account every undispatched segment as this tenant's loss
+        if self.forced_shed and self._want_more():
+            one = self._ingest_one(self.dispatched)
+            if one is not None:
+                self.dispatched += 1
+                log.warning(f"[fleet:{self.name}] force-shed: "
+                            "dropping ingested segment (accounted)")
+                self.pipe._account_dropped()
+                self.pipe._ring_invalidate()
+                pool = getattr(self.pipe.source, "pool", None)
+                if pool is not None and self.pipe.cfg.input_file_path:
+                    pool.release(one[0].data)
+                return True
+        # 2) drain whatever is device-ready, in order
+        if self.pending and self._drain_head(block=False):
+            return True
+        # 3) admit + dispatch the next segment while the window has room
+        if self._live_count() < self.window and self._want_more() \
+                and not self.forced_shed:
+            self._maybe_promote()
+            one = self._ingest_one(self.dispatched)
+            if one is not None:
+                seg, dt, off = one
+                self.pending.append(
+                    self._dispatch(seg, dt, off, self.dispatched))
+                self._live_add(1)
+                self.dispatched += 1
+                self.pipe.stats.segments += 1
+                self.pipe.stats.samples += \
+                    self.pipe.cfg.baseband_input_count
+                self._park_t0 = None
+                return True
+        # 3b) whole window parked behind the sink: a real-time lane
+        #    must never stall on a wedged sink — past the deadline
+        #    with zero per-push progress, keep draining the source
+        #    and account each undispatched segment as this stream's
+        #    loss (the solo engine's shed_ingest, lane-scoped)
+        if self.real_time and self.deadline_s > 0 \
+                and self._want_more() and not self.pending \
+                and self._staged_emit is None \
+                and self._live_count() >= self.window:
+            self.sink_wait = True
+            cur = (self.drained[0], self.pipe._sink_heartbeat)
+            if self._park_t0 is None or cur != self._park_mark:
+                self._park_t0 = time.perf_counter()
+                self._park_mark = cur
+            elif time.perf_counter() - self._park_t0 \
+                    > self.deadline_s:
+                one = self._ingest_one(self.dispatched)
+                if one is not None:
+                    self.dispatched += 1
+                    log.error(
+                        f"[fleet:{self.name}] sink wedged with a "
+                        "full window: shedding ingested segment as "
+                        "accounted loss")
+                    self.pipe._account_dropped()
+                    self.pipe._ring_invalidate()
+                    pool = getattr(self.pipe.source, "pool", None)
+                    if pool is not None \
+                            and self.pipe.cfg.input_file_path:
+                        pool.release(one[0].data)
+                    return True
+            return False
+        # 4) window full (or source done) with an unready head: only a
+        #    blocking fetch makes progress — the fleet grants that to
+        #    one lane per idle round
+        if self.pending and allow_block:
+            return self._drain_head(block=True)
+        # 5) complete: everything dispatched, drained and handed to
+        #    the sink — close the lane (sentinel + bounded join).  A
+        #    wedged sink can hold the queue full forever; the
+        #    sentinel push is bounded by shutdown_join_timeout_s like
+        #    the solo engine's
+        if not self.pending and self._staged_emit is None \
+                and not self._want_more():
+            if self._q_sink.push_lossy(fw.SENTINEL):
+                self.status = "closing"
+                self._t_close = time.perf_counter()
+                self._sentinel_t0 = None
+                return True
+            if self._sentinel_t0 is None:
+                self._sentinel_t0 = time.perf_counter()
+            elif self.join_s > 0 and \
+                    time.perf_counter() - self._sentinel_t0 \
+                    > self.join_s:
+                self._wedge_teardown()
+                return True
+        return False
+
+    def _maybe_promote(self) -> None:
+        h = self.pipe.healer
+        if h is not None and h.promote_due():
+            newp = h.promote()
+            if newp is not None:
+                self.pipe._swap_processor(newp)
+
+    def _step_close(self) -> bool:
+        """Closing: wait for the lane's sink pipe to drain + exit,
+        bounded by shutdown_join_timeout_s (0 = wait as long as it
+        takes — but never blocking the scheduler more than a poll)."""
+        if self._sink_pipe.exception is not None:
+            if not self._sink_alive():
+                raise self._sink_pipe.exception
+            # supervised restart mid-close: the sentinel is still on
+            # the queue unless the crash consumed past it; repost
+            # (lossy — a duplicate sentinel is harmless, the pipe
+            # exits on the first)
+            self._q_sink.push_lossy(fw.SENTINEL)
+            return True
+        if self._sink_pipe.join(0.002):
+            self._finish()
+            return True
+        if self.join_s > 0 and \
+                time.perf_counter() - self._t_close > self.join_s:
+            self._wedge_teardown()
+            return True
+        return False
+
+    def _wedge_teardown(self) -> None:
+        """Bounded-shutdown giveup on a wedged sink: report the
+        thread, account still-queued segments as this stream's loss,
+        and finish with the pool abandoned (never drained)."""
+        from srtb_tpu.utils import termination
+        self.pipe._sink_wedged = True
+        termination.report_wedged(
+            [self._sink_pipe.thread],
+            f"fleet lane {self.name} shutdown "
+            f"({self.join_s:g}s join timeout)")
+        while True:
+            leftover = self._q_sink.try_pop()
+            if leftover is None:
+                break
+            if leftover is fw.SENTINEL:
+                continue
+            self._shed_item(leftover)
+        held = self._current[0]
+        if held is not None and held is not fw.SENTINEL:
+            with self.pipe._handoff_lock:
+                if self.drained[0] == self._progress[0]:
+                    held[-1].add("abandoned")
+                    self.pipe._account_dropped()
+                    self._live_add(-1)
+        self._stop.request_stop()
+        log.error(f"[fleet:{self.name}] wedged sink: queued segments "
+                  "accounted as segments_dropped")
+        self._finish()
+
+    def _finish(self) -> None:
+        if not self.pipe._sink_wedged:
+            self.pipe._drain_sinks()
+        self.pipe.stats.elapsed_s = time.perf_counter() - self._t_start
+        self.pipe.stats.extras["stages"] = \
+            self.pipe.stage_timer.summary()
+        self.status = "done"
+        self.done = True
+        metrics.set("inflight_depth", 0, labels={"stream": self.name})
+        telemetry.release_stream(self.name)
+        log.info(f"[fleet:{self.name}] done: "
+                 f"{self.pipe.stats.segments} segments, "
+                 f"{self.drained[0] - self._drained0} drained")
+
+    def _fail(self, exc: BaseException) -> None:
+        """Bulkhead containment of a lane failure: every in-flight /
+        queued segment becomes accounted per-stream loss, resources
+        are released, neighbors never see the exception."""
+        self.error = exc
+        self.status = "failed"
+        log.error(f"[fleet:{self.name}] stream failed (contained): "
+                  f"{exc!r}")
+        self._stop.request_stop()
+        while True:
+            leftover = self._q_sink.try_pop()
+            if leftover is None:
+                break
+            if leftover is fw.SENTINEL:
+                continue
+            self._shed_item(leftover)
+        if self._staged_emit is not None:
+            self._shed_item(self._staged_emit)
+            self._staged_emit = None
+        while self.pending:
+            item = self.pending.popleft()
+            self.pipe._account_dropped()
+            self._live_add(-1)
+            rel = getattr(self.pipe.processor, "release_staging", None)
+            if rel is not None:
+                try:
+                    rel(item[0].data)
+                except Exception as e:  # noqa: BLE001 - teardown
+                    log.debug(f"[fleet:{self.name}] staging release "
+                              f"during teardown failed: {e!r}")
+        self.pipe._ring_invalidate()
+        self._q_sink.push_lossy(fw.SENTINEL)
+        self._sink_pipe.join(1.0)
+        self.done = True
+        metrics.set("inflight_depth", 0, labels={"stream": self.name})
+        telemetry.release_stream(self.name)
+
+    def close(self) -> None:
+        self.pipe.close()
+
+
+class StreamFleet:
+    """Serve N streams from one device (see module docstring).
+
+    ``run()`` drives every admitted lane round-robin to completion and
+    returns ``{name: StreamResult}`` — including rejected streams
+    (status "rejected") and contained failures (status "failed" with
+    the error attached); it raises only for fleet-level failures (an
+    exhausted shared reinit budget escalating through a lane is
+    contained to that lane's result).
+    """
+
+    def __init__(self, specs: list[StreamSpec],
+                 fleet_cfg: Config | None = None):
+        if not specs:
+            raise ValueError("StreamFleet needs at least one stream")
+        names = [s.name for s in specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate stream names: {names}")
+        for s in specs:
+            # the lane label must reach the lane's telemetry/faults:
+            # stamp the spec's config with its fleet name
+            if getattr(s.cfg, "stream_name", "") not in ("", s.name):
+                raise ValueError(
+                    f"stream {s.name!r}: cfg.stream_name "
+                    f"{s.cfg.stream_name!r} disagrees with the spec")
+            s.cfg.stream_name = s.name
+        self.specs = {s.name: s for s in specs}
+        cfg0 = fleet_cfg if fleet_cfg is not None else specs[0].cfg
+        self.plans = SharedPlanCache()
+        self.admission = AdmissionController.from_config(cfg0)
+        self.fairness = FleetShedPolicy.from_config(cfg0)
+        # the SHARED device-halt reinit budget (one device, one
+        # budget): per-lane healers keep demotion only
+        self._reinit_sup = None
+        reinit_max = int(getattr(cfg0, "device_reinit_max", 0) or 0)
+        if reinit_max > 0:
+            self._reinit_sup = Supervisor(
+                "fleet_device_reinit", max_restarts=reinit_max,
+                window_s=float(getattr(cfg0, "device_reinit_window_s",
+                                       300.0)),
+                counter=None)
+        self.lanes: dict[str, _StreamLane] = {}
+        self.results: dict[str, StreamResult] = {}
+        self._waitlist: dict[str, StreamSpec] = {}
+
+    # ---------------------------------------------------- lane control
+
+    def _start(self, name: str) -> bool:
+        spec = self.specs[name]
+        try:
+            self.lanes[name] = _StreamLane(self, spec)
+            return True
+        except (KeyboardInterrupt, SystemExit):
+            self.admission.release(name)
+            raise
+        except BaseException as e:  # noqa: BLE001 — contained
+            log.error(f"[fleet] stream {name!r} failed to start: "
+                      f"{e!r}")
+            self.admission.release(name)
+            self.results[name] = StreamResult(name, "failed", error=e)
+            return False
+
+    def _start_queued(self) -> None:
+        """Start queued streams into freed capacity.  Loops PAST
+        start failures: a lane whose constructor raises released its
+        slot, and the next queued stream must get it — otherwise a
+        failed start with a non-empty waitlist would leave run()
+        spinning forever with no active lanes."""
+        while True:
+            nxt = self.admission.pop_ready()
+            if nxt is None:
+                return
+            spec = self._waitlist.pop(nxt, None)
+            if spec is None:
+                # popped a stream the waitlist no longer holds (e.g.
+                # recorded rejected after an eviction race): give the
+                # slot back and try the next one
+                self.admission.release(nxt)
+                continue
+            # a start failure released its slot; keep popping until
+            # capacity is genuinely full or the queue is drained
+            self._start(nxt)
+
+    def _reinit_all(self, exc: BaseException, faulting: str) -> bool:
+        """The one shared failure domain: a device halt.  One budgeted
+        decision (the fleet supervisor), then: drop the jax caches,
+        retire + forget every shared plan, rebuild each lane's
+        processor at its own ladder rung and re-dispatch each lane's
+        in-flight window cold — journal order and checkpoint offsets
+        unchanged per stream."""
+        if self._reinit_sup is None or \
+                not self._reinit_sup.should_restart(exc):
+            return False
+        metrics.add("device_reinits")
+        metrics.add("device_reinits", labels={"stream": faulting})
+        log.warning(f"[fleet] device halt (stream {faulting!r}): "
+                    "shared reinit — rebuilding every lane's plan "
+                    f"({exc!r})")
+        import jax
+        try:
+            jax.clear_caches()
+        except Exception as e:  # pragma: no cover - version drift
+            log.warning(f"[fleet] jax.clear_caches failed ({e!r}); "
+                        "proceeding with the rebuild")
+        self.plans.invalidate()
+        for lane in self.lanes.values():
+            if not lane.done:
+                lane.reinit_cold()
+        return True
+
+    def _on_lane_done(self, lane: _StreamLane) -> None:
+        self.admission.release(lane.name)
+        dropped = int(metrics.get("segments_dropped",
+                                  labels={"stream": lane.name}))
+        self.results[lane.name] = StreamResult(
+            lane.name,
+            lane.status if lane.status in ("done", "failed")
+            else "failed",
+            stats=lane.pipe.stats, error=lane.error,
+            drained=lane.drained[0] - lane._drained0,
+            dropped=dropped,
+            extras={"plan": getattr(lane.pipe.processor, "plan_name",
+                                    None)})
+        # capacity freed: start queued streams in priority order
+        self._start_queued()
+
+    def _observe_fairness(self) -> None:
+        """One fleet-wide fairness observation, paced on emits (not
+        scheduler rounds — an idle spin must not walk the hysteresis):
+        pressure = fraction of running lanes that waited on their sink
+        since the last observation."""
+        running = [ln for ln in self.lanes.values() if not ln.done]
+        emits = sum(ln._emitted_since_obs for ln in running)
+        waits = sum(1 for ln in running if ln.sink_wait)
+        if not running or (emits == 0 and waits == 0):
+            return
+        pressure = waits / len(running)
+        loss = metrics.window("segments_dropped").sum() > 0
+        shed = self.fairness.observe(
+            pressure, loss,
+            [(ln.name, ln.priority, ln.real_time) for ln in running])
+        for ln in running:
+            ln.forced_shed = ln.name in shed
+            ln._emitted_since_obs = 0
+
+    # ------------------------------------------------------------ run
+
+    def run(self) -> dict[str, StreamResult]:
+        metrics.set("fleet_streams_total", len(self.specs))
+        for spec in self.specs.values():
+            decision = self.admission.request(spec.name, spec.priority)
+            if decision == ADMIT:
+                self._start(spec.name)
+            elif decision == QUEUE:
+                self._waitlist[spec.name] = spec
+        # queue evictions recorded by the controller surface as
+        # rejected results too
+        for name in self.admission.rejected:
+            self._waitlist.pop(name, None)
+            self.results.setdefault(
+                name, StreamResult(name, "rejected"))
+        # a start failure in the admission pass freed capacity: give
+        # it to queued streams before the loop (otherwise nothing
+        # active + a populated waitlist = an immediate idle spin)
+        self._start_queued()
+        try:
+            while True:
+                active = [ln for ln in self.lanes.values()
+                          if not ln.done]
+                if not active and not self._waitlist:
+                    break
+                if not active and self._waitlist:
+                    # every running lane is gone but streams still
+                    # wait: start them now; if none can start (all
+                    # fail / inconsistent queue state), fail the
+                    # remainder loudly instead of spinning forever
+                    self._start_queued()
+                    if not any(not ln.done
+                               for ln in self.lanes.values()):
+                        for name, spec in list(self._waitlist.items()):
+                            del self._waitlist[name]
+                            self.results.setdefault(name, StreamResult(
+                                name, "failed",
+                                error=RuntimeError(
+                                    "queued stream never became "
+                                    "startable")))
+                        break
+                    continue
+                progressed = False
+                for lane in active:
+                    if lane.step():
+                        progressed = True
+                    if lane.done:
+                        self._on_lane_done(lane)
+                self._observe_fairness()
+                for name in self.admission.rejected:
+                    if name in self._waitlist:
+                        del self._waitlist[name]
+                        self.results.setdefault(
+                            name, StreamResult(name, "rejected"))
+                if not progressed:
+                    blocker = next(
+                        (ln for ln in self.lanes.values()
+                         if not ln.done and ln.pending), None)
+                    if blocker is not None:
+                        blocker.step(allow_block=True)
+                        if blocker.done:
+                            self._on_lane_done(blocker)
+                    else:
+                        time.sleep(0.002)
+        finally:
+            metrics.set("fleet_running", 0)
+        return self.results
+
+    def close(self) -> None:
+        for lane in self.lanes.values():
+            lane.close()
+        self.plans.invalidate()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -301,12 +301,19 @@ class Pipeline:
         if getattr(cfg, "sanitize", False):
             from srtb_tpu.analysis.sanitizer import Sanitizer
             self.sanitizer = Sanitizer()
+        # multi-tenant stream identity (pipeline/fleet.py): the fleet
+        # names each lane's config; solo runs are unnamed and every
+        # labeled-twin bump below is a single None check
+        self.stream = str(getattr(cfg, "stream_name", "") or "")
+        self._stream_labels = ({"stream": self.stream}
+                               if self.stream else None)
         # resilience hooks, each None when off (same zero-cost-disabled
         # contract as the sanitizer): deterministic fault injection,
         # the retry policy for the six guarded sites, and the
-        # graceful-degradation ladder
+        # graceful-degradation ladder.  Fault-plan entries carrying a
+        # stream selector arm only in the matching lane.
         self.faults = FaultInjector.from_plan(
-            getattr(cfg, "fault_plan", ""))
+            getattr(cfg, "fault_plan", ""), stream=self.stream)
         self.retry = RetryPolicy.from_config(cfg)
         # self-healing compute (resilience/demote.py): plan demotion
         # for device OOM/compile faults, bounded backend reinit for
@@ -422,7 +429,11 @@ class Pipeline:
             metrics.add("signals")
         metrics.window("segments").add(1)
         metrics.window("samples").add(n_samples)
-        telemetry.mark_segment()
+        if self._stream_labels is not None:
+            metrics.add("segments", labels=self._stream_labels)
+            metrics.add("samples", n_samples,
+                        labels=self._stream_labels)
+        telemetry.mark_segment(self.stream or None)
         det_count = 0
         counts = getattr(det_res, "signal_counts", None)
         if counts is not None:
@@ -433,7 +444,8 @@ class Pipeline:
                 timestamp_ns=getattr(seg, "timestamp", 0),
                 overlap_hidden_s=overlap_hidden_s,
                 inflight_depth=inflight_depth,
-                active_plan=getattr(self.processor, "plan_name", None)))
+                active_plan=getattr(self.processor, "plan_name", None),
+                stream=self.stream or None))
 
     # ---------------------------------------------- async segment engine
 
@@ -499,7 +511,20 @@ class Pipeline:
         self._ring_invalidate()
         retire = getattr(old, "retire", None)
         if retire is not None and old is not newp:
+            # a fleet-SHARED processor no-ops its retire (other
+            # tenants still dispatch through it; segment.py guards)
             retire()
+
+    def _account_dropped(self, n: int = 1) -> None:
+        """Account ``n`` whole shed segments: the process-wide counter
+        + loss window, plus the per-stream labeled twin when this
+        pipeline is a named fleet lane (loss must be attributable to
+        its tenant)."""
+        metrics.add("segments_dropped", n)
+        metrics.window("segments_dropped").add(n)
+        if self._stream_labels is not None:
+            metrics.add("segments_dropped", n,
+                        labels=self._stream_labels)
 
     # ------------------------------------------------- ingest ring state
 
@@ -886,6 +911,9 @@ class Pipeline:
             with live_lock:
                 live[0] += n
                 metrics.set("inflight_depth", live[0])
+                if self._stream_labels is not None:
+                    metrics.set("inflight_depth", live[0],
+                                labels=self._stream_labels)
 
         # bounded-restart supervision of the sink pipe: a transient
         # crash restarts the worker (the failed item is replayed
@@ -984,8 +1012,7 @@ class Pipeline:
             next dispatch re-arms cold (an undispatched shed breaks
             the source-adjacency chain; an in-flight shed is just
             conservative hygiene, at one full upload's cost)."""
-            metrics.add("segments_dropped")
-            metrics.window("segments_dropped").add(1)
+            self._account_dropped()
             self._ring_invalidate()
             if in_flight:
                 live_add(-1)
@@ -1500,8 +1527,7 @@ class Pipeline:
                         with self._handoff_lock:
                             if drained[0] == progress[0]:
                                 held[-1].add("abandoned")
-                                metrics.add("segments_dropped")
-                                metrics.window("segments_dropped").add(1)
+                                self._account_dropped()
                                 live_add(-1)
                     log.error("[pipeline] wedged sink: still-queued "
                               "segments accounted as segments_dropped")
@@ -1578,6 +1604,9 @@ class Pipeline:
             # or replayed push re-enters with the original waterfall
             if done is None or "wf" not in done:
                 metrics.add("shed_waterfalls")
+                if self._stream_labels is not None:
+                    metrics.add("shed_waterfalls",
+                                labels=self._stream_labels)
                 if done is not None:
                     done.add("wf")
         full = SegmentResultWork(segment=seg, waterfall=wf,
@@ -1605,6 +1634,9 @@ class Pipeline:
                     continue
             if degrade_level >= 2 and getattr(sink, "sheddable", False):
                 metrics.add("shed_baseband")
+                if self._stream_labels is not None:
+                    metrics.add("shed_baseband",
+                                labels=self._stream_labels)
                 if done is not None:
                     done.add(i)
                 continue

@@ -917,6 +917,51 @@ class SegmentProcessor:
         "ingest_ring",
     )
 
+    @classmethod
+    def _trace_projection(cls, cfg) -> tuple[dict, dict]:
+        """The (config fields, env knobs) that shape the traced
+        programs — the ONE projection both :meth:`plan_signature` and
+        :meth:`plan_cache_key` are built from, so the fleet's shared-
+        plan safety claim ("equal cache keys imply equal signatures")
+        can never drift apart by a one-sided edit.  Only SRTB_* env
+        prefixes that shape traces are swept: keying on run-local
+        paths (SRTB_BENCH_*, SRTB_WATCH_LOG, the cache dir itself)
+        would silently miss on every deployment-environment
+        difference — the exact outage the AOT cache exists to
+        prevent."""
+        cfg_d = {k: getattr(cfg, k) for k in cls._TRACE_CFG_KEYS
+                 if hasattr(cfg, k)}
+        trace_prefixes = ("SRTB_STAGED", "SRTB_PALLAS", "SRTB_DIST",
+                          "SRTB_MXU")
+        knobs = {k: v for k, v in os.environ.items()
+                 if k.startswith(trace_prefixes)}
+        return cfg_d, knobs
+
+    @classmethod
+    def plan_cache_key(cls, cfg, window_name: str = W.DEFAULT_WINDOW,
+                       donate_input: bool = False) -> str:
+        """Conservative shared-plan cache key WITHOUT constructing a
+        processor: the trace projection + the constructor inputs.
+        Equal keys imply equal :meth:`plan_signature` — every derived
+        plan flag (staged, fused_tail, ring, skzap, hbm_passes)
+        resolves as a pure function of exactly these inputs and the
+        local platform — so the fleet's SharedPlanCache
+        (pipeline/fleet.py) can serve one compiled plan family to
+        every stream whose config projects identically, probing
+        nothing.  (The key is *finer* than the family only in the
+        degenerate sense that two DIFFERENT projections could resolve
+        to the same plan; those compile twice — correct, merely
+        unshared.)  Per-stream identity (stream_name, priority,
+        paths) is deliberately outside the projection: tenancy must
+        never split the plan cache."""
+        import json
+
+        cfg_d, knobs = cls._trace_projection(cfg)
+        return json.dumps(
+            {"cfg": cfg_d, "env": knobs, "window": window_name,
+             "donate_input": bool(donate_input)},
+            sort_keys=True, default=str)
+
     def plan_signature(self) -> str:
         """Stable string identifying everything that shapes the compiled
         programs: the trace-relevant config fields, the trace-shaping
@@ -924,17 +969,7 @@ class SegmentProcessor:
         cache cleanly and recompiles."""
         import json
 
-        cfg_d = {k: getattr(self.cfg, k) for k in self._TRACE_CFG_KEYS
-                 if hasattr(self.cfg, k)}
-        # only knobs that shape the traced programs: sweeping all
-        # SRTB_* would key the cache on run-local paths (SRTB_BENCH_*,
-        # SRTB_WATCH_LOG, the cache dir itself) and silently miss on
-        # every deployment-environment difference — the exact outage
-        # this cache exists to prevent
-        trace_prefixes = ("SRTB_STAGED", "SRTB_PALLAS", "SRTB_DIST",
-                          "SRTB_MXU")
-        knobs = {k: v for k, v in os.environ.items()
-                 if k.startswith(trace_prefixes)}
+        cfg_d, knobs = self._trace_projection(self.cfg)
         return json.dumps(
             {"cfg": cfg_d, "env": knobs, "staged": self.staged,
              "interp": self._pallas_interpret,
@@ -1459,7 +1494,19 @@ class SegmentProcessor:
         "_jit_stage_a_ring", "_jit_stage_a_cold", "_jit_batch_ring",
         "_jit_batch_cold")
 
-    def retire(self) -> None:
+    # set by SharedPlanCache.mark_shared(): this processor serves
+    # SEVERAL fleet streams at once, so one stream's plan demotion
+    # must not retire the programs its neighbors are still
+    # dispatching through (the bulkhead contract).  A fleet-wide
+    # device reinit retires shared processors too, via force=True.
+    _fleet_shared = False
+
+    def mark_shared(self) -> "SegmentProcessor":
+        """Flag this processor as fleet-shared (see retire)."""
+        self._fleet_shared = True
+        return self
+
+    def retire(self, force: bool = False) -> None:
         """Disarm a processor the pipeline has replaced (plan demotion,
         promotion probe, or device reinit — resilience/demote.py).
 
@@ -1471,7 +1518,14 @@ class SegmentProcessor:
         plan.  Host-side state (the staging pool, retained buffers) is
         left to the garbage collector: in-flight transfers may still
         reference those buffers, and a fresh processor owns fresh
-        pools."""
+        pools.
+
+        A fleet-SHARED processor (mark_shared) is a no-op here unless
+        ``force=True``: one stream swapping it out (demotion) leaves
+        the other tenants' dispatch path alive; only the fleet itself
+        retires the shared plan (device reinit, fleet close)."""
+        if self._fleet_shared and not force:
+            return
         def _dead(*_args, **_kwargs):
             raise RuntimeError(
                 "SegmentProcessor retired (plan demotion / device "

@@ -35,7 +35,15 @@ same property, in six composable pieces:
   jax exception strings) — on scheduled segment indices, zero-cost
   when off (the same None-hook pattern as the runtime sanitizer), so
   every recovery path above is testable on CPU CI
-  (``tools/chaos_soak.py`` composes them into randomized soaks).
+  (``tools/chaos_soak.py`` composes them into randomized soaks; an
+  optional stream selector ``beam3:dispatch:oom@4`` scopes an entry
+  to one fleet lane);
+- :mod:`admission` — the multi-tenant fleet's admission gate:
+  capacity-bounded concurrent streams with a priority-ordered wait
+  queue, every admit/queue/reject decision a stream-labeled counter
+  (``pipeline/fleet.py`` consumes it; ``degrade.FleetShedPolicy`` is
+  its overload-time twin, shedding the lowest-priority real-time
+  stream first under fleet-wide sink pressure).
 
 Everything is surfaced: retries, requeues, restarts, shed dumps, the
 degradation level, plan demotions/promotions, device reinits and the

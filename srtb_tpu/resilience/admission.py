@@ -1,0 +1,128 @@
+"""Admission control for the multi-tenant stream fleet.
+
+A device serving N concurrent streams (pipeline/fleet.py) has a hard
+capacity: every admitted stream holds an in-flight window of device
+buffers, and admitting one more tenant past that point degrades every
+existing one (the noisy-neighbor failure the bulkheads exist to
+prevent).  The admission gate makes that boundary explicit and FAIR:
+
+- up to ``Config.fleet_max_streams`` streams run concurrently
+  (0 = no limit — a dev box running two replay jobs needs no gate);
+- past capacity, new streams are **queued** (up to
+  ``Config.fleet_queue_limit`` slots) in priority order
+  (``Config.stream_priority``, higher first; FIFO within a priority)
+  and started as running streams finish;
+- past the queue, the LOWEST-priority request loses: a new request
+  that outranks the worst queued entry evicts it (the evictee is
+  rejected), otherwise the new request itself is rejected.
+
+Every decision is a counter with a ``stream`` label — an operator
+must be able to answer "who was turned away, and why" from /metrics
+alone.  Rejection is an ANSWER, not an error: the fleet reports
+rejected streams in its result instead of raising, so a submitting
+service can retry, re-prioritize, or route to another device.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from srtb_tpu.utils.logging import log
+from srtb_tpu.utils.metrics import metrics
+
+ADMIT = "admit"
+QUEUE = "queue"
+REJECT = "reject"
+
+
+class AdmissionController:
+    """Capacity gate + priority wait queue over stream names.
+
+    Not thread-safe by itself: the fleet scheduler (single-threaded)
+    is the only caller.
+    """
+
+    def __init__(self, max_streams: int = 0, queue_limit: int = 0):
+        self.max_streams = max(0, int(max_streams))
+        self.queue_limit = max(0, int(queue_limit))
+        self.running: set[str] = set()
+        # sort key: (-priority, arrival seq) — higher priority first,
+        # FIFO within a priority band
+        self._seq = itertools.count()
+        self._queue: list[tuple[int, int, str]] = []
+        self.rejected: list[str] = []
+
+    @classmethod
+    def from_config(cls, cfg) -> "AdmissionController":
+        return cls(
+            max_streams=int(getattr(cfg, "fleet_max_streams", 0) or 0),
+            queue_limit=int(getattr(cfg, "fleet_queue_limit", 0) or 0))
+
+    # ------------------------------------------------------- decisions
+
+    _COUNTERS = {ADMIT: "fleet_admitted", QUEUE: "fleet_queued",
+                 REJECT: "fleet_rejected"}
+
+    def _mark(self, decision: str, name: str) -> None:
+        counter = self._COUNTERS[decision]
+        metrics.add(counter)
+        metrics.add(counter, labels={"stream": name})
+        metrics.set("fleet_running", len(self.running))
+        metrics.set("fleet_queued_depth", len(self._queue))
+
+    def request(self, name: str, priority: int = 0) -> str:
+        """One stream asking to run; returns ADMIT / QUEUE / REJECT.
+        A queued stream surfaces later via :meth:`pop_ready` once
+        capacity frees up (the fleet starts its lane then)."""
+        if self.max_streams <= 0 or len(self.running) < self.max_streams:
+            self.running.add(name)
+            self._mark("admit", name)
+            return ADMIT
+        entry = (-int(priority), next(self._seq), name)
+        if len(self._queue) < self.queue_limit:
+            self._queue.append(entry)
+            self._queue.sort()
+            self._mark("queue", name)
+            log.info(f"[admission] fleet at capacity "
+                     f"({self.max_streams}): queued stream {name!r} "
+                     f"(priority {priority})")
+            return QUEUE
+        if self._queue and entry[:1] < self._queue[-1][:1]:
+            # the new request outranks the worst queued entry: the
+            # queue keeps the highest-priority waiters, the evictee
+            # is rejected in the newcomer's place
+            evicted = self._queue.pop()[-1]
+            self.rejected.append(evicted)
+            self._mark("reject", evicted)
+            log.warning(f"[admission] queued stream {evicted!r} "
+                        f"evicted by higher-priority {name!r}")
+            self._queue.append(entry)
+            self._queue.sort()
+            self._mark("queue", name)
+            return QUEUE
+        self.rejected.append(name)
+        self._mark("reject", name)
+        log.warning(f"[admission] fleet over capacity: rejected "
+                    f"stream {name!r} (priority {priority})")
+        return REJECT
+
+    def pop_ready(self) -> str | None:
+        """Highest-priority queued stream if capacity allows, else
+        None; the returned stream is immediately counted as running."""
+        if not self._queue or (self.max_streams > 0
+                               and len(self.running)
+                               >= self.max_streams):
+            return None
+        name = self._queue.pop(0)[-1]
+        self.running.add(name)
+        self._mark("admit", name)
+        return name
+
+    def release(self, name: str) -> None:
+        """A running stream finished (or failed): frees its slot."""
+        self.running.discard(name)
+        metrics.set("fleet_running", len(self.running))
+
+    @property
+    def queued(self) -> list[str]:
+        return [name for _, _, name in self._queue]

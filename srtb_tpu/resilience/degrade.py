@@ -59,7 +59,7 @@ class DegradationLadder:
     observations of sink backlog and loss state."""
 
     def __init__(self, high: float = 0.9, low: float = 0.25,
-                 hold: int = 3):
+                 hold: int = 3, stream: str = ""):
         if not 0.0 <= low < high <= 1.0:
             raise ValueError(f"need 0 <= low < high <= 1, got "
                              f"low={low} high={high}")
@@ -69,13 +69,23 @@ class DegradationLadder:
         self.level = 0
         self._above = 0
         self._below = 0
-        metrics.set("degrade_level", 0)
+        # per-stream twin of the degrade_level gauge (multi-tenant
+        # fleet): the flat gauge stays process-wide for solo runs,
+        # the labeled one names the tenant
+        self._labels = {"stream": stream} if stream else None
+        self._set_gauge(0)
+
+    def _set_gauge(self, level: int) -> None:
+        metrics.set("degrade_level", level)
+        if self._labels is not None:
+            metrics.set("degrade_level", level, labels=self._labels)
 
     @classmethod
     def from_config(cls, cfg) -> "DegradationLadder":
         return cls(high=float(getattr(cfg, "degrade_queue_high", 0.9)),
                    low=float(getattr(cfg, "degrade_queue_low", 0.25)),
-                   hold=int(getattr(cfg, "degrade_hold_segments", 3)))
+                   hold=int(getattr(cfg, "degrade_hold_segments", 3)),
+                   stream=str(getattr(cfg, "stream_name", "") or ""))
 
     def observe(self, occupancy: float, loss_active: bool) -> int:
         """One per-drained-segment observation; returns the (possibly
@@ -107,5 +117,87 @@ class DegradationLadder:
             metrics.add("degrade_recoveries")
             log.info(f"[degrade] pressure cleared: recovering to level "
                      f"{self.level} ({LEVELS[self.level]})")
-        metrics.set("degrade_level", self.level)
+        self._set_gauge(self.level)
         return self.level
+
+
+class FleetShedPolicy:
+    """Cross-stream fairness under fleet-wide sink pressure
+    (pipeline/fleet.py): when the FLEET as a whole is drowning — a
+    sustained fraction of lanes reporting sink pressure or active
+    accounted loss — shed the lowest-priority REAL-TIME stream first
+    (force its ladder to ``shed_segments``), instead of letting every
+    tenant degrade a little and the overload land arbitrarily.
+
+    Same hysteresis discipline as the per-stream ladder: ``hold``
+    consecutive pressured observations shed one more stream (lowest
+    priority first, name as tie-break for determinism); ``hold``
+    consecutive relieved observations restore one (highest priority
+    first).  File-mode streams throttle losslessly by design and are
+    never shed (the per-stream ladder's real_time rule, applied
+    fleet-wide).  Every transition is a counter with a ``stream``
+    label — fleet shedding that is not attributable per tenant is
+    just noisy-neighbor loss with better marketing."""
+
+    def __init__(self, high: float = 0.9, low: float = 0.25,
+                 hold: int = 3):
+        if not 0.0 <= low < high <= 1.0:
+            raise ValueError(f"need 0 <= low < high <= 1, got "
+                             f"low={low} high={high}")
+        self.high = float(high)
+        self.low = float(low)
+        self.hold = max(1, int(hold))
+        self._above = 0
+        self._below = 0
+        self.shed: set[str] = set()
+
+    @classmethod
+    def from_config(cls, cfg) -> "FleetShedPolicy":
+        return cls(high=float(getattr(cfg, "degrade_queue_high", 0.9)),
+                   low=float(getattr(cfg, "degrade_queue_low", 0.25)),
+                   hold=int(getattr(cfg, "degrade_hold_segments", 3)))
+
+    def observe(self, pressure: float, loss_active: bool,
+                lanes: list[tuple[str, int, bool]]) -> set[str]:
+        """One fleet-scheduler observation.  ``pressure`` is the
+        fraction of running lanes that waited on their sink since the
+        last observation; ``lanes`` is [(name, priority, real_time)]
+        for every RUNNING lane.  Returns the set of stream names
+        currently force-shed (their lanes drop whole segments as
+        accounted per-stream loss until restored)."""
+        live = {name for name, _, _ in lanes}
+        self.shed &= live  # finished lanes leave the shed set
+        sheddable = sorted(
+            ((prio, name) for name, prio, rt in lanes
+             if rt and name not in self.shed))
+        restorable = sorted(
+            ((prio, name) for name, prio, _ in lanes
+             if name in self.shed), reverse=True)
+        if pressure >= self.high or loss_active:
+            self._above += 1
+            self._below = 0
+        elif pressure <= self.low and not loss_active:
+            self._below += 1
+            self._above = 0
+        else:
+            self._above = self._below = 0
+        if self._above >= self.hold and sheddable:
+            prio, name = sheddable[0]
+            self.shed.add(name)
+            self._above = 0
+            metrics.add("fleet_sheds")
+            metrics.add("fleet_sheds", labels={"stream": name})
+            log.warning(
+                f"[fleet] sustained fleet pressure {pressure:.2f} "
+                f"(loss={loss_active}): shedding lowest-priority "
+                f"real-time stream {name!r} (priority {prio})")
+        elif self._below >= self.hold and restorable:
+            prio, name = restorable[0]
+            self.shed.discard(name)
+            self._below = 0
+            metrics.add("fleet_restores")
+            metrics.add("fleet_restores", labels={"stream": name})
+            log.info(f"[fleet] pressure cleared: restoring stream "
+                     f"{name!r} (priority {prio})")
+        metrics.set("fleet_shed_streams", len(self.shed))
+        return set(self.shed)

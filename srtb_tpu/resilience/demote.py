@@ -237,7 +237,24 @@ class ComputeHealer:
             self._reinit = Supervisor(
                 "device_reinit", max_restarts=int(reinit_max),
                 window_s=float(reinit_window_s), counter=None)
+        # per-stream twins (multi-tenant fleet): the flat series stay
+        # process-wide; the labeled ones attribute demotions/ladder
+        # position to the tenant whose device fault caused them
+        stream = str(getattr(cfg, "stream_name", "") or "")
+        self._labels = {"stream": stream} if stream else None
         metrics.set("plan_ladder_level", 0)
+        if self._labels is not None:
+            metrics.set("plan_ladder_level", 0, labels=self._labels)
+
+    def _mark(self, counter: str | None) -> None:
+        if counter is not None:
+            metrics.add(counter)
+        metrics.set("plan_ladder_level", self._level)
+        if self._labels is not None:
+            if counter is not None:
+                metrics.add(counter, labels=self._labels)
+            metrics.set("plan_ladder_level", self._level,
+                        labels=self._labels)
 
     @classmethod
     def from_config(cls, cfg, factory) -> "ComputeHealer | None":
@@ -322,8 +339,7 @@ class ComputeHealer:
         self._level += 1
         self._healthy = 0
         rung = self._rungs[self._level - 1]
-        metrics.add("plan_demotions")
-        metrics.set("plan_ladder_level", self._level)
+        self._mark("plan_demotions")
         log.warning(
             f"[selfheal] device fault ({kind}) — demoting to ladder "
             f"rung {self._level}/{len(self._rungs)} ({rung.step}): "
@@ -340,9 +356,20 @@ class ComputeHealer:
                 not self._reinit.should_restart(exc):
             return None
         metrics.add("device_reinits")
+        if self._labels is not None:
+            metrics.add("device_reinits", labels=self._labels)
         log.warning(
             f"[selfheal] device halt — reinitializing backend at "
             f"ladder rung {self._level} ({self.active_step}): {exc!r}")
+        return self._build(self._level)
+
+    def rebuild(self):
+        """Fresh processor at the CURRENT rung, with no budget check
+        and no counters: the fleet's SHARED device reinit
+        (pipeline/fleet.py) makes one budgeted decision for the whole
+        device and then rebuilds every lane — charging each lane's own
+        reinit budget for a fault it didn't cause would let one
+        flapping neighbor bankrupt the fleet."""
         return self._build(self._level)
 
     # --------------------------------------------- promotion probe
@@ -364,8 +391,7 @@ class ComputeHealer:
             return None
         self._level -= 1
         self._healthy = 0
-        metrics.add("plan_promotions")
-        metrics.set("plan_ladder_level", self._level)
+        self._mark("plan_promotions")
         log.info(
             f"[selfheal] {self.promote_after} healthy segments — "
             f"promotion probe back to rung {self._level} "

@@ -8,8 +8,16 @@ with ``tools/udp_soak.py --fault-plan``.
 
 Plan syntax (comma-separated entries)::
 
-    site:action@index
+    [stream:]site:action@index
 
+- ``stream``  OPTIONAL stream selector (multi-tenant fleet): the entry
+              fires only in the pipeline whose ``Config.stream_name``
+              matches (e.g. ``stream0:dispatch:oom@3`` hits only the
+              fleet's "stream0" lane).  Entries without a selector
+              keep the existing semantics — they arm in every pipeline
+              the plan reaches — so existing soaks and tests are
+              untouched.  Any prefix that is not a known site name is
+              read as a stream selector;
 - ``site``    one of ``ingest``, ``h2d``, ``dispatch``, ``fetch``,
               ``sink_write``, ``checkpoint`` — the hook points wired
               through pipeline/runtime.py;
@@ -102,12 +110,14 @@ class FaultSpec:
     action: str
     index: int
     arg: float = 0.0     # stall duration
+    stream: str | None = None   # None = every pipeline (legacy)
     fired: bool = field(default=False, compare=False)
 
     def __str__(self) -> str:
         a = (f"{self.action}={self.arg:g}" if self.action == "stall"
              else self.action)
-        return f"{self.site}:{a}@{self.index}"
+        pre = f"{self.stream}:" if self.stream else ""
+        return f"{pre}{self.site}:{a}@{self.index}"
 
 
 def parse_plan(text: str) -> list[FaultSpec]:
@@ -120,6 +130,11 @@ def parse_plan(text: str) -> list[FaultSpec]:
             continue
         try:
             site, rest = entry.split(":", 1)
+            stream = None
+            if site.strip() not in SITES and ":" in rest:
+                # leading stream selector: "stream0:dispatch:oom@3"
+                stream, site, rest = site, *rest.split(":", 1)
+                stream = stream.strip()
             action, idx = rest.rsplit("@", 1)
             arg = 0.0
             if "=" in action:
@@ -130,8 +145,8 @@ def parse_plan(text: str) -> list[FaultSpec]:
         except ValueError as e:
             raise ValueError(
                 f"fault_plan entry {entry!r}: expected "
-                "'site:action@index' with action raise|fatal|corrupt|"
-                f"stall=SECONDS ({e})") from e
+                "'[stream:]site:action@index' with action raise|fatal|"
+                f"corrupt|stall=SECONDS ({e})") from e
         if site not in SITES:
             raise ValueError(f"fault_plan entry {entry!r}: unknown site "
                              f"{site!r} (sites: {', '.join(SITES)})")
@@ -147,7 +162,7 @@ def parse_plan(text: str) -> list[FaultSpec]:
                 f"fault_plan entry {entry!r}: device-fault action "
                 f"{action!r} only fires at a device site "
                 f"({', '.join(DEVICE_SITES)})")
-        specs.append(FaultSpec(site, action, index, arg))
+        specs.append(FaultSpec(site, action, index, arg, stream))
     return specs
 
 
@@ -168,11 +183,21 @@ class FaultInjector:
             site[s.index] = s
 
     @classmethod
-    def from_plan(cls, text: str) -> "FaultInjector | None":
-        """None (zero-cost off) for an empty plan."""
+    def from_plan(cls, text: str,
+                  stream: str = "") -> "FaultInjector | None":
+        """None (zero-cost off) for an empty plan, or when every entry
+        is scoped to some OTHER stream.  ``stream`` is this pipeline's
+        ``Config.stream_name``: entries without a selector always arm
+        (legacy semantics); entries with one arm only in the matching
+        pipeline — the fleet hands each lane the whole plan and each
+        lane keeps exactly its own faults."""
         if not text or not text.strip():
             return None
-        return cls(parse_plan(text))
+        specs = [s for s in parse_plan(text)
+                 if s.stream is None or s.stream == stream]
+        if not specs:
+            return None
+        return cls(specs)
 
     def armed(self, site: str) -> bool:
         return site in self._by_site

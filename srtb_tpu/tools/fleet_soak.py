@@ -1,0 +1,400 @@
+"""Fleet chaos soak: cross-stream blast-radius gate.
+
+The multi-tenant contract of :mod:`srtb_tpu.pipeline.fleet` is that a
+faulty stream's blast radius is exactly itself.  This harness proves
+it end-to-end: N seeded streams (distinct baseband, shared plan
+family) run (1) each SOLO through the single-stream ``Pipeline`` —
+the golden reference — and then (2) together through a
+``StreamFleet`` with a fault plan injected into ONE victim stream
+(stream-selector scoped, e.g. ``victim:dispatch:oom@1``).  The gate:
+
+- **(a) healthy isolation**: every healthy stream's final output set
+  (relative paths + SHA-256) is BIT-identical to its solo golden run
+  — scheduling N tenants onto one device, with a neighbor faulting,
+  changed nothing for the innocent;
+- **(b) victim accounting**: the victim's loss is accounted-only
+  (drained + dropped == source segments, nothing vanishes), its
+  detection DECISIONS match its solo run exactly (recovery may change
+  the plan, never the science), and the demotions/sheds are
+  attributed to the victim's stream id in the v6 journal (healthy
+  journals carry zero);
+- **(c) shared plan economy**: the fleet's plan cache records exactly
+  ONE compile for the shared plan family across all streams
+  (``hits == N - 1``).
+
+``--selftest`` proves the gate is sharp: an UNSCOPED fault plan (no
+stream selector — it arms in every lane) must FAIL the healthy-
+journal attribution check, and a scoped single-oom run must pass.
+
+``--ab`` instead runs the steady-state single-stream A/B (fleet
+engine with N=1 vs the solo ``Pipeline``) and reports both medians —
+the PERF.md round-15 measurement.
+
+Usage::
+
+    python -m srtb_tpu.tools.fleet_soak [--streams N] [--segments N]
+        [--log2n N] [--plan PLAN] [--selftest] [--ab [--reps R]]
+
+Exit 0 on a passing gate (or sharp selftest), 1 on any failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+
+class SoakFailure(AssertionError):
+    """One broken fleet invariant (the gate)."""
+
+
+def _stream_names(n: int) -> list[str]:
+    # stream0 is always the victim (matching the default --plan)
+    return [f"stream{i}" for i in range(n)]
+
+
+def make_deterministic_source(cfg):
+    """File source with offset-derived timestamps, so artifact names
+    reproduce across the solo and fleet runs (same convention as
+    tools/crash_soak.py)."""
+    from srtb_tpu.io.file_input import BasebandFileReader
+
+    class DeterministicTimestampReader(BasebandFileReader):
+        def __next__(self):
+            offset = self.logical_offset
+            work = super().__next__()
+            work.timestamp = 1_700_000_000_000_000_000 + offset
+            return work
+
+    return DeterministicTimestampReader(cfg)
+
+
+def _cfg(tmp: str, name: str, run_dir: str, n: int, **extra):
+    from srtb_tpu.config import Config
+    base = dict(
+        baseband_input_count=n, baseband_input_bits=8,
+        baseband_freq_low=1405.0, baseband_bandwidth=64.0,
+        baseband_sample_rate=128e6, dm=0.05,
+        input_file_path=os.path.join(tmp, f"bb_{name}.bin"),
+        baseband_output_file_prefix=os.path.join(run_dir, "out_"),
+        spectrum_channel_count=64,
+        # every segment must write artifacts (deterministically) so
+        # the bit-identical union is a real comparison, not vacuous
+        mitigate_rfi_average_method_threshold=1000.0,
+        mitigate_rfi_spectral_kurtosis_threshold=50.0,
+        signal_detect_signal_noise_threshold=1.5,
+        signal_detect_max_boxcar_length=8,
+        baseband_reserve_sample=True,
+        writer_thread_count=0,
+        fft_strategy="four_step",
+        inflight_segments=2,
+        retry_backoff_base_s=0.001,
+        checkpoint_path=os.path.join(run_dir, "ck.json"),
+        run_manifest_path=os.path.join(run_dir, "manifest.jsonl"),
+    )
+    base.update(extra)
+    return Config(**base)
+
+
+def _synthesize(tmp: str, names: list[str], n: int, segments: int,
+                seed: int) -> None:
+    from srtb_tpu.io.synth import make_dispersed_baseband
+    for i, name in enumerate(names):
+        make_dispersed_baseband(
+            n * segments, 1405.0, 64.0, 0.05,
+            pulse_positions=[n // 2 + j * n for j in range(segments)],
+            pulse_amp=30.0, nbits=8, seed=seed * 1000 + i,
+        ).tofile(os.path.join(tmp, f"bb_{name}.bin"))
+
+
+class _DecisionTap:
+    """Pass-through sink recording detection decisions (rides NEXT TO
+    the real writer sinks, so artifacts still land on disk)."""
+
+    wants_waterfall = False
+
+    def __init__(self):
+        self.out = []
+
+    def push(self, work, positive):
+        det = work.detect
+        self.out.append((np.asarray(det.signal_counts).copy(),
+                         np.asarray(det.zero_count).copy(),
+                         bool(positive)))
+
+
+def _solo_run(cfg) -> tuple:
+    """One golden single-stream run; returns (stats, decisions)."""
+    from srtb_tpu.io.writers import WriteSignalSink
+    from srtb_tpu.pipeline.runtime import Pipeline
+    from srtb_tpu.utils.metrics import metrics
+    metrics.reset()
+    tap = _DecisionTap()
+    sinks = [WriteSignalSink(cfg), tap]
+    with Pipeline(cfg, source=make_deterministic_source(cfg),
+                  sinks=sinks) as pipe:
+        stats = pipe.run()
+    return stats, tap.out
+
+
+def run_soak(streams: int = 3, segments: int = 5, log2n: int = 13,
+             plan: str | None = None, seed: int = 0,
+             tmpdir: str | None = None) -> dict:
+    """One full soak (solo goldens + fleet run + the gate).  Returns
+    the report dict; raises :class:`SoakFailure` on any broken
+    invariant."""
+    from srtb_tpu.io.writers import WriteSignalSink
+    from srtb_tpu.pipeline.fleet import StreamFleet, StreamSpec
+    from srtb_tpu.resilience.faults import parse_plan
+    from srtb_tpu.tools.crash_soak import snapshot_outputs
+    from srtb_tpu.utils.metrics import metrics
+
+    tmp = tmpdir or tempfile.mkdtemp(prefix="srtb_fleet_")
+    n = 1 << log2n
+    names = _stream_names(streams)
+    victim = names[0]
+    if plan is None:
+        plan = (f"{victim}:dispatch:oom@1,"
+                f"{victim}:sink_write:raise@2,"
+                f"{victim}:fetch:stall=0.05@3")
+    specs_parsed = parse_plan(plan)
+    victims = {s.stream for s in specs_parsed if s.stream is not None}
+    n_demote = sum(1 for s in specs_parsed
+                   if s.action in ("oom", "compile_fail"))
+    _synthesize(tmp, names, n, segments, seed)
+
+    # ---- solo goldens (per-stream run dirs, identical rel names)
+    solo_out: dict[str, dict] = {}
+    solo_dec: dict[str, list] = {}
+    solo_segs: dict[str, int] = {}
+    for name in names:
+        run_dir = os.path.join(tmp, f"solo_{name}")
+        os.makedirs(run_dir, exist_ok=True)
+        stats, dec = _solo_run(_cfg(tmp, name, run_dir, n))
+        solo_out[name] = snapshot_outputs(run_dir)
+        solo_dec[name] = dec
+        # overlap-save re-reads reserved tails, so the stream yields
+        # MORE segments than the synthesized count — the solo run is
+        # the authority on how many a lossless run drains
+        solo_segs[name] = int(stats.segments)
+        if not solo_out[name]:
+            raise SoakFailure(
+                f"solo run of {name} wrote NO artifacts — the "
+                "bit-identical gate would be vacuous")
+
+    # ---- fleet run, victim faulted
+    metrics.reset()
+    specs = []
+    taps: dict[str, _DecisionTap] = {}
+    jpaths: dict[str, str] = {}
+    for name in names:
+        run_dir = os.path.join(tmp, f"fleet_{name}")
+        os.makedirs(run_dir, exist_ok=True)
+        jpaths[name] = os.path.join(tmp, f"journal_{name}.jsonl")
+        cfg = _cfg(tmp, name, run_dir, n, fault_plan=plan,
+                   telemetry_journal_path=jpaths[name])
+        taps[name] = _DecisionTap()
+        specs.append(StreamSpec(
+            name=name, cfg=cfg,
+            source=make_deterministic_source(cfg),
+            sinks=[WriteSignalSink(cfg), taps[name]]))
+    fleet = StreamFleet(specs)
+    results = fleet.run()
+    fleet.close()
+    compiles, hits = fleet.plans.compiles, fleet.plans.hits
+    dropped_by = metrics.by_label("segments_dropped")
+
+    def check(cond, msg):
+        if not cond:
+            raise SoakFailure(msg)
+
+    for name in names:
+        check(results[name].status == "done",
+              f"stream {name} did not finish: {results[name].status} "
+              f"({results[name].error!r})")
+
+    # (a) healthy streams: output sets bit-identical to solo
+    for name in names:
+        if name in victims:
+            continue
+        fleet_set = snapshot_outputs(os.path.join(tmp, f"fleet_{name}"))
+        check(fleet_set == solo_out[name],
+              f"healthy stream {name}: fleet output set differs from "
+              f"its solo golden run (fleet {sorted(fleet_set)} vs "
+              f"solo {sorted(solo_out[name])})")
+        for i, (a, b) in enumerate(zip(taps[name].out,
+                                       solo_dec[name])):
+            check(np.array_equal(a[0], b[0])
+                  and np.array_equal(a[1], b[1]) and a[2] == b[2],
+                  f"healthy stream {name}: decision differs at "
+                  f"segment {i}")
+
+    # (b) victim: accounted-only loss, decisions exact, journal
+    # attribution
+    for name in victims:
+        res = results[name]
+        vdropped = int(dropped_by.get(name, 0))
+        check(res.drained + vdropped == solo_segs[name],
+              f"victim {name}: loss not accounted — {res.drained} "
+              f"drained + {vdropped} dropped != {solo_segs[name]} "
+              "source segments")
+        for i, (a, b) in enumerate(zip(taps[name].out,
+                                       solo_dec[name])):
+            check(np.array_equal(a[0], b[0])
+                  and np.array_equal(a[1], b[1]) and a[2] == b[2],
+                  f"victim {name}: detection decision differs at "
+                  f"segment {i} (recovery changed the science)")
+    for name in names:
+        recs = [json.loads(line) for line in open(jpaths[name])
+                if line.strip().startswith("{")]
+        check(recs and all(r.get("stream") == name and r["v"] == 6
+                           for r in recs),
+              f"stream {name}: v6 journal records not stream-stamped")
+        total_demote = int(recs[-1].get("plan_demotions", 0))
+        if name in victims:
+            check(total_demote == n_demote,
+                  f"victim {name}: journal plan_demotions "
+                  f"{total_demote} != {n_demote} injected")
+        else:
+            check(total_demote == 0,
+                  f"healthy stream {name}: journal attributes "
+                  f"{total_demote} demotions — the victim's fault "
+                  "leaked into a neighbor's books")
+
+    # (c) shared plan cache: one compile per family
+    check(compiles == 1,
+          f"plan cache recorded {compiles} compiles for one shared "
+          "plan family (expected exactly 1)")
+    check(hits == streams - 1,
+          f"plan cache hits {hits} != {streams - 1} "
+          "(every non-first stream must reuse the shared plan)")
+
+    return {
+        "streams": streams, "segments": segments, "plan": plan,
+        "victims": sorted(victims),
+        "drained": {k: results[k].drained for k in names},
+        "dropped": {k: int(dropped_by.get(k, 0)) for k in names},
+        "plan_compiles": compiles, "plan_cache_hits": hits,
+        "ok": True,
+    }
+
+
+def selftest(log2n: int = 12) -> list[str]:
+    """Prove the gate is sharp.  (a) an UNSCOPED oom (no stream
+    selector) arms in every lane, so healthy lanes demote too and the
+    journal-attribution check must fail; (b) the scoped default plan
+    must pass (the gate is not simply failing everything)."""
+    failures = []
+    try:
+        run_soak(streams=2, segments=3, log2n=log2n,
+                 plan="dispatch:oom@1")
+        failures.append(
+            "gate passed an UNSCOPED fault plan — cross-stream "
+            "fault leakage went unnoticed")
+    except SoakFailure:
+        pass  # caught, as required
+    try:
+        run_soak(streams=2, segments=3, log2n=log2n,
+                 plan="stream0:dispatch:oom@1")
+    except Exception as e:  # noqa: BLE001 - reported, not raised
+        failures.append(f"scoped single-oom soak did not pass: {e!r}")
+    return failures
+
+
+def run_ab(segments: int = 20, log2n: int = 13, reps: int = 3) -> dict:
+    """Steady-state single-stream A/B: fleet engine with N=1 vs the
+    solo Pipeline, same config/data, median-of-reps seg/s each."""
+    import time
+
+    from srtb_tpu.io.writers import WriteSignalSink
+    from srtb_tpu.pipeline.fleet import StreamFleet, StreamSpec
+    from srtb_tpu.pipeline.runtime import Pipeline
+    from srtb_tpu.utils.metrics import metrics
+
+    tmp = tempfile.mkdtemp(prefix="srtb_fleet_ab_")
+    n = 1 << log2n
+    _synthesize(tmp, ["ab"], n, segments, seed=0)
+
+    def one_solo() -> float:
+        run_dir = tempfile.mkdtemp(dir=tmp)
+        cfg = _cfg(tmp, "ab", run_dir, n, checkpoint_path="",
+                   run_manifest_path="")
+        metrics.reset()
+        t0 = time.perf_counter()
+        with Pipeline(cfg, source=make_deterministic_source(cfg),
+                      sinks=[WriteSignalSink(cfg)]) as pipe:
+            stats = pipe.run()
+        return stats.segments / (time.perf_counter() - t0)
+
+    def one_fleet() -> float:
+        run_dir = tempfile.mkdtemp(dir=tmp)
+        cfg = _cfg(tmp, "ab", run_dir, n, checkpoint_path="",
+                   run_manifest_path="")
+        metrics.reset()
+        t0 = time.perf_counter()
+        fleet = StreamFleet([StreamSpec(
+            name="ab", cfg=cfg, source=make_deterministic_source(cfg),
+            sinks=[WriteSignalSink(cfg)])])
+        res = fleet.run()
+        fleet.close()
+        return res["ab"].drained / (time.perf_counter() - t0)
+
+    solo = sorted(one_solo() for _ in range(reps))[reps // 2]
+    fleet = sorted(one_fleet() for _ in range(reps))[reps // 2]
+    return {"solo_seg_per_s": round(solo, 2),
+            "fleet_n1_seg_per_s": round(fleet, 2),
+            "delta_pct": round((fleet - solo) / solo * 100, 2),
+            "segments": segments, "log2n": log2n, "reps": reps}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fleet-soak",
+        description="multi-tenant fleet blast-radius gate "
+                    "(see srtb_tpu/tools/fleet_soak.py)")
+    ap.add_argument("--streams", type=int, default=3)
+    ap.add_argument("--segments", type=int, default=5)
+    ap.add_argument("--log2n", type=int, default=13)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--plan", default=None,
+                    help="explicit fault plan (stream-selector scoped;"
+                         " default faults stream0)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="prove the gate catches cross-stream leakage")
+    ap.add_argument("--ab", action="store_true",
+                    help="single-stream A/B: fleet N=1 vs Pipeline")
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        fails = selftest()
+        for f in fails:
+            print(f"fleet-soak selftest: {f}", file=sys.stderr)
+        print("fleet-soak selftest: "
+              + ("FAILED" if fails else
+                 "OK — cross-stream leakage fails the gate"))
+        return 1 if fails else 0
+    if args.ab:
+        print(json.dumps(run_ab(segments=args.segments * 4,
+                                log2n=args.log2n, reps=args.reps),
+                         sort_keys=True))
+        return 0
+    try:
+        report = run_soak(streams=args.streams, segments=args.segments,
+                          log2n=args.log2n, plan=args.plan,
+                          seed=args.seed)
+    except SoakFailure as e:
+        print(json.dumps({"ok": False, "failure": str(e)}))
+        print(f"fleet-soak: GATE FAILED — {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(report, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -24,8 +24,14 @@ pipeline writes (one record per segment) and reports
   segments recovered beyond the checkpoint, sink pushes skipped on
   replay, uncommitted intents rolled back (all zero on a run that
   never crashed).
+- fleet (schema-v6 spans): per-stream breakdown for multi-tenant
+  runs — spans, detections, loss, demotions and degrade levels
+  grouped by the ``stream`` field (in a NAMED span the cumulative
+  attribution fields are the stream's own labeled series, so each
+  tenant's books balance independently); feed it one lane's journal
+  or several lanes' merged.
 
-Mixed v1-v5 journals (rotation can leave an older-schema tail
+Mixed v1-v6 journals (rotation can leave an older-schema tail
 after an upgrade) are summarized tolerantly: records simply lack the
 newer fields and drop out of the sections that need them.
 
@@ -302,6 +308,40 @@ def durability_stats(records: list[dict]) -> dict:
     }
 
 
+def fleet_stats(records: list[dict]) -> dict:
+    """Per-stream breakdown from v6 spans (the multi-tenant fleet).
+    Records without a ``stream`` field (v1-v5, or unnamed solo runs)
+    are skipped; empty dict when none qualify.  Cumulative fields in
+    a named span are the stream's OWN series (telemetry.segment_span
+    v6), so the last record per stream carries that tenant's totals."""
+    by_stream: dict[str, list[dict]] = {}
+    for r in records:
+        s = r.get("stream")
+        if s is not None:
+            by_stream.setdefault(str(s), []).append(r)
+    if not by_stream:
+        return {}
+    out = {}
+    for s, recs in sorted(by_stream.items()):
+        last = recs[-1]
+        levels = [int(r.get("degrade_level", 0)) for r in recs]
+        out[s] = {
+            "records": len(recs),
+            "detections": sum(int(r.get("detections", 0))
+                              for r in recs),
+            "dumps": sum(1 for r in recs if r.get("dump")),
+            "segments_dropped": int(last.get("segments_dropped", 0)),
+            "shed_waterfalls": int(last.get("shed_waterfalls", 0)),
+            "shed_baseband": int(last.get("shed_baseband", 0)),
+            "plan_demotions": int(last.get("plan_demotions", 0)),
+            "device_reinits": int(last.get("device_reinits", 0)),
+            "degrade_level_max": max(levels),
+            "plan_ladder_level_last":
+                int(last.get("plan_ladder_level", 0)),
+        }
+    return out
+
+
 def report(path: str, bin_s: float = 10.0) -> dict:
     records = load(path)
     return {
@@ -312,6 +352,7 @@ def report(path: str, bin_s: float = 10.0) -> dict:
         "resilience": resilience_stats(records),
         "compute": compute_stats(records),
         "durability": durability_stats(records),
+        "fleet": fleet_stats(records),
         "timeline": timeline(records, bin_s),
     }
 
@@ -370,6 +411,19 @@ def _md(rep: dict) -> str:
                   f"recovered segments: {ds['recovered_segments']}, "
                   f"replayed skips: {ds['replayed_skips']}, "
                   f"rolled-back intents: {ds['rolled_back_intents']}"]
+    fl = rep.get("fleet") or {}
+    if fl:
+        lines += ["", "## Fleet (per-stream)", "",
+                  "| stream | spans | detections | dumps | dropped | "
+                  "demotions | reinits | degrade max | ladder |",
+                  "|---|---|---|---|---|---|---|---|---|"]
+        for s, st in fl.items():
+            lines.append(
+                f"| {s} | {st['records']} | {st['detections']} | "
+                f"{st['dumps']} | {st['segments_dropped']} | "
+                f"{st['plan_demotions']} | {st['device_reinits']} | "
+                f"{st['degrade_level_max']} | "
+                f"{st['plan_ladder_level_last']} |")
     lines += ["", "## Throughput timeline", "",
               "| t (s) | segments | seg/s | Msamples/s | detections | "
               "dumps | pkts lost |", "|---|---|---|---|---|---|---|"]

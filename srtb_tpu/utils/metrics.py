@@ -163,21 +163,56 @@ class Metrics:
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: dict[str, float] = {}
+        # labeled scalar series (multi-tenant fleet: the same counter
+        # name per stream, e.g. segments_dropped{stream="beam3"}),
+        # keyed (name, sorted-label-items).  Deliberately SEPARATE
+        # from the flat series: a labeled bump never moves the
+        # process-wide total — call sites that want both bump both,
+        # so single-stream dashboards keep their exact semantics.
+        self._labeled: dict[tuple, float] = {}
         self._histograms: dict[tuple, Histogram] = {}
         self._windows: dict[str, SlidingWindow] = {}
         self._start = time.monotonic()
 
-    def add(self, name: str, value: float = 1.0) -> None:
+    def add(self, name: str, value: float = 1.0,
+            labels: dict | None = None) -> None:
         with self._lock:
-            self._counters[name] = self._counters.get(name, 0.0) + value
+            if labels:
+                key = (name, _label_key(labels))
+                self._labeled[key] = self._labeled.get(key, 0.0) + value
+            else:
+                self._counters[name] = (self._counters.get(name, 0.0)
+                                        + value)
 
-    def set(self, name: str, value: float) -> None:
+    def set(self, name: str, value: float,
+            labels: dict | None = None) -> None:
         with self._lock:
-            self._counters[name] = value
+            if labels:
+                self._labeled[(name, _label_key(labels))] = value
+            else:
+                self._counters[name] = value
 
-    def get(self, name: str) -> float:
+    def get(self, name: str, labels: dict | None = None) -> float:
         with self._lock:
+            if labels:
+                return self._labeled.get((name, _label_key(labels)),
+                                         0.0)
             return self._counters.get(name, 0.0)
+
+    def labeled_series(self, name: str) -> list:
+        """[(labels_dict, value)] for every labeled series of ``name``
+        (sorted by label key for determinism)."""
+        with self._lock:
+            out = [(lk, v) for (n, lk), v in self._labeled.items()
+                   if n == name]
+        return [(dict(lk), v) for lk, v in sorted(out)]
+
+    def by_label(self, name: str, label: str = "stream") -> dict:
+        """label-value -> metric value over the labeled series of
+        ``name`` (e.g. per-stream loss: ``by_label(
+        "segments_dropped")`` -> {"beam3": 2.0, ...})."""
+        return {d[label]: v for d, v in self.labeled_series(name)
+                if label in d}
 
     def histogram(self, name: str, buckets=DEFAULT_TIME_BUCKETS,
                   labels: dict | None = None) -> Histogram:
@@ -207,6 +242,7 @@ class Metrics:
         observation run)."""
         with self._lock:
             self._counters.clear()
+            self._labeled.clear()
             self._histograms.clear()
             self._windows.clear()
             self._start = time.monotonic()
@@ -218,6 +254,7 @@ class Metrics:
         and Prometheus views can never drift apart."""
         with self._lock:
             out = dict(self._counters)
+            labeled = dict(self._labeled)
             hists = list(self._histograms.values())
             windows = list(self._windows.values())
         elapsed = time.monotonic() - self._start
@@ -233,10 +270,12 @@ class Metrics:
             if total_w > 0:
                 out["packet_loss_rate_window"] = (
                     by_name["packets_lost"].sum() / total_w)
-        return out, windows, hists
+        return out, labeled, windows, hists
 
     def snapshot(self) -> dict:
-        out, windows, hists = self._scalar_series()
+        out, labeled, windows, hists = self._scalar_series()
+        for (name, lk), v in sorted(labeled.items()):
+            out[name + self._prom_labels(dict(lk))] = v
         for w in windows:
             out[f"{w.name}_per_sec_{w.window_s:g}s"] = w.rate()
         for h in hists:
@@ -279,16 +318,32 @@ class Metrics:
         /metrics.json exactly (derived series like packet_loss_rate
         and msamples_per_sec included), so an alert written against
         either endpoint sees the other's values too."""
-        scalars, windows, hists = self._scalar_series()
+        scalars, labeled, windows, hists = self._scalar_series()
         lines = []
 
         def val(v: float) -> str:
             return f"{v:.17g}"
 
+        labeled_by_name: dict[str, list] = {}
+        for (n, lk), v in sorted(labeled.items()):
+            labeled_by_name.setdefault(n, []).append((lk, v))
         for k in sorted(scalars):
             name = self._prom_name(k)
             lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {val(scalars[k])}")
+            # labeled samples of the SAME family must stay adjacent
+            # to the flat sample: the exposition format requires one
+            # contiguous group per metric (strict parsers reject a
+            # re-opened family)
+            for lk, v in labeled_by_name.pop(k, []):
+                lines.append(
+                    f"{name}{self._prom_labels(dict(lk))} {val(v)}")
+        for bare in sorted(labeled_by_name):
+            name = self._prom_name(bare)
+            lines.append(f"# TYPE {name} gauge")
+            for lk, v in labeled_by_name[bare]:
+                lines.append(
+                    f"{name}{self._prom_labels(dict(lk))} {val(v)}")
         for w in windows:
             name = self._prom_name(w.name) + "_per_sec"
             lines.append(f"# TYPE {name} gauge")

@@ -45,10 +45,15 @@ from srtb_tpu.utils.metrics import metrics
 # beyond the checkpoint at startup), ``replayed_skips`` (sink pushes
 # skipped on replay because the manifest already holds their commit)
 # and ``rolled_back_intents`` (uncommitted artifacts rolled back by
-# manifest recovery) — all zero on a run that never crashed.  Readers
-# must tolerate mixed v1-v5 journals: rotation can leave an
-# older-schema tail in ``<path>.1`` after an upgrade.
-SPAN_SCHEMA_VERSION = 5
+# manifest recovery) — all zero on a run that never crashed.
+# v6 (multi-tenant fleet): adds ``stream`` (the Config.stream_name
+# label of the stream this span belongs to — omitted on unnamed
+# single-stream runs, never a fake placeholder) so a fleet journal
+# (or N per-stream journals merged) attributes every span, loss
+# burst, demotion and shed to its tenant.  Readers must tolerate
+# mixed v1-v6 journals: rotation can leave an older-schema tail in
+# ``<path>.1`` after an upgrade.
+SPAN_SCHEMA_VERSION = 6
 
 # gauge names shared between the pipeline (writer) and health() (reader)
 LAST_SEGMENT_MONOTONIC = "last_segment_monotonic"
@@ -121,7 +126,8 @@ def segment_span(segment: int, stages_s: dict, queue_depth: int,
                  timestamp_ns: int = 0, extra: dict | None = None,
                  overlap_hidden_s: float | None = None,
                  inflight_depth: int | None = None,
-                 active_plan: str | None = None) -> dict:
+                 active_plan: str | None = None,
+                 stream: str | None = None) -> dict:
     """One journal record.  ``stages_s`` maps stage name -> seconds for
     THIS segment; loss/drop counters are the cumulative registry values
     at drain time (deltas between consecutive records localize a loss
@@ -192,16 +198,63 @@ def segment_span(segment: int, stages_s: dict, queue_depth: int,
         # the writer has no plan-aware processor (duck-typed stubs) —
         # never a fake placeholder.
         rec["active_plan"] = str(active_plan)
+    if stream:
+        # v6: which tenant this span belongs to (Config.stream_name;
+        # the fleet stamps every lane's).  Omitted when unnamed — a
+        # solo run's journal reads exactly as before.  In a NAMED
+        # span the per-stream-attributable cumulative fields are the
+        # stream's OWN labeled series, not the process-wide totals: a
+        # healthy lane's journal must not inherit its noisy
+        # neighbor's demotions/loss (retries/requeues/restarts stay
+        # process-wide — their sites are not stream-labeled).
+        rec["stream"] = str(stream)
+        lbl = {"stream": str(stream)}
+        for key in ("segments_dropped", "degrade_level",
+                    "shed_waterfalls", "shed_baseband",
+                    "plan_demotions", "plan_promotions",
+                    "device_reinits", "plan_ladder_level"):
+            rec[key] = type(rec[key])(metrics.get(key, labels=lbl))
     if extra:
         rec.update(extra)
     return rec
 
 
-def mark_segment() -> None:
+# admitted fleet streams whose liveness /healthz must track: name ->
+# registration time.  Registered by StreamFleet when a lane starts,
+# released when it finishes/fails — a finished stream is legitimately
+# quiet and must not read as stale.
+_ADMITTED_STREAMS: dict[str, float] = {}
+_STREAMS_LOCK = threading.Lock()
+
+
+def register_stream(name: str) -> None:
+    """Admit ``name`` to per-stream staleness tracking: health() goes
+    unhealthy if ANY registered stream's last segment goes stale."""
+    with _STREAMS_LOCK:
+        _ADMITTED_STREAMS[name] = time.monotonic()
+
+
+def release_stream(name: str) -> None:
+    with _STREAMS_LOCK:
+        _ADMITTED_STREAMS.pop(name, None)
+
+
+def admitted_streams() -> list[str]:
+    with _STREAMS_LOCK:
+        return sorted(_ADMITTED_STREAMS)
+
+
+def mark_segment(stream: str | None = None) -> None:
     """Stamp the registry with "a segment just finished" — the signal
-    health() ages against."""
-    metrics.set(LAST_SEGMENT_MONOTONIC, time.monotonic())
+    health() ages against.  With ``stream`` set, also stamps that
+    stream's labeled gauge so /healthz can age each admitted tenant
+    independently."""
+    now = time.monotonic()
+    metrics.set(LAST_SEGMENT_MONOTONIC, now)
     metrics.set(LAST_SEGMENT_UNIX, time.time())
+    if stream:
+        metrics.set(LAST_SEGMENT_MONOTONIC, now,
+                    labels={"stream": str(stream)})
 
 
 def health(stale_after_s: float = 30.0) -> dict:
@@ -209,19 +262,52 @@ def health(stale_after_s: float = 30.0) -> dict:
     segment (startup / idle server is healthy), ``ok`` while the last
     segment is younger than ``stale_after_s``, ``stale`` otherwise — a
     wedged accelerator or dead source flips /healthz to 503 without any
-    in-process cooperation from the stuck thread."""
+    in-process cooperation from the stuck thread.
+
+    Multi-tenant fleet: every ADMITTED stream (register_stream) is aged
+    independently against its own labeled last-segment stamp; the
+    report carries a per-stream breakdown and ``ok`` is False when ANY
+    admitted stream is stale — one wedged tenant must flip /healthz
+    even while its neighbors keep the global stamp fresh."""
     last = metrics.get(LAST_SEGMENT_MONOTONIC)
+    now = time.monotonic()
     out = {
         "segments": metrics.get("segments"),
         "signals": metrics.get("signals"),
         "stale_after_s": float(stale_after_s),
     }
-    if not last:
+    streams = admitted_streams()
+    if streams:
+        per = {}
+        stale_streams = []
+        for s in streams:
+            st_last = metrics.get(LAST_SEGMENT_MONOTONIC,
+                                  labels={"stream": s})
+            if not st_last:
+                # no segment yet: startup is healthy, exactly like
+                # the solo contract — a lane still inside its first
+                # cold plan compile must not flip a liveness probe
+                # to 503 (and so restart the pod) at every start
+                per[s] = {"last_segment_age_s": None, "ok": True}
+                continue
+            age = now - st_last
+            per[s] = {"last_segment_age_s": round(age, 3),
+                      "ok": age <= stale_after_s}
+            if age > stale_after_s:
+                stale_streams.append(s)
+        out["streams"] = per
+        if stale_streams:
+            out["stale_streams"] = stale_streams
+    else:
+        stale_streams = []
+    if not last and not streams:
         out.update(status="idle", ok=True, last_segment_age_s=None)
         return out
-    age = time.monotonic() - last
-    out["last_segment_age_s"] = round(age, 3)
-    if age > stale_after_s:
+    age = now - last if last else None
+    if age is not None:
+        out["last_segment_age_s"] = round(age, 3)
+    globally_stale = age is not None and age > stale_after_s
+    if globally_stale or stale_streams:
         out.update(status="stale", ok=False)
     else:
         out.update(status="ok", ok=True)
