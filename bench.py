@@ -201,7 +201,6 @@ def run_bench(platform_error, overlap: str = "on",
     enable_compile_cache()
 
     from srtb_tpu.config import Config
-    from srtb_tpu.pipeline.segment import SegmentProcessor
 
     platform = jax.default_backend()
     on_accel = platform not in ("cpu",)
@@ -247,6 +246,11 @@ def run_bench(platform_error, overlap: str = "on",
         # config twice with this set — the second run's compile_s is
         # the AOT warm-restart number
         aot_plan_path=os.environ.get("SRTB_BENCH_AOT_DIR", ""),
+        # registered search mode (pipeline/registry.py):
+        # SRTB_BENCH_SEARCH_MODE=periodicity benches the harmonic-sum
+        # + folding plan family (the r8 queue's periodicity legs)
+        search_mode=os.environ.get("SRTB_BENCH_SEARCH_MODE",
+                                   "single_pulse"),
     )
     # "" = auto (staged at n >= 2^30); "0"/"1" force the plan — the
     # one-program 2^30 experiment (pallas2 has no XLA FFT scratch, so
@@ -266,7 +270,8 @@ def run_bench(platform_error, overlap: str = "on",
     # host-side constant building (chirp banks) isn't miscounted as
     # compile.
     t0 = time.perf_counter()
-    proc = SegmentProcessor(
+    from srtb_tpu.pipeline import registry
+    proc = registry.build_processor(
         cfg, staged=None if staged_env == "" else bool(int(staged_env)))
     # key the timer semantics on AOT actually ENGAGING, not merely being
     # requested: a silently-inactive cache (CPU without the opt-in) must
@@ -382,6 +387,7 @@ def run_bench(platform_error, overlap: str = "on",
         "hbm_passes": proc.hbm_passes,
         "fused_tail": "on" if proc.fused_tail else "off",
         "ring": ring,
+        "search_mode": proc.MODE,
     }
     if ring != "none":
         # H2D accounting (PERF.md "H2D accounting"): average uploaded

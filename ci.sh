@@ -24,13 +24,13 @@ if [ "${1:-}" = "--hardware" ]; then
   exit 0
 fi
 
-echo "== [1/15] native build =="
+echo "== [1/16] native build =="
 make -C srtb_tpu/native
 
-echo "== [2/15] native sanitizer harness (ASan/UBSan) =="
+echo "== [2/16] native sanitizer harness (ASan/UBSan) =="
 make -C srtb_tpu/native check
 
-echo "== [3/15] static checks (compile + import) =="
+echo "== [3/16] static checks (compile + import) =="
 python -m compileall -q srtb_tpu tests bench.py __graft_entry__.py
 python - <<'EOF'
 import importlib, pkgutil
@@ -45,7 +45,7 @@ assert not bad, bad
 print(f"all srtb_tpu modules import cleanly")
 EOF
 
-echo "== [4/15] srtb-lint (static analysis vs baseline) =="
+echo "== [4/16] srtb-lint (static analysis vs baseline) =="
 # fails on findings not in srtb_tpu/analysis/baseline.json; accept an
 # intentional finding with --write-baseline + a note, or a pragma.
 # The machine-readable run lands next to the other CI artifacts.
@@ -54,7 +54,7 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.lint srtb_tpu/ \
   --format json > artifacts/lint.json \
   || { cat artifacts/lint.json; exit 1; }
 
-echo "== [5/15] plan audit (compile-time HLO cards vs baseline) =="
+echo "== [5/16] plan audit (compile-time HLO cards vs baseline) =="
 # AOT-lowers every plan family and audits the compiled artifacts:
 # spectrum-sized HBM sweeps vs the declared hbm_passes floor, donation
 # proven aliased (not silently dropped), no f64/host-callback/
@@ -66,7 +66,7 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.plan_audit \
   --out artifacts/plan_cards_audit.json
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.plan_audit --selftest
 
-echo "== [6/15] pytest (8-device CPU mesh) =="
+echo "== [6/16] pytest (8-device CPU mesh) =="
 FAST_ARGS=()
 if [ "${1:-}" = "--fast" ]; then
   # one source of truth for what "slow" means: the pytest marker
@@ -75,11 +75,11 @@ if [ "${1:-}" = "--fast" ]; then
 fi
 python -m pytest tests/ -q "${FAST_ARGS[@]}"
 
-echo "== [7/15] bench smoke (with the roofline/audit cross-check) =="
+echo "== [7/16] bench smoke (with the roofline/audit cross-check) =="
 JAX_PLATFORMS=cpu SRTB_BENCH_LOG2N=16 SRTB_BENCH_AUDIT=1 \
   python bench.py | tail -1
 
-echo "== [8/15] fused-plan parity (spectrum-pass fusion, Pallas interpret on CPU) =="
+echo "== [8/16] fused-plan parity (spectrum-pass fusion, Pallas interpret on CPU) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import numpy as np
 
@@ -122,13 +122,13 @@ print(f"fused-plan parity OK: plan {fused.plan_name} "
       "detections bit-identical")
 EOF
 
-echo "== [9/15] ring parity smoke (incremental H2D ring on vs off, Pallas interpret) =="
+echo "== [9/16] ring parity smoke (incremental H2D ring on vs off, Pallas interpret) =="
 # The ISSUE-8 acceptance gate: ring-on output is bit-identical to
 # ring-off on a Pallas-kernel plan (interpret mode on CPU), and the
 # per-segment h2d_bytes counter equals the stride model exactly — the
 # full segment on the one cold dispatch, stride_bytes (segment minus
 # the reserved overlap tail) on every warm dispatch.  The plan-audit
-# stage [5/15] already proved the carry donation is a real alias for
+# stage [5/16] already proved the carry donation is a real alias for
 # every ring-v1 family; this proves the runtime keeps its half of the
 # contract.
 JAX_PLATFORMS=cpu python - <<'EOF'
@@ -191,7 +191,7 @@ print(f"ring parity OK: plan {proc.plan_name}, {s_on.segments} segments "
       f"{proc.reserved_bytes / seg_b:.1%} per warm segment)")
 EOF
 
-echo "== [10/15] telemetry + sanitizer smoke (journal + report + /metrics + /healthz + Config.sanitize) =="
+echo "== [10/16] telemetry + sanitizer smoke (journal + report + /metrics + /healthz + Config.sanitize) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import json, os, tempfile, urllib.request
 
@@ -267,7 +267,7 @@ print(f"sanitizer smoke OK: {stats_s.segments} segments with "
       "Config.sanitize on, tripwire restored")
 EOF
 
-echo "== [11/15] fault-injection smoke (one transient fault at every site -> recovery + v6 telemetry) =="
+echo "== [11/16] fault-injection smoke (one transient fault at every site -> recovery + v6 telemetry) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import json, os, tempfile
 
@@ -345,7 +345,7 @@ print(f"fault-injection smoke OK: {st1.segments} segments recovered "
       "/metrics + v6 journal")
 EOF
 
-echo "== [12/15] chaos smoke (self-healing compute: oom + compile_fail + device_halt in one run) =="
+echo "== [12/16] chaos smoke (self-healing compute: oom + compile_fail + device_halt in one run) =="
 # The ISSUE-9 acceptance gate: a deterministic fault plan injecting all
 # three device-fault classes completes with accounted-only loss,
 # detection decisions identical to the clean run, and the
@@ -359,7 +359,7 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.chaos_soak --segments 6 \
   | tail -1
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.chaos_soak --selftest
 
-echo "== [13/15] crash-soak smoke (SIGKILL exactly-once: manifest recovery + fsck + bit-identical union) =="
+echo "== [13/16] crash-soak smoke (SIGKILL exactly-once: manifest recovery + fsck + bit-identical union) =="
 # The ISSUE-10 acceptance gate, CI-sized: a deterministic two-kill plan
 # — one SIGKILL mid-checkpoint-flush (between sink commit and the
 # checkpoint update, the duplicate-on-resume window) and one mid-
@@ -374,11 +374,11 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.crash_soak --segments 5 \
   --kills 2 --kill-plan "ckpt_stall@1,rename@1" --log2n 13 | tail -1
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.fsck --selftest
 
-echo "== [14/15] multichip dryrun (8 virtual devices) =="
+echo "== [14/16] multichip dryrun (8 virtual devices) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== [15/15] fleet smoke (multi-tenant bulkheads: 3 streams, 1 victim, shared plan cache) =="
+echo "== [15/16] fleet smoke (multi-tenant bulkheads: 3 streams, 1 victim, shared plan cache) =="
 # The ISSUE-11 acceptance gate, CI-sized: 3 seeded streams on one
 # device, a stream-selector fault plan injected into stream0 (oom ->
 # victim-only demotion, plus a transient sink fault and a fetch
@@ -392,5 +392,17 @@ echo "== [15/15] fleet smoke (multi-tenant bulkheads: 3 streams, 1 victim, share
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.fleet_soak --streams 3 \
   --segments 4 --log2n 12 | tail -1
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.fleet_soak --selftest
+
+echo "== [16/16] archive-replay smoke (full-throughput replay: SIGTERM resume + bit-identical union + micro-batch tolerance) =="
+# The ISSUE-12 acceptance gate, CI-sized: a 2-file fleet-fanned replay
+# (deterministic timestamps, per-file checkpoint + manifest namespaces)
+# killed by a SIGTERM steered into one lane's sink-write window, then
+# resumed to completion.  Gate: fsck-clean manifests, no orphan temps,
+# the final output set (paths + SHA-256) BIT-IDENTICAL to per-file
+# streamed golden runs, and the micro-batched throughput mode
+# reproducing identical decisions (same artifact set, raw dumps
+# bitwise, float artifacts within the documented vmap tolerance).
+JAX_PLATFORMS=cpu python -m srtb_tpu.tools.archive_replay --selftest \
+  --segments 4 --log2n 13 | tail -1
 
 echo "CI OK"

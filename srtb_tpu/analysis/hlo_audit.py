@@ -54,122 +54,28 @@ import contextlib
 import json
 import os
 import re
-from dataclasses import dataclass, field
+
+from srtb_tpu.pipeline import registry
 
 # ------------------------------------------------------------------
-# plan families
+# plan families: enumerated from the ONE plan-family registry
+# (pipeline/registry.py) — this module keeps NO family list of its
+# own, so the auditable zoo, the demotion ladder and the fleet's plan
+# cache can never drift apart.  ``PlanSpec`` is the registry's
+# dataclass (the pre-registry name, kept for importers), and the
+# module attributes PLAN_FAMILIES / PLAN_KEYS are LIVE views so a
+# ``registry.temp_family`` registration (tests, the selftest) is
+# visible here too.
+
+PlanSpec = registry.PlanFamily
 
 
-@dataclass(frozen=True)
-class PlanSpec:
-    """One auditable plan family: the Config/constructor knobs that
-    select it, plus the declared hbm_passes the family must report."""
-
-    key: str
-    desc: str
-    cfg: dict = field(default_factory=dict)
-    donate: bool = False
-    staged: bool | None = None
-    env: dict = field(default_factory=dict)
-    expect_hbm_passes: int | None = None
-
-
-# Families reachable from plan_signature(): fft strategy x fused_tail x
-# skzap x micro-batch x donation x staged.  The audit shape (default
-# 2^16 samples, 8 channels — ci.sh stage-7's shape) keeps every family
-# lowerable in ~a second on CPU; pallas kernels lower in interpret
-# mode, which emits the same logical HLO structure scans care about.
-PLAN_FAMILIES = (
-    PlanSpec("monolithic", "one XLA R2C custom call, unfused 7-pass tail",
-             {"fft_strategy": "monolithic", "fused_tail": "off"},
-             expect_hbm_passes=7),
-    PlanSpec("monolithic_donate", "monolithic with the donated raw input",
-             {"fft_strategy": "monolithic", "fused_tail": "off"},
-             donate=True, expect_hbm_passes=7),
-    PlanSpec("four_step", "Bailey four-step R2C, unfused tail",
-             {"fft_strategy": "four_step", "fused_tail": "off"},
-             expect_hbm_passes=7),
-    PlanSpec("four_step_ftail", "four-step with the fused RFI+chirp tail",
-             {"fft_strategy": "four_step", "fused_tail": "on"},
-             expect_hbm_passes=5),
-    PlanSpec("four_step_ftail_donate", "fused tail + donated raw input",
-             {"fft_strategy": "four_step", "fused_tail": "on"},
-             donate=True, expect_hbm_passes=5),
-    PlanSpec("four_step_ftail_mb2", "fused tail, micro-batch of 2",
-             {"fft_strategy": "four_step", "fused_tail": "on",
-              "micro_batch_segments": 2},
-             donate=True, expect_hbm_passes=5),
-    PlanSpec("mxu_ftail", "radix-128 MXU matmul FFT, fused tail",
-             {"fft_strategy": "mxu", "fused_tail": "on"},
-             expect_hbm_passes=5),
-    PlanSpec("pallas_ftail", "Pallas unpack/chirp kernels, fused tail",
-             {"fft_strategy": "four_step", "fused_tail": "on",
-              "use_pallas": True},
-             expect_hbm_passes=5),
-    PlanSpec("pallas_fft_ftail", "Pallas VMEM row-FFT legs, fused tail",
-             {"fft_strategy": "pallas", "fused_tail": "on",
-              "use_pallas": True},
-             expect_hbm_passes=5),
-    PlanSpec("pallas_skzap", "fully fused: one-kernel watfft+SK+detect",
-             {"fft_strategy": "four_step", "fused_tail": "on",
-              "use_pallas": True, "use_pallas_sk": True},
-             expect_hbm_passes=4),
-    PlanSpec("pallas_skzap_donate", "skzap plan + donated raw input",
-             {"fft_strategy": "four_step", "fused_tail": "on",
-              "use_pallas": True, "use_pallas_sk": True},
-             donate=True, expect_hbm_passes=4),
-    PlanSpec("staged", "three-program staged plan, fused tail, donation",
-             {"fft_strategy": "four_step", "fused_tail": "on"},
-             donate=True, staged=True, expect_hbm_passes=5),
-    PlanSpec("staged_unfused", "staged plan with the legacy 7-pass tail",
-             {"fft_strategy": "four_step", "fused_tail": "off"},
-             donate=True, staged=True, expect_hbm_passes=7),
-    PlanSpec("staged_pallas", "staged with Pallas row-FFT legs",
-             {"fft_strategy": "four_step", "fused_tail": "on"},
-             donate=True, staged=True,
-             env={"SRTB_STAGED_ROWS_IMPL": "pallas"},
-             expect_hbm_passes=5),
-    PlanSpec("staged_pallas2", "staged with fused two-pass pallas2 legs "
-             "(downgrades to pallas legs below the 2^24 leg window)",
-             {"fft_strategy": "four_step", "fused_tail": "on"},
-             donate=True, staged=True,
-             env={"SRTB_STAGED_ROWS_IMPL": "pallas2"},
-             expect_hbm_passes=5),
-    # ---- ingest-ring (ring-v1) families: overlap-save reserves a tail
-    # (baseband_reserve_sample + a small dm keeps 0 < reserved < n at
-    # the audit shape), so the two-input carry ++ new assemble programs
-    # exist and their carry donation must audit as a PROVEN alias
-    # (checks.ring_alias_ok) — uint8[reserved_bytes] in -> identical
-    # aval out, rewritten in place every warm dispatch.
-    PlanSpec("four_step_ftail_ring", "fused tail + ingest ring: carry "
-             "donation proven aliased on the warm assemble program",
-             {"fft_strategy": "four_step", "fused_tail": "on",
-              "baseband_reserve_sample": True, "dm": 0.1},
-             donate=True, expect_hbm_passes=5),
-    PlanSpec("monolithic_ring", "ring on the unfused monolithic "
-             "fallback plan",
-             {"fft_strategy": "monolithic", "fused_tail": "off",
-              "baseband_reserve_sample": True, "dm": 0.1},
-             donate=True, expect_hbm_passes=7),
-    PlanSpec("pallas_skzap_ring", "fully fused 4-pass plan + ring",
-             {"fft_strategy": "four_step", "fused_tail": "on",
-              "use_pallas": True, "use_pallas_sk": True,
-              "baseband_reserve_sample": True, "dm": 0.1},
-             donate=True, expect_hbm_passes=4),
-    PlanSpec("four_step_ftail_ring_mb2", "ring micro-batch: ONE carry "
-             "+ B stride uploads assemble B overlapped segments",
-             {"fft_strategy": "four_step", "fused_tail": "on",
-              "micro_batch_segments": 2,
-              "baseband_reserve_sample": True, "dm": 0.1},
-             donate=True, expect_hbm_passes=5),
-    PlanSpec("staged_ring", "staged plan + ring: stage_a_ring emits "
-             "the carry alongside the canonical boundary",
-             {"fft_strategy": "four_step", "fused_tail": "on",
-              "baseband_reserve_sample": True, "dm": 0.1},
-             donate=True, staged=True, expect_hbm_passes=5),
-)
-
-PLAN_KEYS = tuple(s.key for s in PLAN_FAMILIES)
+def __getattr__(name: str):
+    if name == "PLAN_FAMILIES":
+        return registry.plan_families()
+    if name == "PLAN_KEYS":
+        return registry.plan_keys()
+    raise AttributeError(name)
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__),
                                 "plan_cards.json")
@@ -213,13 +119,15 @@ def _env(overrides: dict):
 
 def build_plan(spec: PlanSpec, log2n: int = DEFAULT_LOG2N,
                channels: int = DEFAULT_CHANNELS):
-    """Construct the SegmentProcessor for one plan family at the audit
-    shape (device constants are built, but no plan program runs)."""
-    from srtb_tpu.pipeline.segment import SegmentProcessor
+    """Construct the segment processor for one plan family at the
+    audit shape (device constants are built, but no plan program
+    runs).  Built through the registry, so a family whose config
+    selects a registered search mode (``search_mode``) audits that
+    mode's actual processor class."""
     cfg = _audit_config(log2n, channels, spec.cfg)
     with _env(spec.env):
-        return SegmentProcessor(cfg, staged=spec.staged,
-                                donate_input=spec.donate)
+        return registry.build_processor(cfg, staged=spec.staged,
+                                        donate_input=spec.donate)
 
 
 # ------------------------------------------------------------------
@@ -459,23 +367,26 @@ def audit_processor(proc, keep_text: bool = False) -> dict:
 
 def audit_families(keys=None, log2n: int = DEFAULT_LOG2N,
                    channels: int = DEFAULT_CHANNELS) -> dict:
-    """Cards for the requested plan families (default: all)."""
-    specs = {s.key: s for s in PLAN_FAMILIES}
-    keys = list(keys) if keys else list(PLAN_KEYS)
+    """Cards for the requested plan families (default: every family
+    in the registry)."""
+    specs = {s.key: s for s in registry.plan_families()}
+    keys = list(keys) if keys else list(registry.plan_keys())
     cards = {}
     for k in keys:
         if k not in specs:
-            raise KeyError(f"unknown plan family {k!r} "
-                           f"(known: {', '.join(PLAN_KEYS)})")
+            raise KeyError(
+                f"unknown plan family {k!r} "
+                f"(known: {', '.join(registry.plan_keys())})")
         spec = specs[k]
         with _env(spec.env):
             proc = build_plan(spec, log2n=log2n, channels=channels)
             card = audit_processor(proc)
         card["audit_shape"] = {"log2n": log2n, "channels": channels}
-        if spec.expect_hbm_passes is not None:
+        card["mode"] = spec.mode
+        if spec.hbm_passes is not None:
             card["checks"]["declared_matches_family"] = (
-                proc.hbm_passes == spec.expect_hbm_passes)
-            card["expected_hbm_passes"] = spec.expect_hbm_passes
+                proc.hbm_passes == spec.hbm_passes)
+            card["expected_hbm_passes"] = spec.hbm_passes
         cards[k] = card
     return cards
 
@@ -490,7 +401,7 @@ _DIFF_PROGRAM_KEYS = (
     "host_transfer_ops", "custom_calls", "host_callbacks", "f64_ops",
     "c128_ops", "donation", "alias_bytes")
 _DIFF_PLAN_KEYS = ("plan_name", "declared_hbm_passes", "fused_tail",
-                   "staged", "ingest", "reserved_bytes",
+                   "staged", "ingest", "reserved_bytes", "mode",
                    "total_spectrum_passes", "checks")
 
 
@@ -577,12 +488,13 @@ def failed_checks(cards: dict) -> list:
 # demote into an unaudited plan family
 
 # the fully-featured ladder base: every canonical demotion rung is
-# live from here (micro-batch, ring, skzap, fused tail, staged,
-# monolithic), so walking it exercises the ladder's whole range
+# live from here (search mode, micro-batch, ring, skzap, fused tail,
+# staged, monolithic), so walking it exercises the ladder's whole
+# range — including the periodicity mode's shed-the-mode-first rung
 LADDER_AUDIT_CFG = {
     "fft_strategy": "four_step", "fused_tail": "on",
     "use_pallas": True, "use_pallas_sk": True,
-    "micro_batch_segments": 2,
+    "micro_batch_segments": 2, "search_mode": "periodicity",
     "baseband_reserve_sample": True, "dm": 0.1,
 }
 
@@ -613,14 +525,16 @@ def audit_ladder(baseline: "CardBaseline",
                  channels: int = DEFAULT_CHANNELS) -> list:
     """Check that EVERY demotion-ladder rung reachable from the
     fully-featured audit config resolves to a plan family already
-    carded in the baseline — the self-healing ladder
-    (resilience/demote.py) must never land the run on an unaudited
-    plan.  Returns failure strings (empty = every target is carded).
+    carded in the baseline AND registered as ladder-ELIGIBLE — the
+    self-healing ladder (resilience/demote.py) must never land the
+    run on an unaudited plan, nor on a family the registry declared
+    off-limits as a demotion target (``PlanFamily.ladder=False``,
+    e.g. the periodicity mode the ladder sheds, never enters).
+    Returns failure strings (empty = every target is carded).
 
-    Builds each rung's SegmentProcessor at the audit shape (constants
-    only — nothing lowers or runs) and matches its resolved
-    fingerprint against the baseline cards."""
-    from srtb_tpu.pipeline.segment import SegmentProcessor
+    Builds each rung's processor at the audit shape (constants only —
+    nothing lowers or runs) and matches its resolved fingerprint
+    against the baseline cards."""
     from srtb_tpu.resilience.demote import ladder_rungs
 
     cfg = _audit_config(log2n, channels, dict(LADDER_AUDIT_CFG))
@@ -631,18 +545,35 @@ def audit_ladder(baseline: "CardBaseline",
                 "fully-featured audit config (ladder dead?)"]
     fps = _card_fingerprints(baseline)
     for rung in rungs:
-        proc = SegmentProcessor(rung.cfg, staged=rung.staged,
-                                donate_input=True)
+        proc = registry.build_processor(rung.cfg, staged=rung.staged,
+                                        donate_input=True)
         mb = int(getattr(rung.cfg, "micro_batch_segments", 1) or 1)
         fp = _plan_fingerprint(proc.plan_name,
                                "ring-v1" if proc.ring else "direct",
                                proc.staged, mb > 1)
-        if fp not in fps:
+        keys = fps.get(fp, [])
+        if not keys:
             failures.append(
                 f"ladder: rung {rung.step!r} resolves to an UNAUDITED "
                 f"plan (plan={fp[0]} ingest={fp[1]} staged={fp[2]} "
                 f"micro_batch={fp[3]}) — card the family in "
                 "plan_cards.json before the ladder may demote into it")
+            continue
+        fams = {k: registry.family(k) for k in keys}
+        unregistered = sorted(k for k, f in fams.items() if f is None)
+        if unregistered and not any(fams.values()):
+            failures.append(
+                f"ladder: rung {rung.step!r} lands on "
+                f"{'/'.join(unregistered)}, carded but NOT in the "
+                "registry — stale plan_cards.json entry (re-run "
+                "--write-baseline)")
+            continue
+        if not any(f is not None and f.ladder for f in fams.values()):
+            failures.append(
+                f"ladder: rung {rung.step!r} lands on "
+                f"{'/'.join(keys)}, registered ladder-INELIGIBLE "
+                "(PlanFamily.ladder=False) — the ladder may shed such "
+                "a family but never demote into it")
     return failures
 
 
@@ -675,7 +606,7 @@ def selftest(log2n: int = DEFAULT_LOG2N,
     import jax
 
     failures = []
-    spec = next(s for s in PLAN_FAMILIES if s.key == "four_step_ftail")
+    spec = registry.family("four_step_ftail")
     proc = build_plan(spec, log2n=log2n, channels=channels)
     spectrum_bytes = 8 * proc.n_spectrum
     (name, fn, args, donated), = [p for p in proc.lowerables()
@@ -689,7 +620,7 @@ def selftest(log2n: int = DEFAULT_LOG2N,
             "extra-pass injection not caught: audited passes moved by "
             f"{gained} (expected >= 2: one read + one write)")
 
-    sspec = next(s for s in PLAN_FAMILIES if s.key == "staged")
+    sspec = registry.family("staged")
     sproc = build_plan(sspec, log2n=log2n, channels=channels)
     sbytes = 8 * sproc.n_spectrum
     progs = {p[0]: p for p in sproc.lowerables()}
@@ -712,8 +643,7 @@ def selftest(log2n: int = DEFAULT_LOG2N,
     # ring-v1: the carry alias must be proven on the warm assemble
     # program, and a plan that loses it (non-donating wrapper again)
     # must fail the ring_alias_ok check
-    rspec = next(s for s in PLAN_FAMILIES
-                 if s.key == "four_step_ftail_ring")
+    rspec = registry.family("four_step_ftail_ring")
     rproc = build_plan(rspec, log2n=log2n, channels=channels)
     if not rproc.ring:
         failures.append("ring family resolved with the ring OFF "
@@ -751,4 +681,22 @@ def selftest(log2n: int = DEFAULT_LOG2N,
         failures.append(
             "ladder-gate injection not caught: an EMPTY baseline "
             "still passes audit_ladder (the gate would never fire)")
+
+    # registry gate: a plan family REGISTERED without a checked-in
+    # plan card must fail the CI diff as unbaselined — registering a
+    # new capability (a search mode, a plan variant) in
+    # pipeline/registry.py is not done until its card is accepted
+    with registry.temp_family(registry.PlanFamily(
+            key="__selftest_uncarded",
+            desc="selftest: registered but never carded",
+            cfg={"fft_strategy": "four_step", "fused_tail": "on"},
+            donate=True, hbm_passes=5)):
+        cards = audit_families(["__selftest_uncarded"], log2n=log2n,
+                               channels=channels)
+        _, new_plans, _ = diff_cards(cards, checked_in)
+        if "__selftest_uncarded" not in new_plans:
+            failures.append(
+                "uncarded-family injection not caught: a family "
+                "registered without a plan card did not surface as "
+                "unbaselined (the registry gate would never fire)")
     return failures

@@ -75,6 +75,16 @@ class Config:
     input_file_offset_bytes: int = 0
     baseband_output_file_prefix: str = "srtb_baseband_output_"
     baseband_write_all: bool = False
+    # stamp segment timestamps deterministically from the STREAM
+    # OFFSET instead of the wall clock (io/file_input.py
+    # DeterministicTimestampReader): the same segment gets the same
+    # stamp in every run and every resume, so file-mode artifact names
+    # (timestamp-derived when no UDP counter exists) reproduce across
+    # runs — what makes an archive replay's output set comparable
+    # byte-for-byte against a golden run, and what the crash/archive
+    # soaks' exactly-once path+SHA-256 equality gates build on.
+    # File sources only; ignored for UDP (real packets carry counters).
+    deterministic_timestamps: bool = False
 
     log_level: int = 3
 
@@ -90,6 +100,36 @@ class Config:
     signal_detect_signal_noise_threshold: float = 6.0
     signal_detect_channel_threshold: float = 0.9
     signal_detect_max_boxcar_length: int = 1024
+
+    # ---- search mode (pipeline/registry.py registered modes) ----
+    # "single_pulse": the reference's boxcar cascade.  "periodicity":
+    # single-pulse PLUS a harmonic-summed power-spectrum search over
+    # the dedispersed time series with phase-folded profiles at the
+    # top candidates (ops/periodicity.py; the FPGA pulsar-search
+    # paper's module set), inside the same traced program — every
+    # execution plan (fused/staged/ring/micro-batch) carries it.
+    # Registered modes land in the plan auditor, the demotion ladder
+    # (which sheds the mode FIRST on a device fault) and the fleet
+    # automatically.
+    search_mode: str = "single_pulse"
+    # max harmonics summed incoherently (ladder 1, 2, 4, ... <= this)
+    periodicity_harmonics: int = 8
+    # top-K candidates folded per stream (static shape)
+    periodicity_candidates: int = 4
+    # phase bins of each folded pulse profile
+    periodicity_fold_bins: int = 64
+    # exclude power-spectrum bins below this (DC + red-noise leakage)
+    periodicity_min_bin: int = 2
+    # a segment is "positive" (candidate files written) when any
+    # folded candidate's harmonic-summed score reaches this MARGIN
+    # above the trials-expected noise maximum: the per-bin score is
+    # ~exponential under noise, so its max over (searched bins x
+    # harmonic levels) trials sits near ln(trials) — the gate
+    # compares against ln(trials) + this margin (Gumbel scale ~1 per
+    # unit; 5 = roughly an e^-5 per-segment false-positive rate).
+    # Candidates are always computed and journaled regardless — the
+    # gate only decides whether the segment writes candidate files.
+    periodicity_snr_threshold: float = 5.0
 
     thread_query_work_wait_time: int = 1000
 
@@ -258,8 +298,9 @@ class Config:
     degrade_hold_segments: int = 3
     # ---- self-healing compute (resilience/demote.py) ----
     # plan-demotion ladder for device OOM / compile faults: "auto"
-    # walks micro_batch -> ring -> skzap -> fused_tail -> staged ->
-    # monolithic (cumulatively, skipping rungs the active config
+    # walks search_mode -> micro_batch -> ring -> skzap -> fused_tail
+    # -> staged -> monolithic (the registry's canonical order,
+    # cumulatively, skipping rungs the active config
     # doesn't use); an explicit comma list selects a subset in that
     # order; "off" disables demotion (device faults escalate like any
     # fatal).  Each demotion rebuilds the segment plan from the rung's
@@ -379,7 +420,9 @@ class Config:
         "segment_watchdog_requeues", "supervisor_max_restarts",
         "degrade_hold_segments", "promote_after_segments",
         "device_reinit_max", "stream_priority", "fleet_max_streams",
-        "fleet_queue_limit",
+        "fleet_queue_limit", "periodicity_harmonics",
+        "periodicity_candidates", "periodicity_fold_bins",
+        "periodicity_min_bin",
     })
     _FLOAT_FIELDS = frozenset({
         "baseband_freq_low", "baseband_bandwidth", "baseband_sample_rate",
@@ -391,13 +434,13 @@ class Config:
         "retry_backoff_max_s", "retry_deadline_s",
         "supervisor_window_s", "degrade_queue_high",
         "degrade_queue_low", "shutdown_join_timeout_s",
-        "device_reinit_window_s",
+        "device_reinit_window_s", "periodicity_snr_threshold",
     })
     _BOOL_FIELDS = frozenset({
         "baseband_reserve_sample", "baseband_write_all", "gui_enable",
         "use_emulated_fp64", "use_pallas", "use_pallas_sk", "sanitize",
         "degrade_enable", "chirp_exact", "manifest_fsync",
-        "manifest_hash",
+        "manifest_hash", "deterministic_timestamps",
     })
     _LIST_FIELDS = frozenset({
         "udp_receiver_address", "udp_receiver_port",

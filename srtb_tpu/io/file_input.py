@@ -142,3 +142,45 @@ class BasebandFileReader:
 
     def close(self):
         self._file.close()
+
+
+# fixed epoch the deterministic stamps count from (an arbitrary 2023
+# instant): stamps must be stable across processes, so the wall clock
+# can play no part
+DETERMINISTIC_EPOCH_NS = 1_700_000_000_000_000_000
+
+
+class DeterministicTimestampReader(BasebandFileReader):
+    """File reader stamping ``timestamp`` from the segment's STREAM
+    OFFSET instead of the wall clock: the same segment gets the same
+    stamp in every run and every resume, so file-mode artifact names
+    (timestamp-derived when no UDP counter exists) are reproducible
+    across runs.  This is what makes an archive replay's output set
+    (paths + SHA-256) comparable byte-for-byte against a golden run —
+    and what the crash/archive soaks' exactly-once equality gates are
+    built on.  Promoted from the crash-soak tool (PR 10) to a
+    first-class reader option (``Config.deterministic_timestamps``)
+    so the soaks and the archive replay engine share ONE
+    implementation."""
+
+    def __next__(self) -> SegmentWork:
+        offset = self.logical_offset
+        work = super().__next__()
+        work.timestamp = DETERMINISTIC_EPOCH_NS + offset
+        return work
+
+
+def make_file_source(cfg: Config,
+                     buffer_pool: BufferPool | None = None,
+                     start_offset_bytes: int | None = None
+                     ) -> BasebandFileReader:
+    """The config-selected file source: the deterministic-timestamp
+    reader when ``Config.deterministic_timestamps`` is set, the
+    wall-clock reader otherwise.  The single construction point the
+    Pipeline, the archive replay engine and the soak harnesses all
+    use."""
+    cls = (DeterministicTimestampReader
+           if getattr(cfg, "deterministic_timestamps", False)
+           else BasebandFileReader)
+    return cls(cfg, buffer_pool=buffer_pool,
+               start_offset_bytes=start_offset_bytes)

@@ -18,6 +18,7 @@ helpers (src/plot_spectrum.py, plot_tim.py) work unmodified:
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import time
 import zlib
@@ -209,6 +210,9 @@ class CandidateFiles:
     bin_path: str
     npy_paths: list
     tim_paths: list
+    # periodicity mode only: <base>[.sN].fold.npy folded profiles +
+    # <base>[.sN].cand.json candidate metadata
+    fold_paths: list = dataclasses.field(default_factory=list)
 
 
 class WriteSignalSink:
@@ -446,7 +450,24 @@ class WriteSignalSink:
                             path, series[s, bi, :valid].astype("<f4"))
                         tim_paths.append(path)
 
-        self.written.append(CandidateFiles(bin_path, npy_paths, tim_paths))
+        # registered-mode hook (the registry contract): a detect
+        # result carrying its own extra artifacts (e.g. the
+        # periodicity mode's folded profiles + candidate table,
+        # pipeline/periodicity.py) hands (path, array) pairs here and
+        # they ride the same temp+rename(+manifest) machinery as
+        # every other artifact — this writer stays mode-blind.
+        fold_paths = []
+        extra = (getattr(work.detect, "extra_artifacts", None)
+                 if work.detect is not None else None)
+        if extra is not None:
+            for path, payload in extra(base):
+                if path.endswith(".npy"):
+                    payload = _npy_bytes(payload)
+                self._write_bytes(path, payload)
+                fold_paths.append(path)
+
+        self.written.append(CandidateFiles(bin_path, npy_paths,
+                                           tim_paths, fold_paths))
 
     def _publish_staged(self) -> None:
         """Close the segment transaction: ONE publish barrier (all

@@ -48,11 +48,15 @@ ladder levels, in-flight depth (``{stream="..."}`` series on
 /metrics), the v6 journal's ``stream`` field, and /healthz per-stream
 staleness.  The fleet chaos gate is ``tools/fleet_soak.py``.
 
-Limits (documented, enforced loudly): lanes are single-segment
-dispatch units (``micro_batch_segments`` must be 1 — the solo engine
-keeps micro-batch), and ``Config.sanitize`` is unsupported inside a
-fleet (the sanitizer's thread-ownership guards assume one engine per
-process).
+Limits (documented, enforced loudly): REAL-TIME lanes are
+single-segment dispatch units (``micro_batch_segments`` must be 1
+there — batching ingest on a live stream trades bounded latency for
+throughput silently; use the solo engine).  FILE-MODE lanes may
+micro-batch: replaying recorded baseband has no latency contract, so
+the archive replay engine (pipeline/archive.py) batches B segments
+into one vmapped dispatch per lane for full device occupancy.
+``Config.sanitize`` is unsupported inside a fleet (the sanitizer's
+thread-ownership guards assume one engine per process).
 """
 
 from __future__ import annotations
@@ -122,11 +126,15 @@ class SharedPlanCache:
 
     def get(self, cfg: Config,
             donate_input: bool = False) -> SegmentProcessor:
-        key = SegmentProcessor.plan_cache_key(cfg,
-                                              donate_input=donate_input)
+        # keyed AND built through the plan registry: a registered
+        # search mode's processor class serves its lanes, and plans of
+        # different modes can never share a cache slot (the key
+        # carries the mode)
+        from srtb_tpu.pipeline import registry
+        key = registry.plan_cache_key(cfg, donate_input=donate_input)
         proc = self._by_key.get(key)
         if proc is None:
-            proc = SegmentProcessor(
+            proc = registry.build_processor(
                 cfg, donate_input=donate_input).mark_shared()
             self._by_key[key] = proc
             self.compiles += 1
@@ -155,15 +163,43 @@ class _StreamLane:
 
     def __init__(self, fleet: "StreamFleet", spec: StreamSpec):
         cfg = spec.cfg
-        if int(getattr(cfg, "micro_batch_segments", 1) or 1) > 1:
+        real_time = not cfg.input_file_path
+        mb = int(getattr(cfg, "micro_batch_segments", 1) or 1)
+        if mb > 1 and real_time:
+            # file-mode (archive replay) lanes may batch — replaying
+            # recorded baseband has no latency contract; a LIVE
+            # stream batching ingest would silently trade bounded
+            # latency for throughput, so real-time lanes reject loudly
             raise ValueError(
                 f"stream {spec.name!r}: micro_batch_segments > 1 is "
-                "not supported in a fleet lane (use the solo engine)")
+                "only supported on file-mode (non-real-time) fleet "
+                "lanes (use the solo engine for a batched live "
+                "stream)")
         if getattr(cfg, "sanitize", False):
             raise ValueError(
                 f"stream {spec.name!r}: Config.sanitize is "
                 "incompatible with fleet scheduling (single-engine "
                 "thread-ownership guards)")
+        # every validation that can fail is pure-config-decidable and
+        # sits BEFORE Pipeline construction: a lane rejected here must
+        # not leak an opened Pipeline (input file, checkpoint,
+        # manifest WAL fd, telemetry registration) into a failed
+        # StreamResult that nothing ever closes
+        self.window = max(1, int(getattr(cfg, "inflight_segments", 2)
+                                 or 1))
+        self.micro_batch = mb
+        if mb > self.window:
+            raise ValueError(
+                f"stream {spec.name!r}: micro_batch_segments={mb} "
+                f"exceeds inflight_segments={self.window}: a batch "
+                "dispatch must fit the lane's in-flight window")
+        if mb > 1:
+            from srtb_tpu.pipeline.segment import staged_resolves
+            if staged_resolves(cfg):
+                raise ValueError(
+                    f"stream {spec.name!r}: micro_batch_segments > 1 "
+                    "requires the fused plan (staged segments are "
+                    "already dispatch-amortized)")
         self.fleet = fleet
         self.spec = spec
         self.name = spec.name
@@ -174,9 +210,7 @@ class _StreamLane:
             keep_waterfall=spec.keep_waterfall,
             processor=fleet.plans.get(
                 cfg, donate_input=on_accelerator()))
-        self.window = max(1, int(getattr(cfg, "inflight_segments", 2)
-                                 or 1))
-        self.real_time = not cfg.input_file_path
+        self.real_time = real_time
         self.max_segments = spec.max_segments
         self.deadline_s = float(cfg.segment_deadline_s or 0.0)
         self.join_s = float(getattr(cfg, "shutdown_join_timeout_s", 0)
@@ -324,6 +358,40 @@ class _StreamLane:
                 if not self._heal(e):
                     raise
                 requeue = True
+
+    def _unit(self) -> int:
+        """The lane's dispatch unit: the active plan's micro-batch
+        (dynamic — the self-healing ladder's micro_batch rung drops
+        it to 1, and the lane must follow the demoted plan exactly
+        like the solo engine's cur_unit)."""
+        h = self.pipe.healer
+        if h is not None:
+            return min(self.window, h.micro_batch)
+        return self.micro_batch
+
+    def _dispatch_batch(self, got: list, b: int) -> list:
+        """Dispatch up to B ingested segments as ONE vmapped jit call
+        (file-mode archive lanes).  Unit 1, a short tail, or a healed
+        plan that no longer micro-batches all finish as plain single
+        dispatches (the vmapped B=1 program is a DIFFERENT trace —
+        the single path keeps lane outputs bit-identical to solo
+        runs), result-compatible by the solo engine's proof."""
+        segs, ingests, offsets = map(list, zip(*got))
+        first = self.dispatched
+        if b > 1 and len(segs) == b:
+            try:
+                return self.pipe._dispatch_micro_batch(
+                    segs, ingests, offsets, first)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — classified
+                if not self._heal(e):
+                    raise
+                return [self._dispatch(s, dt, off, first + i,
+                                       requeue=True)
+                        for i, (s, dt, off) in enumerate(got)]
+        return [self._dispatch(s, dt, off, first + i)
+                for i, (s, dt, off) in enumerate(got)]
 
     def reinit_cold(self) -> None:
         """Fleet-wide device reinit, this lane's share: swap in a
@@ -506,22 +574,36 @@ class _StreamLane:
         # 2) drain whatever is device-ready, in order
         if self.pending and self._drain_head(block=False):
             return True
-        # 3) admit + dispatch the next segment while the window has room
-        if self._live_count() < self.window and self._want_more() \
-                and not self.forced_shed:
+        # 3) admit + dispatch the next unit while the window has room
+        #    (file-mode lanes may micro-batch: B segments, one jit
+        #    call — admission gates on the WHOLE unit fitting, so the
+        #    lane's in-flight depth never exceeds its window; the
+        #    b = 1 case is the same path with a budget of one, routed
+        #    to a plain single dispatch inside _dispatch_batch)
+        if self._live_count() + self._unit() <= self.window \
+                and self._want_more() and not self.forced_shed:
             self._maybe_promote()
-            one = self._ingest_one(self.dispatched)
-            if one is not None:
-                seg, dt, off = one
-                self.pending.append(
-                    self._dispatch(seg, dt, off, self.dispatched))
-                self._live_add(1)
-                self.dispatched += 1
-                self.pipe.stats.segments += 1
-                self.pipe.stats.samples += \
-                    self.pipe.cfg.baseband_input_count
-                self._park_t0 = None
-                return True
+            b = self._unit()
+            if self._live_count() + b <= self.window:
+                # (a promotion probe may have restored a bigger unit
+                # that no longer fits: drain first, dispatch later)
+                budget = b if self.max_segments is None else \
+                    min(b, self.max_segments - self.dispatched)
+                got = []
+                while len(got) < budget:
+                    one = self._ingest_one(self.dispatched + len(got))
+                    if one is None:
+                        break
+                    got.append(one)
+                if got:
+                    self.pending.extend(self._dispatch_batch(got, b))
+                    self._live_add(len(got))
+                    self.dispatched += len(got)
+                    self.pipe.stats.segments += len(got)
+                    self.pipe.stats.samples += \
+                        self.pipe.cfg.baseband_input_count * len(got)
+                    self._park_t0 = None
+                    return True
         # 3b) whole window parked behind the sink: a real-time lane
         #    must never stall on a wedged sink — past the deadline
         #    with zero per-push progress, keep draining the source

@@ -1,0 +1,189 @@
+"""Periodicity/folding search mode as a registered plan family.
+
+:class:`PeriodicitySegmentProcessor` extends the single-pulse
+:class:`~srtb_tpu.pipeline.segment.SegmentProcessor` with the FPGA
+pulsar-search paper's module set (ops/periodicity.py): after the
+standard device chain produces the dedispersed detection time series,
+the same traced program appends a harmonic-summed power-spectrum
+search and phase-folds the top-K candidates — one plan, one dispatch,
+every execution variant (fused / staged / ring / micro-batch) for
+free, because the hook point is the shared ``_waterfall_detect`` tail
+every plan funnels through.
+
+The result type is a strict SUPERSET of ``DetectResult``: every
+single-pulse consumer (``has_signal``, sinks, the journal, the chaos
+soak's decision comparison) keeps working unchanged, and
+periodicity-aware consumers read the extra candidate fields.  The
+extra config knobs are trace-relevant (they shape the program), so
+they extend the AOT/shared-plan projection — two streams share a
+compiled periodicity plan only when the whole projection agrees, and
+a restart with different knobs misses the cache cleanly.
+
+Registered in ``pipeline/registry.py`` (mode "periodicity"), which is
+what makes the auditor, the demotion ladder (the ``search_mode`` rung
+sheds the mode FIRST on a device fault — the cheapest science to
+drop), the chaos soak and the fleet cover it without knowing it
+exists.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from srtb_tpu.ops import periodicity as P
+from srtb_tpu.pipeline.segment import SegmentProcessor
+
+
+class PeriodicityResult(NamedTuple):
+    """``DetectResult`` superset: the single-pulse fields first (same
+    names, same shapes — existing consumers index by attribute), then
+    the periodicity candidates, all batched over data streams."""
+
+    # ---- single-pulse fields (ops/detect.DetectResult) ----
+    zero_count: jnp.ndarray
+    time_series: jnp.ndarray
+    boxcar_lengths: tuple
+    signal_counts: jnp.ndarray
+    boxcar_series: jnp.ndarray
+    snr_peaks: jnp.ndarray
+    # ---- periodicity fields (ops/periodicity.py), per stream ----
+    candidate_bins: jnp.ndarray        # [S, K] int32
+    candidate_snr: jnp.ndarray         # [S, K] f32 (harmonic-summed)
+    candidate_harmonics: jnp.ndarray   # [S, K] int32
+    folded_profiles: jnp.ndarray       # [S, K, n_bins] f32
+    # static (like boxcar_lengths): (searched bins, harmonic levels)
+    # — the trial count the positive gate corrects for (the max of
+    # ~exponential per-bin scores over M*L trials sits near
+    # ln(M*L), NOT near 0, so an uncorrected sigma threshold fires
+    # on pure noise at any realistic series length)
+    candidate_trials: tuple = (1, 1)
+
+    # ---- mode hooks consumed by MODE-BLIND shared code: the engine
+    # (runtime.has_signal), the candidate writer and the journal all
+    # probe for these by name, so the next registered mode brings its
+    # own rules by defining them on its result type — no per-mode
+    # branches accrete in shared infrastructure (the registry
+    # contract).  All three run drain-side on device_get-fetched host
+    # data (NamedTuple methods survive the fetch: the tree unflattens
+    # back into this class).
+
+    def _host2d(self, x) -> np.ndarray:
+        a = np.asarray(x)
+        return a.reshape(1, -1) if a.ndim < 2 else a
+
+    def positive_gate(self, cfg) -> np.ndarray:
+        """Per-stream positive verdict, TRIALS-corrected: the per-bin
+        score is ~exponential under noise, so its maximum over
+        (searched bins x harmonic levels) trials concentrates near
+        ln(trials) — ``periodicity_snr_threshold`` is the MARGIN
+        above that expectation (Gumbel scale ~1 per unit), or every
+        noise segment at a realistic series length reads positive."""
+        # drain-side, post-fetch  # srtb-lint: disable=sync-hot-path
+        snr = self._host2d(self.candidate_snr)
+        thr = float(getattr(cfg, "periodicity_snr_threshold", 5.0))
+        # static ints riding the result (0-d arrays after a batched
+        # fetch)  # srtb-lint: disable=sync-hot-path
+        m, levels = (int(np.asarray(t).reshape(-1)[0])
+                     for t in self.candidate_trials)
+        return (snr >= thr + float(np.log(max(m * levels, 2)))) \
+            .any(axis=-1)
+
+    def span_extra(self) -> dict:
+        """Journal payload: the candidate table rides every segment's
+        span, so the search outcome survives even when the positive
+        gate withholds the file dumps."""
+        # drain-side host lists  # srtb-lint: disable=sync-hot-path
+        snr = self._host2d(self.candidate_snr)
+        return {"periodicity": {
+            # srtb-lint: disable=sync-hot-path
+            "bins": self._host2d(self.candidate_bins).tolist(),
+            "snr": [[round(float(x), 3) for x in row] for row in snr],
+            # srtb-lint: disable=sync-hot-path
+            "harmonics": self._host2d(
+                self.candidate_harmonics).tolist()}}
+
+    def extra_artifacts(self, base: str) -> list:
+        """``(path, uint8/float payload array)`` pairs the candidate
+        writer persists for a positive segment through its usual
+        temp+rename(+manifest) machinery: per stream, the folded
+        profiles ``<base>[.sN].fold.npy`` ([K, n_bins] f32 — the
+        mode's science product) and a ``.cand.json`` candidate table.
+        Deterministic bytes (same computation, same rounding, same
+        key order), so the replay equality gates cover these files
+        like any other."""
+        # drain-side, post-fetch  # srtb-lint: disable=sync-hot-path
+        prof = np.asarray(self.folded_profiles, dtype=np.float32)
+        if prof.ndim == 2:
+            prof = prof[None]
+        bins = self._host2d(self.candidate_bins)
+        snr = self._host2d(np.asarray(self.candidate_snr,
+                                      dtype=np.float32))
+        harm = self._host2d(self.candidate_harmonics)
+        multi = prof.shape[0] > 1
+        out = []
+        for s in range(prof.shape[0]):
+            stem = f"{base}.s{s}" if multi else base
+            out.append((f"{stem}.fold.npy", prof[s]))
+            meta = {"bins": [int(b) for b in bins[s]],
+                    "snr": [round(float(x), 4) for x in snr[s]],
+                    "harmonics": [int(h) for h in harm[s]]}
+            payload = json.dumps(meta, sort_keys=True).encode() + b"\n"
+            out.append((f"{stem}.cand.json",
+                        np.frombuffer(payload, np.uint8)))
+        return out
+
+
+class PeriodicitySegmentProcessor(SegmentProcessor):
+    """The single-pulse plan + in-trace periodicity search (see module
+    docstring).  All the parent's plan machinery — staged boundaries,
+    ring carries, micro-batch vmap, AOT lowerables, retirement — is
+    inherited: the only override is the detection tail, plus the
+    trace projection (mode + knobs) so plan signatures, cache keys and
+    plan names honestly distinguish the mode."""
+
+    MODE = "periodicity"
+
+    # the periodicity knobs shape the traced program (harmonic ladder
+    # depth, candidate count, fold bins are all static shapes), so
+    # they join the AOT/shared-plan projection
+    _TRACE_CFG_KEYS = SegmentProcessor._TRACE_CFG_KEYS + (
+        "search_mode", "periodicity_harmonics",
+        "periodicity_candidates", "periodicity_fold_bins",
+        "periodicity_min_bin",
+    )
+
+    @property
+    def plan_name(self) -> str:
+        return super().plan_name + "+period"
+
+    def _waterfall_detect(self, spec: jnp.ndarray):
+        """Every plan variant funnels through here (fused tail, legacy
+        spectrum tail, staged stage (c)) — append the periodicity
+        module to the single-pulse result inside the same trace."""
+        wf_ri, det = super()._waterfall_detect(spec)
+        cfg = self.cfg
+        harmonics = int(getattr(cfg, "periodicity_harmonics", 8) or 1)
+        top_k = max(1, int(getattr(cfg, "periodicity_candidates", 4)
+                           or 1))
+        n_bins = max(2, int(getattr(cfg, "periodicity_fold_bins", 64)
+                            or 2))
+        min_bin = max(1, int(getattr(cfg, "periodicity_min_bin", 2)
+                             or 1))
+        cands = jax.vmap(
+            lambda ts: P.periodicity_search(ts, harmonics, top_k,
+                                            n_bins, min_bin=min_bin)
+        )(det.time_series)  # [S, t] -> per-stream candidates
+        m = det.time_series.shape[-1] // 2 + 1
+        levels = P.harmonic_levels(harmonics)
+        return wf_ri, PeriodicityResult(
+            *det,
+            candidate_bins=cands.bins,
+            candidate_snr=cands.snr,
+            candidate_harmonics=cands.harmonics,
+            folded_profiles=cands.profiles,
+            candidate_trials=(max(m - min_bin, 1), len(levels)))

@@ -117,6 +117,15 @@ def has_signal(cfg: Config, detect_result, stream: int | None = None,
                  else cfg.spectrum_channel_count)
     ok = zero_count < cfg.signal_detect_channel_threshold * freq_bins
     fired = counts.sum(axis=-1) > 0
+    # registered-mode hook (pipeline/registry.py contract): a result
+    # type carrying its own positive rule (e.g. the periodicity
+    # mode's trials-corrected candidate gate) extends the verdict —
+    # the engine stays mode-blind, the mode owns its statistics
+    gate = getattr(detect_result, "positive_gate", None)
+    if gate is not None:
+        # the hook runs drain-side on fetched host
+        # data  # srtb-lint: disable=sync-hot-path
+        fired = fired | np.asarray(gate(cfg)).reshape(fired.shape)
     per_stream = ok & fired
     if stream is not None:
         return bool(per_stream[stream])
@@ -219,10 +228,13 @@ class Pipeline:
             # engine stages a fresh device array per segment and never
             # reuses it, so XLA may recycle its HBM as program scratch
             # (steady state does no net fresh device allocation).  Kept
-            # off on CPU where donation is a no-op.
+            # off on CPU where donation is a no-op.  Built through the
+            # plan registry so Config.search_mode selects the
+            # registered mode's processor class.
+            from srtb_tpu.pipeline import registry
             from srtb_tpu.utils.platform import on_accelerator
-            processor = SegmentProcessor(cfg,
-                                         donate_input=on_accelerator())
+            processor = registry.build_processor(
+                cfg, donate_input=on_accelerator())
         self.processor = processor
         self._owned_writer_pool = None
         # durable exactly-once outputs (io/manifest.py): opening the
@@ -262,7 +274,10 @@ class Pipeline:
             start = None
             if self.checkpoint and self.checkpoint.segments_done:
                 start = self.checkpoint.file_offset_bytes
-            source = BasebandFileReader(cfg, start_offset_bytes=start)
+            # make_file_source honors Config.deterministic_timestamps
+            # (offset-derived stamps -> reproducible artifact names)
+            from srtb_tpu.io.file_input import make_file_source
+            source = make_file_source(cfg, start_offset_bytes=start)
         self.source = source
         if sinks is None:
             if cfg.baseband_write_all:
@@ -439,9 +454,16 @@ class Pipeline:
         if counts is not None:
             det_count = int(np.asarray(counts).sum())
         if self.journal is not None:
+            # registered-mode hook: a result type with its own span
+            # payload (e.g. the periodicity candidate table) journals
+            # it on every segment — search outcomes survive even when
+            # the positive gate withholds the file dumps
+            span_extra = getattr(det_res, "span_extra", None)
+            extra = span_extra() if span_extra is not None else None
             self.journal.write(telemetry.segment_span(
                 index, span, queue_depth, det_count, positive, n_samples,
                 timestamp_ns=getattr(seg, "timestamp", 0),
+                extra=extra,
                 overlap_hidden_s=overlap_hidden_s,
                 inflight_depth=inflight_depth,
                 active_plan=getattr(self.processor, "plan_name", None),
@@ -487,10 +509,12 @@ class Pipeline:
         thing that changes is the plan itself; the rung's config
         changes trace-relevant knobs, so ``plan_signature()`` differs
         and any AOT cache (``cfg.aot_plan_path``, re-enabled by the
-        constructor) misses cleanly and re-lowers."""
+        constructor) misses cleanly and re-lowers.  Built through the
+        plan registry: the search_mode rung demotes by CHANGING the
+        mode, so the replacement may be a different processor class."""
         from srtb_tpu.ops import window as W
-        from srtb_tpu.pipeline.segment import SegmentProcessor
-        return SegmentProcessor(
+        from srtb_tpu.pipeline import registry
+        return registry.build_processor(
             cfg,
             window_name=getattr(self.processor, "_window_name",
                                 W.DEFAULT_WINDOW),

@@ -82,6 +82,18 @@ FUSED_TAIL_DF64_MAX_SPECTRUM = 1 << 27
 # maintained mirror of these rules would silently drift.
 
 
+def staged_resolves(cfg, staged: bool | None = None) -> bool:
+    """Resolution of the staged-plan flag from config alone (the
+    constructor's default when no explicit override is given) — the
+    single home of the size rule, shared by the demotion ladder's
+    rung predicates (pipeline/registry.py) and the fleet's pre-build
+    lane validation."""
+    if staged is not None:
+        return staged
+    return int(getattr(cfg, "baseband_input_count", 0) or 0) \
+        >= STAGED_MIN_N
+
+
 def ring_usable(cfg) -> bool:
     """Whether overlap-save reserves a non-empty, byte-aligned tail
     strictly smaller than the segment — the structural precondition of
@@ -142,6 +154,13 @@ class SegmentProcessor:
       ``python -m srtb_tpu.tools.plan_audit`` proves the aliasing
       statically per plan.
     """
+
+    # registered search mode this class implements
+    # (pipeline/registry.py): subclasses adding a search capability
+    # override it, and it stamps plan_signature/plan_cache_key so
+    # plans of different modes can never share an AOT entry or a
+    # fleet plan-cache slot
+    MODE = "single_pulse"
 
     def __init__(self, cfg: Config, window_name: str = W.DEFAULT_WINDOW,
                  compute_chirp_on_device: bool | None = None,
@@ -959,6 +978,7 @@ class SegmentProcessor:
         cfg_d, knobs = cls._trace_projection(cfg)
         return json.dumps(
             {"cfg": cfg_d, "env": knobs, "window": window_name,
+             "mode": cls.MODE,
              "donate_input": bool(donate_input)},
             sort_keys=True, default=str)
 
@@ -971,7 +991,8 @@ class SegmentProcessor:
 
         cfg_d, knobs = self._trace_projection(self.cfg)
         return json.dumps(
-            {"cfg": cfg_d, "env": knobs, "staged": self.staged,
+            {"cfg": cfg_d, "env": knobs, "mode": self.MODE,
+             "staged": self.staged,
              "interp": self._pallas_interpret,
              "window": self._window_name,
              "has_chirp": self.chirp is not None,

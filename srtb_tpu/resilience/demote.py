@@ -11,9 +11,11 @@ device-fault classification in :mod:`srtb_tpu.resilience.errors`:
 
 **Plan demotion** (oom / compile faults).  The ladder is an ordered
 list of progressively cheaper execution plans derived from the active
-config by switching off features in a fixed order::
+config by switching off features in a fixed order (owned by the plan
+registry, ``pipeline/registry.py``)::
 
-    micro_batch -> ring -> skzap -> fused_tail -> staged -> monolithic
+    search_mode -> micro_batch -> ring -> skzap -> fused_tail
+                -> staged -> monolithic
 
 Each rung is CUMULATIVE (rung k applies every earlier step too) and
 rungs that would not change the active config are skipped, so the
@@ -67,14 +69,16 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+from srtb_tpu.pipeline import registry
 from srtb_tpu.resilience.errors import classify_device
 from srtb_tpu.resilience.supervisor import Supervisor
 from srtb_tpu.utils.logging import log
 from srtb_tpu.utils.metrics import metrics
 
-# canonical rung order, cheapest-to-drop first (see module docstring)
-LADDER_ORDER = ("micro_batch", "ring", "skzap", "fused_tail", "staged",
-                "monolithic")
+# canonical rung order, cheapest-to-drop first — read from the ONE
+# plan-family registry (pipeline/registry.py), which also owns each
+# step's apply rule; this module keeps only the per-run state machine
+LADDER_ORDER = registry.ladder_order()
 
 
 @dataclass(frozen=True)
@@ -92,80 +96,15 @@ class Rung:
         return self.step
 
 
-def _resolved_staged(cfg, staged: bool | None) -> bool:
-    if staged is not None:
-        return staged
-    from srtb_tpu.pipeline.segment import STAGED_MIN_N
-    return int(getattr(cfg, "baseband_input_count", 0) or 0) \
-        >= STAGED_MIN_N
-
-
-def _ring_usable(cfg) -> bool:
-    """Whether the ingest ring can resolve ON for ``cfg`` (a rung
-    that demotes an already-off ring would burn a ladder level
-    changing nothing).  The structural rule is the SegmentProcessor's
-    own shared predicate — no mirror to drift."""
-    if str(getattr(cfg, "ingest_ring", "auto")).lower() == "off":
-        return False
-    from srtb_tpu.pipeline.segment import ring_usable
-    return ring_usable(cfg)
-
-
-def _resolves_fused_tail(cfg, staged: bool | None) -> bool:
-    """Whether ``fused_tail`` resolves ON for the (resolved) plan —
-    the SegmentProcessor's own shared predicate, so the fused_tail
-    rung is skipped exactly when the active plan already runs the
-    unfused chain (e.g. "auto" on a monolithic strategy)."""
-    from srtb_tpu.pipeline.segment import fused_tail_resolves
-    return fused_tail_resolves(cfg, _resolved_staged(cfg, staged))
-
-
 def _apply_step(cfg, step: str, staged: bool | None):
     """(new_cfg, new_staged) after one ladder step, or None when the
     step would not change the active RESOLVED plan (skipped rung —
     demoting onto an identical plan would burn a ladder level while
-    recovering nothing)."""
-    if step == "micro_batch":
-        if int(getattr(cfg, "micro_batch_segments", 1) or 1) <= 1:
-            return None
-        return cfg.replace(micro_batch_segments=1), staged
-    if step == "ring":
-        if not _ring_usable(cfg):
-            return None
-        return cfg.replace(ingest_ring="off"), staged
-    if step == "skzap":
-        if not (getattr(cfg, "use_pallas_sk", False)
-                and getattr(cfg, "use_pallas", False)):
-            return None
-        return cfg.replace(use_pallas_sk=False), staged
-    if step == "fused_tail":
-        # drops the fused epilogue AND the Pallas kernels hosting it:
-        # this rung is the Mosaic-free fallback, so a kernel compile
-        # fault cannot survive it
-        if not (_resolves_fused_tail(cfg, staged)
-                or getattr(cfg, "use_pallas", False)):
-            return None
-        return cfg.replace(fused_tail="off", use_pallas=False), staged
-    if step == "staged":
-        if _resolved_staged(cfg, staged):
-            return None
-        # staged forbids micro-batching; force it off even when an
-        # explicit plan_ladder subset skipped the micro_batch rung
-        if int(getattr(cfg, "micro_batch_segments", 1) or 1) > 1:
-            cfg = cfg.replace(micro_batch_segments=1)
-        return cfg, True
-    if step == "monolithic":
-        from srtb_tpu.ops import fft as F
-        n = int(getattr(cfg, "baseband_input_count", 0) or 0)
-        already = (not _resolved_staged(cfg, staged) and n > 0
-                   and F.resolve_strategy(
-                       n, getattr(cfg, "fft_strategy", "auto"))
-                   == "monolithic")
-        if already:
-            return None
-        return cfg.replace(fft_strategy="monolithic"), False
-    raise ValueError(f"unknown ladder step {step!r} "
-                     f"(steps: {', '.join(LADDER_ORDER)})")
+    recovering nothing).  The apply rules themselves live in the plan
+    registry, next to the families they demote between — and they
+    delegate to the SegmentProcessor's own pure-config resolvers, so
+    no mirrored rule can drift."""
+    return registry.ladder_step(step).apply(cfg, staged)
 
 
 def parse_ladder(text: str) -> tuple[str, ...]:

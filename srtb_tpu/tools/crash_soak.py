@@ -78,20 +78,11 @@ class SoakFailure(AssertionError):
 def make_resumable_source(cfg):
     """The file source a resumed child needs: checkpoint-aware start
     offset (mirroring Pipeline's own source construction) plus
-    offset-derived deterministic timestamps."""
-    from srtb_tpu.io.file_input import BasebandFileReader
-
-    class DeterministicTimestampReader(BasebandFileReader):
-        """Stamps ``timestamp`` from the segment's stream offset: the
-        same segment gets the same stamp in every run and every
-        resume, so file-mode artifact names (timestamp-derived when no
-        UDP counter exists) are reproducible."""
-
-        def __next__(self):
-            offset = self.logical_offset
-            work = super().__next__()
-            work.timestamp = 1_700_000_000_000_000_000 + offset
-            return work
+    offset-derived deterministic timestamps — the first-class reader
+    in io/file_input.py (``DeterministicTimestampReader``, promoted
+    out of this tool so the soaks and the archive replay engine share
+    one implementation)."""
+    from srtb_tpu.io.file_input import DeterministicTimestampReader
 
     start = None
     if cfg.checkpoint_path and (

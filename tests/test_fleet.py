@@ -606,13 +606,38 @@ def test_fleet_rejects_sanitize_and_micro_batch(tmp_path):
     res = fleet.run()
     assert res["s"].status == "failed"
     assert isinstance(res["s"].error, ValueError)
+    # REAL-TIME lanes (no input file) still reject micro-batch
+    # loudly: batching a live stream trades bounded latency for
+    # throughput silently
+    rt_cfg = _mkcfg(tmp_path, "s", bb, micro_batch_segments=2,
+                    inflight_segments=2).replace(input_file_path="")
     fleet = StreamFleet([
-        StreamSpec(name="s", cfg=_mkcfg(tmp_path, "s", bb,
+        StreamSpec(name="s", cfg=rt_cfg, source=iter(()),
+                   sinks=[_Cap()])])
+    res = fleet.run()
+    assert res["s"].status == "failed"
+    assert isinstance(res["s"].error, ValueError)
+    assert "file-mode" in str(res["s"].error)
+    # FILE-mode lanes accept it (the archive-replay shape): B
+    # segments per vmapped dispatch inside the fleet
+    cap = _Cap()
+    fleet = StreamFleet([
+        StreamSpec(name="s", cfg=_mkcfg(tmp_path, "smb", bb,
                                         micro_batch_segments=2,
+                                        inflight_segments=4),
+                   sinks=[cap])])
+    res = fleet.run()
+    assert res["s"].status == "done"
+    assert res["s"].drained == len(cap.out) > 0
+    # a batch bigger than the lane window still rejects
+    fleet = StreamFleet([
+        StreamSpec(name="s", cfg=_mkcfg(tmp_path, "sbig", bb,
+                                        micro_batch_segments=4,
                                         inflight_segments=2),
                    sinks=[_Cap()])])
     res = fleet.run()
     assert res["s"].status == "failed"
+    assert "exceeds" in str(res["s"].error)
 
 
 def test_fleet_duplicate_names_rejected(tmp_path):

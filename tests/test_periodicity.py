@@ -1,0 +1,373 @@
+"""Periodicity/folding search mode (ops/periodicity.py +
+pipeline/periodicity.py): harmonic-summed power-spectrum search +
+phase folding over the dedispersed time series, landing as a
+registered plan family."""
+
+import numpy as np
+import pytest
+
+from srtb_tpu.config import Config
+from srtb_tpu.io.synth import make_dispersed_baseband
+from srtb_tpu.ops import periodicity as P
+from srtb_tpu.pipeline import registry
+from srtb_tpu.pipeline.periodicity import (PeriodicityResult,
+                                           PeriodicitySegmentProcessor)
+from srtb_tpu.pipeline.runtime import has_signal
+from srtb_tpu.pipeline.segment import SegmentProcessor
+
+N = 1 << 14
+CHANNELS = 64
+
+
+def _cfg(**kw):
+    base = dict(
+        baseband_input_count=N, baseband_input_bits=8,
+        baseband_freq_low=1405.0, baseband_bandwidth=64.0,
+        baseband_sample_rate=128e6, dm=0.0,
+        spectrum_channel_count=CHANNELS,
+        mitigate_rfi_average_method_threshold=100.0,
+        mitigate_rfi_spectral_kurtosis_threshold=2.0,
+        signal_detect_signal_noise_threshold=6.0,
+        signal_detect_max_boxcar_length=8,
+        baseband_reserve_sample=False, fft_strategy="four_step")
+    base.update(kw)
+    return Config(**base)
+
+
+# ------------------------------------------------------------------
+# ops vs the numpy oracle
+
+
+def test_harmonic_levels():
+    assert P.harmonic_levels(1) == (1,)
+    assert P.harmonic_levels(8) == (1, 2, 4, 8)
+    assert P.harmonic_levels(6) == (1, 2, 4)
+
+
+def test_candidate_search_matches_oracle():
+    rng = np.random.default_rng(0)
+    ts = rng.standard_normal(512).astype(np.float32)
+    ts += 3.0 * np.sin(2 * np.pi * 17 * np.arange(512) / 512) \
+        .astype(np.float32)
+    ts -= ts.mean()
+    got = P.periodicity_search(ts, 8, 4, 32, min_bin=2)
+    o_bins, o_snr, o_harm, o_prof = P.periodicity_oracle(
+        ts, 8, 4, 32, min_bin=2)
+    np.testing.assert_array_equal(np.asarray(got.bins), o_bins)
+    np.testing.assert_allclose(np.asarray(got.snr), o_snr, rtol=2e-5)
+    np.testing.assert_array_equal(np.asarray(got.harmonics), o_harm)
+    np.testing.assert_allclose(np.asarray(got.profiles), o_prof,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_sinusoid_found_at_its_bin():
+    rng = np.random.default_rng(1)
+    t = 1024
+    ts = 0.3 * rng.standard_normal(t).astype(np.float32)
+    ts += np.sin(2 * np.pi * 37 * np.arange(t) / t).astype(np.float32)
+    ts -= ts.mean()
+    got = P.periodicity_search(ts, 8, 4, 64)
+    assert int(np.asarray(got.bins)[0]) == 37
+    assert float(np.asarray(got.snr)[0]) > 10.0
+
+
+def test_pulse_train_candidates_are_comb_teeth():
+    """A delta train's power lives on the comb at multiples of the
+    fundamental: every returned candidate must sit on a tooth, and
+    the folded profile at the top one concentrates the pulse."""
+    t = 1024
+    period = 64  # -> fundamental bin 16
+    ts = np.zeros(t, np.float32)
+    ts[::period] = 10.0
+    rng = np.random.default_rng(2)
+    ts += 0.1 * rng.standard_normal(t).astype(np.float32)
+    ts -= ts.mean()
+    got = P.periodicity_search(ts, 8, 4, 64)
+    fundamental = t // period
+    for b in np.asarray(got.bins):
+        assert int(b) % fundamental == 0, np.asarray(got.bins)
+    assert float(np.asarray(got.snr)[0]) > 3.0
+    prof = np.asarray(P.fold(ts, np.asarray(got.bins)[0], 64))
+    assert prof.max() > 5 * np.median(np.abs(prof))
+
+
+def test_weak_harmonics_win_by_summing():
+    """Harmonics individually near the noise floor: the summed level
+    must beat level 1 (the reason the harmonic ladder exists), and
+    the winning candidate is the fundamental with harmonics > 1."""
+    t = 1024
+    rng = np.random.default_rng(5)
+    ts = rng.standard_normal(t).astype(np.float32)
+    for h in (1, 2, 4, 8):
+        ts += 0.17 * np.sin(
+            2 * np.pi * 20 * h * np.arange(t) / t + 0.3 * h) \
+            .astype(np.float32)
+    ts -= ts.mean()
+    got = P.periodicity_search(ts, 16, 4, 64)
+    bins = [int(b) for b in np.asarray(got.bins)]
+    harm = [int(h) for h in np.asarray(got.harmonics)]
+    # the winner needed summing (harmonics > 1), and the fundamental
+    # is in the top candidates with a multi-harmonic level of its own
+    assert harm[0] > 1, (bins, harm)
+    assert 20 in bins[:2], bins
+    assert harm[bins.index(20)] > 1, (bins, harm)
+    assert float(np.asarray(got.snr)[0]) > 8.0
+
+
+def test_fold_uniform_series_is_flat():
+    ts = np.ones(256, np.float32)
+    prof = np.asarray(P.fold(ts, np.int32(7), 16))
+    np.testing.assert_allclose(prof, 1.0, rtol=1e-6)
+
+
+# ------------------------------------------------------------------
+# the processor: superset result, parity with the base plan
+
+
+@pytest.fixture(scope="module")
+def raw_segment():
+    return make_dispersed_baseband(N, 1405.0, 64.0, 0.0,
+                                   pulse_positions=N // 2,
+                                   pulse_amp=30.0, nbits=8)
+
+
+def test_processor_superset_of_single_pulse(raw_segment):
+    base = SegmentProcessor(_cfg())
+    per = registry.build_processor(_cfg(search_mode="periodicity"))
+    assert isinstance(per, PeriodicitySegmentProcessor)
+    wf_b, det_b = base.process(raw_segment)
+    wf_p, det_p = per.process(raw_segment)
+    assert isinstance(det_p, PeriodicityResult)
+    # the single-pulse half is BIT-identical (same chain, same trace)
+    np.testing.assert_array_equal(np.asarray(wf_b), np.asarray(wf_p))
+    np.testing.assert_array_equal(np.asarray(det_b.signal_counts),
+                                  np.asarray(det_p.signal_counts))
+    np.testing.assert_array_equal(np.asarray(det_b.zero_count),
+                                  np.asarray(det_p.zero_count))
+    np.testing.assert_array_equal(np.asarray(det_b.time_series),
+                                  np.asarray(det_p.time_series))
+    # candidate shapes: [S, K] / [S, K, bins]
+    k = per.cfg.periodicity_candidates
+    s = det_p.candidate_snr.shape[0]
+    assert det_p.candidate_bins.shape == (s, k)
+    assert det_p.folded_profiles.shape == \
+        (s, k, per.cfg.periodicity_fold_bins)
+    # the candidates agree with the oracle run on the SAME ts
+    ts = np.asarray(det_p.time_series)[0]
+    o_bins, _, _, _ = P.periodicity_oracle(
+        ts, per.cfg.periodicity_harmonics, k,
+        per.cfg.periodicity_fold_bins,
+        min_bin=per.cfg.periodicity_min_bin)
+    np.testing.assert_array_equal(
+        np.asarray(det_p.candidate_bins)[0], o_bins)
+
+
+def test_plan_identity_distinguishes_the_mode():
+    base = SegmentProcessor(_cfg())
+    per = PeriodicitySegmentProcessor(_cfg(search_mode="periodicity"))
+    assert per.plan_name.endswith("+period")
+    assert per.plan_signature() != base.plan_signature()
+    assert per.MODE == "periodicity"
+    # knob changes re-key the plan (AOT must miss cleanly)
+    per2 = PeriodicitySegmentProcessor(
+        _cfg(search_mode="periodicity", periodicity_fold_bins=32))
+    assert per2.plan_signature() != per.plan_signature()
+
+
+def test_micro_batch_carries_candidates(raw_segment):
+    per = registry.build_processor(
+        _cfg(search_mode="periodicity", micro_batch_segments=2))
+    batch = np.stack([raw_segment, raw_segment])
+    wf, det = per.process_batch(batch)
+    assert det.candidate_snr.shape[0] == 2
+    np.testing.assert_array_equal(np.asarray(det.candidate_bins)[0],
+                                  np.asarray(det.candidate_bins)[1])
+
+
+def test_periodic_baseband_detected_end_to_end():
+    """A pulse train in the BASEBAND surfaces as a high-SNR folding
+    candidate at the train's bin, versus a noise-only segment."""
+    period = 1024  # baseband samples; 1 waterfall bin = N/T samples
+    train = make_dispersed_baseband(
+        N, 1405.0, 64.0, 0.0,
+        pulse_positions=list(range(period // 2, N - 64, period)),
+        pulse_amp=60.0, pulse_width=16, nbits=8, seed=3)
+    noise = make_dispersed_baseband(N, 1405.0, 64.0, 0.0,
+                                    pulse_positions=[], nbits=8,
+                                    seed=4)
+    # a strong pulse train is maximally kurtotic: keep the SK zap out
+    # of the way (the crash-soak recipe) or the whole waterfall zaps
+    # to zero and the time series is empty
+    per = registry.build_processor(
+        _cfg(search_mode="periodicity",
+             mitigate_rfi_average_method_threshold=1000.0,
+             mitigate_rfi_spectral_kurtosis_threshold=50.0))
+    _, det_t = per.process(train)
+    _, det_n = per.process(noise)
+    t_len = np.asarray(det_t.time_series).shape[-1]
+    fundamental = t_len // (period // (N // t_len))
+    bins = [int(b) for b in np.asarray(det_t.candidate_bins)[0]]
+    # every train candidate sits ON the comb (multiples of the
+    # fundamental: the period really was found)...
+    assert all(b % fundamental == 0 for b in bins), (bins,
+                                                     fundamental)
+    assert bins[0] in (fundamental, 2 * fundamental), bins
+    # ...while the noise run's candidates don't line up on any comb
+    nbins = [int(b) for b in np.asarray(det_n.candidate_bins)[0]]
+    assert any(b % fundamental != 0 for b in nbins), nbins
+    # the top candidate's fold concentrates the pulse
+    prof = np.asarray(det_t.folded_profiles)[0, 0]
+    assert prof.max() > 3 * np.median(np.abs(prof)), prof
+
+
+def _mk_result(snr, trials=(1, 1)):
+    """A host-side PeriodicityResult with zero boxcar hits and the
+    given candidate scores — exercises the result type's OWN
+    positive_gate hook, the way has_signal consumes it."""
+    snr = np.asarray(snr, np.float32)
+    k = snr.shape[-1]
+    return PeriodicityResult(
+        zero_count=np.zeros(1, np.int32),
+        time_series=np.zeros((1, 8), np.float32),
+        boxcar_lengths=(1,),
+        signal_counts=np.zeros((1, 3), np.int32),
+        boxcar_series=np.zeros((1, 1, 8), np.float32),
+        snr_peaks=np.zeros((1, 3), np.float32),
+        candidate_bins=np.zeros((1, k), np.int32),
+        candidate_snr=snr,
+        candidate_harmonics=np.ones((1, k), np.int32),
+        folded_profiles=np.zeros((1, k, 4), np.float32),
+        candidate_trials=trials)
+
+
+def test_has_signal_gates_on_candidate_snr():
+    cfg = _cfg(search_mode="periodicity",
+               periodicity_snr_threshold=6.0)
+    # trials (1, 1): gate = 6 + ln(2) ~ 6.7
+    assert has_signal(cfg, _mk_result([[7.0, 1.0]])) is True
+    assert has_signal(cfg, _mk_result([[3.0, 1.0]])) is False
+    # trials correction: the same raw score over many searched bins
+    # is just the noise maximum — the gate moves to ln(trials) +
+    # margin and only a genuinely exceptional score fires
+    t = (100, 4)  # gate = 6 + ln(400) ~ 12.0
+    assert has_signal(cfg, _mk_result([[7.0, 1.0]], t)) is False
+    assert has_signal(cfg, _mk_result([[13.0, 1.0]], t)) is True
+
+
+def test_noise_segments_not_positive_end_to_end(tmp_path):
+    """The verify-run regression: a pure-noise file in periodicity
+    mode must NOT mark every segment positive (the uncorrected gate
+    fired on the noise maximum of ~M*L exponential trials)."""
+    import os
+
+    from srtb_tpu.pipeline.runtime import Pipeline
+
+    n = 1 << 17
+    path = os.path.join(str(tmp_path), "noise.bin")
+    np.random.default_rng(42).integers(
+        0, 256, size=2 * n, dtype=np.uint8).tofile(path)
+    cfg = _cfg(search_mode="periodicity",
+               baseband_input_count=n,
+               spectrum_channel_count=1 << 8,
+               signal_detect_signal_noise_threshold=8.0,
+               input_file_path=path,
+               baseband_output_file_prefix=os.path.join(
+                   str(tmp_path), "out_"),
+               writer_thread_count=0)
+    with Pipeline(cfg, sinks=[]) as pipe:
+        stats = pipe.run()
+    assert stats.segments >= 2
+    assert stats.signals == 0, \
+        "noise segments read positive: the periodicity gate is not " \
+        "trials-corrected"
+
+
+def test_candidates_persisted_to_disk_and_journal(tmp_path):
+    """The mode's science product survives the drain: positive
+    segments write <base>.fold.npy ([K, n_bins] profiles) +
+    <base>.cand.json (candidate table), and every segment's
+    candidates land in the journal span."""
+    import json as _json
+    import os
+
+    from srtb_tpu.pipeline.runtime import Pipeline
+
+    n = 1 << 13
+    path = os.path.join(str(tmp_path), "bb.bin")
+    make_dispersed_baseband(n * 2, 1405.0, 64.0, 0.0,
+                            pulse_positions=[n // 2, n + n // 2],
+                            pulse_amp=40.0, nbits=8).tofile(path)
+    journal = os.path.join(str(tmp_path), "j.jsonl")
+    cfg = _cfg(search_mode="periodicity",
+               baseband_input_count=n,
+               signal_detect_signal_noise_threshold=2.0,
+               input_file_path=path,
+               baseband_output_file_prefix=os.path.join(
+                   str(tmp_path), "out_"),
+               writer_thread_count=0,
+               telemetry_journal_path=journal)
+    with Pipeline(cfg) as pipe:
+        stats = pipe.run()
+    assert stats.signals > 0
+    names = sorted(os.listdir(str(tmp_path)))
+    folds = [f for f in names if f.endswith(".fold.npy")]
+    cands = [f for f in names if f.endswith(".cand.json")]
+    assert folds and len(folds) == len(cands)
+    prof = np.load(os.path.join(str(tmp_path), folds[0]))
+    assert prof.shape == (cfg.periodicity_candidates,
+                          cfg.periodicity_fold_bins)
+    with open(os.path.join(str(tmp_path), cands[0])) as f:
+        meta = _json.load(f)
+    assert len(meta["bins"]) == len(meta["snr"]) \
+        == len(meta["harmonics"]) == cfg.periodicity_candidates
+    with open(journal) as f:
+        recs = [_json.loads(ln) for ln in f if ln.strip()]
+    spans = [r for r in recs if r.get("type") == "segment_span"]
+    assert spans and all("periodicity" in r for r in spans)
+    assert spans[0]["periodicity"]["bins"][0]
+
+
+def test_ladder_demotes_out_of_periodicity_end_to_end(tmp_path):
+    """A device OOM on the periodicity plan demotes through the
+    search_mode rung: the run completes on the single-pulse plan with
+    the demotion accounted, and the single-pulse outputs survive."""
+    import os
+
+    from srtb_tpu.pipeline.runtime import Pipeline
+    from srtb_tpu.utils.metrics import metrics
+
+    n = 1 << 13
+    path = os.path.join(str(tmp_path), "bb.bin")
+    make_dispersed_baseband(n * 3, 1405.0, 64.0, 0.0,
+                            pulse_positions=n, nbits=8).tofile(path)
+    cfg = _cfg(search_mode="periodicity",
+               baseband_input_count=n,
+               input_file_path=path,
+               baseband_output_file_prefix=os.path.join(
+                   str(tmp_path), "out_"),
+               writer_thread_count=0,
+               inflight_segments=2,
+               fault_plan="dispatch:oom@1",
+               retry_backoff_base_s=0.001)
+
+    class Cap:
+        def __init__(self):
+            self.out = []
+
+        def push(self, w, p):
+            self.out.append(type(w.detect).__name__)
+
+    metrics.reset()
+    cap = Cap()
+    with Pipeline(cfg, sinks=[cap]) as pipe:
+        stats = pipe.run()
+        assert pipe.faults.unfired() == []
+        # demoted plan: single-pulse, the +period suffix gone
+        assert "+period" not in pipe.processor.plan_name
+    assert stats.segments >= 3
+    assert metrics.get("plan_demotions") >= 1
+    # pre-fault segments carried candidates; post-demotion ones are
+    # plain DetectResults — both drain through the same sink
+    assert "PeriodicityResult" in cap.out
+    assert "DetectResult" in cap.out
+    metrics.reset()

@@ -1,0 +1,118 @@
+#!/bin/bash
+# Round-8 TPU hardware backlog: archive-replay throughput + the
+# periodicity/folding search mode, on top of the still-undrained r7
+# backlog (ring A/Bs).  The archive legs measure what the replay
+# engine exists for — recorded baseband at full device occupancy, no
+# real-time pacing, deep micro-batch, files fanned across fleet lanes
+# — against the real-time-shaped solo engine on the same bytes; the
+# periodicity legs price the harmonic-sum + folding module against the
+# single-pulse plan it extends.  Safe to re-run; each block is
+# independent.  Run from the repo root with the TPU visible
+# (tools_tpu_watcher.sh fires it automatically).
+#
+#   bash tools_tpu_r8_queue.sh [quick]
+#
+# "quick" drains only the new r8 rows (skips the r7 backlog and the
+# long 2^30 / multi-GB-archive blocks).
+set -u
+OUT=${SRTB_PERF_OUT:-PERF_TPU.jsonl}
+stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
+note() { echo "{\"ts\": \"$(stamp)\", \"variant\": \"note\", \"note\": \"$1\"}" >> "$OUT"; }
+run() {
+  local tag="$1"; shift
+  echo "== $tag =="
+  local line
+  line=$("$@" 2>/dev/null | grep '^{' | tail -1)
+  if [ -n "$line" ]; then
+    echo "{\"ts\": \"$(stamp)\", \"variant\": \"$tag\", \"result\": $line}" >> "$OUT"
+    echo "$line"
+  else
+    echo "{\"ts\": \"$(stamp)\", \"variant\": \"$tag\", \"error\": true}" >> "$OUT"
+  fi
+}
+
+QUICK=${1:-}
+
+# ---- 0. the r7 backlog first (ring A/Bs, never drained) ----
+if [ "$QUICK" != "quick" ] && [ -f tools_tpu_r7_queue.sh ]; then
+  note "r8 queue: draining r7 backlog first"
+  bash tools_tpu_r7_queue.sh quick
+fi
+
+note "r8 queue start: archive replay throughput + periodicity search mode"
+
+# ---- 1. periodicity A/B at 2^27: the harmonic-sum + folding module
+#          rides the detection time series (2^16 samples at 2^11
+#          channels), so its cost should be dispatch-level noise next
+#          to the segment FFTs — this pair prices that claim.
+run period_off_27 env SRTB_BENCH_LOG2N=27 SRTB_BENCH_FFT_STRATEGY=four_step \
+    SRTB_BENCH_DEADLINE=900 python bench.py
+run period_on_27  env SRTB_BENCH_LOG2N=27 SRTB_BENCH_FFT_STRATEGY=four_step \
+    SRTB_BENCH_SEARCH_MODE=periodicity SRTB_BENCH_DEADLINE=900 python bench.py
+
+# ---- 2. archive replay vs real-time-shaped streaming on the SAME
+#          recorded bytes (2^24-sample segments, 8 files x 32
+#          segments): replay = fleet-fanned lanes, micro-batch 4,
+#          window 8; baseline = the solo serial engine, one file at a
+#          time.  seg/s ratio is the engine's payoff number
+#          (PERF.md round 16 carries the CPU methodology + noise
+#          caveat).
+ARCH_DIR=${SRTB_ARCHIVE_DIR:-/tmp/srtb_r8_archive}
+python - <<'EOF'
+import os
+from srtb_tpu.io.synth import make_dispersed_baseband
+d = os.environ.get("SRTB_ARCHIVE_DIR", "/tmp/srtb_r8_archive")
+os.makedirs(d, exist_ok=True)
+n = 1 << 24
+for i in range(8):
+    p = os.path.join(d, f"arch{i}.bin")
+    if not (os.path.exists(p) and os.path.getsize(p) == n * 32):
+        make_dispersed_baseband(
+            n * 32, 1405.0, 64.0, 0.05,
+            pulse_positions=[n // 2 + j * n for j in range(32)],
+            pulse_amp=30.0, nbits=8, seed=i).tofile(p)
+EOF
+run archive_stream_24 python - <<EOF
+import glob, json, os, time
+from srtb_tpu.config import Config
+from srtb_tpu.pipeline.runtime import Pipeline
+cfg0 = dict(baseband_input_count=1 << 24, baseband_input_bits=8,
+            baseband_freq_low=1405.0, baseband_bandwidth=64.0,
+            baseband_sample_rate=128e6, dm=0.05,
+            spectrum_channel_count=1 << 11,
+            signal_detect_signal_noise_threshold=50.0,
+            baseband_reserve_sample=True, writer_thread_count=0,
+            fft_strategy="four_step", deterministic_timestamps=True)
+t0 = time.perf_counter(); segs = 0
+for i, f in enumerate(sorted(glob.glob("$ARCH_DIR/arch*.bin"))):
+    cfg = Config(**cfg0).replace(
+        input_file_path=f, inflight_segments=2,
+        baseband_output_file_prefix=f"$ARCH_DIR/solo{i}_")
+    with Pipeline(cfg, sinks=[]) as p:
+        segs += p.run().segments
+dt = time.perf_counter() - t0
+print(json.dumps({"metric": "archive_stream_seg_s",
+                  "value": round(segs / dt, 2), "segments": segs,
+                  "elapsed_s": round(dt, 1)}))
+EOF
+run archive_replay_24 python -m srtb_tpu.tools.archive_replay \
+    --files "$ARCH_DIR/arch*.bin" --out-dir "$ARCH_DIR/replay" \
+    --lanes 4 --micro-batch 4 --inflight 8 --no-waterfall \
+    --set baseband_input_count="2 ** 24" --set baseband_input_bits=8 \
+    --set baseband_freq_low=1405.0 --set baseband_bandwidth=64.0 \
+    --set baseband_sample_rate=128e6 --set dm=0.05 \
+    --set spectrum_channel_count="2 ** 11" \
+    --set signal_detect_signal_noise_threshold=50.0 \
+    --set baseband_reserve_sample=1 --set writer_thread_count=0 \
+    --set fft_strategy=four_step
+
+if [ "$QUICK" = "quick" ]; then exit 0; fi
+
+# ---- 3. periodicity at the 2^30 staged production shape: the mode
+#          must survive the staged plan's three-program chain (the
+#          folding rides stage (c)).
+run period_staged_30 env SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 \
+    SRTB_BENCH_SEARCH_MODE=periodicity SRTB_BENCH_REPS=3 \
+    SRTB_BENCH_DEADLINE=2700 python bench.py
+
+note "r8 queue done"
