@@ -24,13 +24,13 @@ if [ "${1:-}" = "--hardware" ]; then
   exit 0
 fi
 
-echo "== [1/16] native build =="
+echo "== [1/17] native build =="
 make -C srtb_tpu/native
 
-echo "== [2/16] native sanitizer harness (ASan/UBSan) =="
+echo "== [2/17] native sanitizer harness (ASan/UBSan) =="
 make -C srtb_tpu/native check
 
-echo "== [3/16] static checks (compile + import) =="
+echo "== [3/17] static checks (compile + import) =="
 python -m compileall -q srtb_tpu tests bench.py __graft_entry__.py
 python - <<'EOF'
 import importlib, pkgutil
@@ -45,7 +45,7 @@ assert not bad, bad
 print(f"all srtb_tpu modules import cleanly")
 EOF
 
-echo "== [4/16] srtb-lint (static analysis vs baseline) =="
+echo "== [4/17] srtb-lint (static analysis vs baseline) =="
 # fails on findings not in srtb_tpu/analysis/baseline.json; accept an
 # intentional finding with --write-baseline + a note, or a pragma.
 # The machine-readable run lands next to the other CI artifacts.
@@ -54,7 +54,7 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.lint srtb_tpu/ \
   --format json > artifacts/lint.json \
   || { cat artifacts/lint.json; exit 1; }
 
-echo "== [5/16] plan audit (compile-time HLO cards vs baseline) =="
+echo "== [5/17] plan audit (compile-time HLO cards vs baseline) =="
 # AOT-lowers every plan family and audits the compiled artifacts:
 # spectrum-sized HBM sweeps vs the declared hbm_passes floor, donation
 # proven aliased (not silently dropped), no f64/host-callback/
@@ -66,7 +66,7 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.plan_audit \
   --out artifacts/plan_cards_audit.json
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.plan_audit --selftest
 
-echo "== [6/16] pytest (8-device CPU mesh) =="
+echo "== [6/17] pytest (8-device CPU mesh) =="
 FAST_ARGS=()
 if [ "${1:-}" = "--fast" ]; then
   # one source of truth for what "slow" means: the pytest marker
@@ -75,11 +75,11 @@ if [ "${1:-}" = "--fast" ]; then
 fi
 python -m pytest tests/ -q "${FAST_ARGS[@]}"
 
-echo "== [7/16] bench smoke (with the roofline/audit cross-check) =="
+echo "== [7/17] bench smoke (with the roofline/audit cross-check) =="
 JAX_PLATFORMS=cpu SRTB_BENCH_LOG2N=16 SRTB_BENCH_AUDIT=1 \
   python bench.py | tail -1
 
-echo "== [8/16] fused-plan parity (spectrum-pass fusion, Pallas interpret on CPU) =="
+echo "== [8/17] fused-plan parity (spectrum-pass fusion, Pallas interpret on CPU) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import numpy as np
 
@@ -122,13 +122,13 @@ print(f"fused-plan parity OK: plan {fused.plan_name} "
       "detections bit-identical")
 EOF
 
-echo "== [9/16] ring parity smoke (incremental H2D ring on vs off, Pallas interpret) =="
+echo "== [9/17] ring parity smoke (incremental H2D ring on vs off, Pallas interpret) =="
 # The ISSUE-8 acceptance gate: ring-on output is bit-identical to
 # ring-off on a Pallas-kernel plan (interpret mode on CPU), and the
 # per-segment h2d_bytes counter equals the stride model exactly — the
 # full segment on the one cold dispatch, stride_bytes (segment minus
 # the reserved overlap tail) on every warm dispatch.  The plan-audit
-# stage [5/16] already proved the carry donation is a real alias for
+# stage [5/17] already proved the carry donation is a real alias for
 # every ring-v1 family; this proves the runtime keeps its half of the
 # contract.
 JAX_PLATFORMS=cpu python - <<'EOF'
@@ -191,7 +191,7 @@ print(f"ring parity OK: plan {proc.plan_name}, {s_on.segments} segments "
       f"{proc.reserved_bytes / seg_b:.1%} per warm segment)")
 EOF
 
-echo "== [10/16] telemetry + sanitizer smoke (journal + report + /metrics + /healthz + Config.sanitize) =="
+echo "== [10/17] telemetry + sanitizer smoke (journal + report + /metrics + /healthz + Config.sanitize) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import json, os, tempfile, urllib.request
 
@@ -225,7 +225,7 @@ recs = TR.load(journal)
 assert recs, "telemetry journal is empty"
 # schema-v3 span fields (async engine + resilience) on every record
 for rec in recs:
-    assert rec["v"] == 6, rec
+    assert rec["v"] == 7, rec
     assert "overlap_hidden_ms" in rec and rec["inflight_depth"] >= 1, rec
     for key in ("degrade_level", "retries", "requeues", "restarts"):
         assert key in rec, (key, rec)
@@ -267,7 +267,7 @@ print(f"sanitizer smoke OK: {stats_s.segments} segments with "
       "Config.sanitize on, tripwire restored")
 EOF
 
-echo "== [11/16] fault-injection smoke (one transient fault at every site -> recovery + v6 telemetry) =="
+echo "== [11/17] fault-injection smoke (one transient fault at every site -> recovery + v7 telemetry) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import json, os, tempfile
 
@@ -334,7 +334,7 @@ assert "srtb_retries_total 6" in prom, prom[:400]
 assert "srtb_faults_injected 6" in prom
 # v3 journal fields + report resilience section
 recs = TR.load(journal)
-assert recs and all(r["v"] == 6 for r in recs)
+assert recs and all(r["v"] == 7 for r in recs)
 # the checkpoint-site retry of the last segment lands after that
 # segment's journal write: the final record carries 5 of the 6
 assert recs[-1]["retries"] == 5 and recs[-1]["requeues"] == 0
@@ -342,10 +342,10 @@ rep = TR.report(journal)
 assert rep["resilience"]["retries"] == 5, rep["resilience"]
 print(f"fault-injection smoke OK: {st1.segments} segments recovered "
       "bit-identical through 6 injected faults, retries accounted in "
-      "/metrics + v6 journal")
+      "/metrics + v7 journal")
 EOF
 
-echo "== [12/16] chaos smoke (self-healing compute: oom + compile_fail + device_halt in one run) =="
+echo "== [12/17] chaos smoke (self-healing compute: oom + compile_fail + device_halt in one run) =="
 # The ISSUE-9 acceptance gate: a deterministic fault plan injecting all
 # three device-fault classes completes with accounted-only loss,
 # detection decisions identical to the clean run, and the
@@ -359,7 +359,7 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.chaos_soak --segments 6 \
   | tail -1
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.chaos_soak --selftest
 
-echo "== [13/16] crash-soak smoke (SIGKILL exactly-once: manifest recovery + fsck + bit-identical union) =="
+echo "== [13/17] crash-soak smoke (SIGKILL exactly-once: manifest recovery + fsck + bit-identical union) =="
 # The ISSUE-10 acceptance gate, CI-sized: a deterministic two-kill plan
 # — one SIGKILL mid-checkpoint-flush (between sink commit and the
 # checkpoint update, the duplicate-on-resume window) and one mid-
@@ -374,18 +374,18 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.crash_soak --segments 5 \
   --kills 2 --kill-plan "ckpt_stall@1,rename@1" --log2n 13 | tail -1
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.fsck --selftest
 
-echo "== [14/16] multichip dryrun (8 virtual devices) =="
+echo "== [14/17] multichip dryrun (8 virtual devices) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== [15/16] fleet smoke (multi-tenant bulkheads: 3 streams, 1 victim, shared plan cache) =="
+echo "== [15/17] fleet smoke (multi-tenant bulkheads: 3 streams, 1 victim, shared plan cache) =="
 # The ISSUE-11 acceptance gate, CI-sized: 3 seeded streams on one
 # device, a stream-selector fault plan injected into stream0 (oom ->
 # victim-only demotion, plus a transient sink fault and a fetch
 # stall).  Gate: every healthy stream's output set (paths + SHA-256)
 # bit-identical to its solo single-stream golden run, the victim's
 # loss accounted-only with demotions attributed to its stream id in
-# the v6 journal, and the shared AOT plan cache recording exactly ONE
+# the v7 journal, and the shared AOT plan cache recording exactly ONE
 # compile for the shared plan family.  The selftest then proves the
 # gate catches cross-stream leakage (an UNSCOPED fault plan arming in
 # every lane must FAIL the healthy-journal attribution check).
@@ -393,7 +393,7 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.fleet_soak --streams 3 \
   --segments 4 --log2n 12 | tail -1
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.fleet_soak --selftest
 
-echo "== [16/16] archive-replay smoke (full-throughput replay: SIGTERM resume + bit-identical union + micro-batch tolerance) =="
+echo "== [16/17] archive-replay smoke (full-throughput replay: SIGTERM resume + bit-identical union + micro-batch tolerance) =="
 # The ISSUE-12 acceptance gate, CI-sized: a 2-file fleet-fanned replay
 # (deterministic timestamps, per-file checkpoint + manifest namespaces)
 # killed by a SIGTERM steered into one lane's sink-write window, then
@@ -404,5 +404,92 @@ echo "== [16/16] archive-replay smoke (full-throughput replay: SIGTERM resume + 
 # bitwise, float artifacts within the documented vmap tolerance).
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.archive_replay --selftest \
   --segments 4 --log2n 13 | tail -1
+
+echo "== [17/17] trace/incident smoke (causal tracing + flight recorder + bundle + Chrome-trace export) =="
+# The ISSUE-13 acceptance gate, CI-sized: a clean traced run proves
+# every segment leaves a complete ingest->dispatch->fetch->sink causal
+# chain whose export is valid Chrome-trace JSON (schema-checked, flow
+# arrows crossing the engine/sink thread boundary — no Perfetto needed
+# in CI); then a seeded fault-plan escalation (oom -> one demotion ->
+# ladder exhausted) must produce EXACTLY ONE incident bundle whose
+# events hold the injected fault site, the device classification, the
+# heal decision, the manifest disposition, and the offending trace_id.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json, os, tempfile
+
+from srtb_tpu.config import Config
+from srtb_tpu.io.synth import make_dispersed_baseband
+from srtb_tpu.pipeline.runtime import Pipeline
+from srtb_tpu.tools import trace_export as TE
+from srtb_tpu.utils import events
+
+tmp = tempfile.mkdtemp(prefix="srtb_ci_trace_")
+n = 1 << 14
+make_dispersed_baseband(n * 4, 1405.0, 64.0, 0.0, pulse_positions=n // 2,
+                        pulse_amp=30.0, nbits=8).tofile(
+    os.path.join(tmp, "bb.bin"))
+
+def cfg(tag, **kw):
+    return Config(baseband_input_count=n, baseband_input_bits=8,
+                  baseband_freq_low=1405.0, baseband_bandwidth=64.0,
+                  baseband_sample_rate=128e6,
+                  input_file_path=os.path.join(tmp, "bb.bin"),
+                  baseband_output_file_prefix=os.path.join(tmp, tag),
+                  spectrum_channel_count=1 << 6,
+                  mitigate_rfi_average_method_threshold=100.0,
+                  mitigate_rfi_spectral_kurtosis_threshold=2.0,
+                  baseband_reserve_sample=False, writer_thread_count=0,
+                  retry_backoff_base_s=0.001, **kw)
+
+# leg 1: clean traced run -> valid Chrome-trace export with flows
+dump = os.path.join(tmp, "events.jsonl")
+with Pipeline(cfg("clean_", inflight_segments=3,
+                  events_dump_path=dump), sinks=[]) as pipe:
+    stats = pipe.run()
+doc = TE.render(TE.load_events(dump))
+problems = TE.validate(doc)
+assert not problems, problems
+slices = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+for stage in ("ingest", "dispatch", "fetch", "sink"):
+    assert sum(1 for e in slices if e["name"] == stage) == stats.segments
+starts = [e for e in doc["traceEvents"] if e["ph"] == "s"]
+finishes = {e["id"]: e for e in doc["traceEvents"] if e["ph"] == "f"}
+assert len(starts) == len(finishes) == stats.segments
+assert all(s["tid"] != finishes[s["id"]]["tid"] for s in starts), \
+    "flow must cross the engine->sink thread boundary"
+assert TE.main([dump, "--validate"]) == 0
+
+# leg 2: seeded escalation -> exactly one bundle, offending trace inside
+from srtb_tpu.resilience.errors import LadderExhausted
+inc = os.path.join(tmp, "incidents")
+try:
+    with Pipeline(cfg("esc_", inflight_segments=1,
+                      fault_plan="dispatch:oom@1,fetch:oom@2",
+                      plan_ladder="staged", device_reinit_max=0,
+                      incident_dir=inc,
+                      checkpoint_path=os.path.join(tmp, "ck.json"),
+                      run_manifest_path=os.path.join(tmp, "m.wal"))) as pipe:
+        pipe.run()
+    raise AssertionError("escalation did not escalate")
+except LadderExhausted:
+    pass
+bundles = [d for d in os.listdir(inc) if d.startswith("incident_")]
+assert len(bundles) == 1, bundles
+b = os.path.join(inc, bundles[0])
+meta = json.load(open(os.path.join(b, "incident.json")))
+assert meta["kind"] == "ladder_exhausted" and meta["trace_id"] > 0
+evs = [json.loads(ln) for ln in open(os.path.join(b, "events.jsonl"))]
+types = [e["type"] for e in evs]
+assert types.count("fault.injected") == 2 and "heal.demote" in types
+assert types.count("fault.device") == 2 and "manifest.ckpt" in types
+tr = [json.loads(ln) for ln in open(os.path.join(b, "trace.jsonl"))]
+assert tr and all(e["trace"] == meta["trace_id"] for e in tr)
+# the bundle's recorder tail exports as valid Chrome-trace JSON too
+assert TE.main([b, "--validate"]) == 0
+print(f"trace/incident smoke OK: {stats.segments} traced segments "
+      f"exported with {len(starts)} cross-thread flows; escalation "
+      f"produced exactly one bundle ({bundles[0]}) carrying trace "
+      f"{meta['trace_id']}")
+EOF
 
 echo "CI OK"

@@ -367,8 +367,59 @@ class Config:
     # Summarize with `python -m srtb_tpu.tools.telemetry_report`.
     telemetry_journal_path: str = ""
     # size-rotate the journal when the active file would exceed this
-    # (renamed to <path>.1, one previous generation kept)
+    # (one previous generation kept)
     telemetry_journal_max_bytes: int = 64 << 20
+    # gzip the rotated generation (<path>.1.gz instead of <path>.1):
+    # a long soak's journal history stays bounded AND small; the
+    # reader/report handle both transparently.  0 keeps plaintext.
+    telemetry_journal_compress: bool = True
+    # ---- causal tracing + flight recorder (utils/events.py) ----
+    # arm the process-global event hub: every SegmentWork carries a
+    # trace_id and every subsystem that touches it (stage edges,
+    # retries, heal/demote decisions, degrade/admission, watchdog,
+    # supervisor, ring transitions, manifest records) emits typed
+    # monotonic-clocked events onto a bounded per-thread ring — the
+    # always-on flight recorder incident bundles and
+    # tools/trace_export.py read.  0 disarms (the zero-cost-off
+    # None-hook path; PERF.md round 17 A/B).  Process-global, like
+    # the metrics registry.
+    events_enable: bool = True
+    # flight-recorder ring slots PER THREAD (O(ring) memory, no
+    # per-event allocation growth)
+    events_ring_size: int = 4096
+    # write the flight-recorder contents (merged, oldest-first JSONL)
+    # here at Pipeline.close() — the input of
+    # `python -m srtb_tpu.tools.trace_export`; "" disables
+    events_dump_path: str = ""
+    # ---- incident bundles (utils/incidents.py) ----
+    # on any escalation (LadderExhausted, ReinitBudgetExceeded,
+    # WatchdogEscalation, wedged sink, failed fleet lane,
+    # manifest-recovery LOSS) dump a self-contained bundle directory
+    # here: flight-recorder tail, the offending segment's causal
+    # trace, active plan + signature, config + metrics snapshots, last
+    # journal spans.  Atomic (temp+rename), rate-limited and bounded
+    # in count.  "" disables.
+    incident_dir: str = ""
+    incident_max_bundles: int = 8
+    incident_min_interval_s: float = 30.0
+    # ---- SLO burn-rate objectives (utils/slo.py) ----
+    # per-stream error-budget burn evaluation over a fast + slow
+    # window pair; states ok / degraded (violations within budget) /
+    # burning (both windows above slo_burn_threshold) on /healthz and
+    # as slo_burn_rate / slo_state gauges on /metrics.  Each objective
+    # arms independently: latency (per-segment host wall clock >
+    # slo_latency_ms counts against slo_latency_budget), loss
+    # (accounted whole-segment drops against slo_loss_budget),
+    # staleness (gap beyond slo_staleness_s against
+    # slo_staleness_budget as a window fraction).  0 targets = off.
+    slo_latency_ms: float = 0.0
+    slo_latency_budget: float = 0.01
+    slo_loss_budget: float = 0.0
+    slo_staleness_s: float = 0.0
+    slo_staleness_budget: float = 0.05
+    slo_fast_window_s: float = 300.0
+    slo_slow_window_s: float = 3600.0
+    slo_burn_threshold: float = 1.0
     # /healthz flips to 503 when the last processed segment is older
     # than this many seconds (gui/server.py staleness detection)
     health_stale_after_s: float = 30.0
@@ -422,7 +473,8 @@ class Config:
         "device_reinit_max", "stream_priority", "fleet_max_streams",
         "fleet_queue_limit", "periodicity_harmonics",
         "periodicity_candidates", "periodicity_fold_bins",
-        "periodicity_min_bin",
+        "periodicity_min_bin", "events_ring_size",
+        "incident_max_bundles",
     })
     _FLOAT_FIELDS = frozenset({
         "baseband_freq_low", "baseband_bandwidth", "baseband_sample_rate",
@@ -435,12 +487,17 @@ class Config:
         "supervisor_window_s", "degrade_queue_high",
         "degrade_queue_low", "shutdown_join_timeout_s",
         "device_reinit_window_s", "periodicity_snr_threshold",
+        "incident_min_interval_s", "slo_latency_ms",
+        "slo_latency_budget", "slo_loss_budget", "slo_staleness_s",
+        "slo_staleness_budget", "slo_fast_window_s",
+        "slo_slow_window_s", "slo_burn_threshold",
     })
     _BOOL_FIELDS = frozenset({
         "baseband_reserve_sample", "baseband_write_all", "gui_enable",
         "use_emulated_fp64", "use_pallas", "use_pallas_sk", "sanitize",
         "degrade_enable", "chirp_exact", "manifest_fsync",
-        "manifest_hash", "deterministic_timestamps",
+        "manifest_hash", "deterministic_timestamps", "events_enable",
+        "telemetry_journal_compress",
     })
     _LIST_FIELDS = frozenset({
         "udp_receiver_address", "udp_receiver_port",

@@ -185,8 +185,14 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             # (SURVEY.md §5.5): JSON snapshot or Prometheus text
             # exposition (counters/gauges, sliding-window rates, and
             # the per-stage wall-clock histograms)
+            from srtb_tpu.utils import slo
             from srtb_tpu.utils.metrics import metrics
 
+            # refresh the SLO burn-rate gauges right before the
+            # scrape (no-op when no objective is armed), so
+            # slo_burn_rate / slo_state are current however long ago
+            # the last segment (or /healthz hit) was
+            slo.evaluate()
             if self.path == "/metrics.json":
                 data = (json.dumps(metrics.snapshot(), sort_keys=True)
                         + "\n").encode()

@@ -75,6 +75,7 @@ import time
 import zlib
 from dataclasses import dataclass, field
 
+from srtb_tpu.utils import events
 from srtb_tpu.utils.logging import log
 from srtb_tpu.utils.metrics import metrics
 
@@ -451,7 +452,13 @@ def recover(manifest_path: str, apply: bool = True,
             f"[manifest] rolled back {report.rolled_back_intents} "
             f"uncommitted intent(s) from an interrupted run: "
             f"{report.rolled_back}")
+    if report.missing:
+        # fsck-grade LOSS: counted so the caller (Pipeline.__init__)
+        # can bundle the evidence, and each flag lands on the flight
+        # recorder
+        metrics.add("manifest_loss_flags", len(report.missing))
     for msg in report.missing:
+        events.emit("manifest.loss", trace=0, info=msg[:200])
         log.error(f"[manifest] DATA LOSS: {msg}")
     return report
 
@@ -587,6 +594,11 @@ class RunManifest:
         if offset is not None:
             rec["off"] = int(offset)
         self._append(rec)
+        # causal trace: the ambient context (bound by _drain_body on
+        # the sink thread) names the segment whose artifact this is —
+        # the bundle's "manifest disposition" evidence
+        events.emit("manifest.intent", seg=int(key[1]),
+                    info=f"{key[2]}:{os.path.basename(path)}")
 
     def commit(self, key, path: str, length: int,
                crc32: int | None = None,
@@ -598,11 +610,15 @@ class RunManifest:
         if offset is not None:
             rec["off"] = int(offset)
         self._append(rec)
+        events.emit("manifest.commit", seg=int(key[1]),
+                    info=f"{key[2]}:{os.path.basename(path)}")
 
     def sink_done(self, key) -> None:
         self._append({"t": "done", **self._key_fields(key)})
         with self._lock:
             self._done.add(tuple(key))
+        events.emit("manifest.done", seg=int(key[1]),
+                    info=str(key[2]))
 
     def checkpoint(self, segments_done: int,
                    file_offset_bytes: int) -> None:
@@ -610,6 +626,8 @@ class RunManifest:
         # record before it, and the checkpoint file rename follows it
         self._append({"t": "ckpt", "segments_done": int(segments_done),
                       "offset": int(file_offset_bytes)}, durable=True)
+        events.emit("manifest.ckpt", seg=int(segments_done),
+                    info=f"offset={int(file_offset_bytes)}")
 
     # -- replay-skip query -----------------------------------------
 

@@ -76,7 +76,7 @@ from srtb_tpu.resilience.degrade import FleetShedPolicy
 from srtb_tpu.resilience.errors import (DEVICE_HALT, LadderExhausted,
                                         ReinitBudgetExceeded)
 from srtb_tpu.resilience.supervisor import Supervisor
-from srtb_tpu.utils import telemetry
+from srtb_tpu.utils import events, telemetry
 from srtb_tpu.utils.logging import log
 from srtb_tpu.utils.metrics import metrics
 
@@ -458,7 +458,7 @@ class _StreamLane:
         and release its buffers (the solo engine's shed_segment,
         lane-scoped)."""
         pipe = self.pipe
-        pipe._account_dropped()
+        pipe._account_dropped(trace=getattr(item[0], "trace_id", 0))
         pipe._ring_invalidate()
         self._live_add(-1)
         rel = getattr(pipe.processor, "release_staging", None)
@@ -565,7 +565,8 @@ class _StreamLane:
                 self.dispatched += 1
                 log.warning(f"[fleet:{self.name}] force-shed: "
                             "dropping ingested segment (accounted)")
-                self.pipe._account_dropped()
+                self.pipe._account_dropped(
+                    trace=getattr(one[0], "trace_id", 0))
                 self.pipe._ring_invalidate()
                 pool = getattr(self.pipe.source, "pool", None)
                 if pool is not None and self.pipe.cfg.input_file_path:
@@ -627,7 +628,8 @@ class _StreamLane:
                         f"[fleet:{self.name}] sink wedged with a "
                         "full window: shedding ingested segment as "
                         "accounted loss")
-                    self.pipe._account_dropped()
+                    self.pipe._account_dropped(
+                        trace=getattr(one[0], "trace_id", 0))
                     self.pipe._ring_invalidate()
                     pool = getattr(self.pipe.source, "pool", None)
                     if pool is not None \
@@ -696,6 +698,10 @@ class _StreamLane:
         and finish with the pool abandoned (never drained)."""
         from srtb_tpu.utils import termination
         self.pipe._sink_wedged = True
+        self.pipe._incident(
+            "sink_wedge_shutdown",
+            reason=f"fleet lane {self.name}: sink pipe still alive "
+                   f"after the {self.join_s:g}s join budget")
         termination.report_wedged(
             [self._sink_pipe.thread],
             f"fleet lane {self.name} shutdown "
@@ -739,6 +745,10 @@ class _StreamLane:
         are released, neighbors never see the exception."""
         self.error = exc
         self.status = "failed"
+        events.emit("fleet.lane_failed", trace=0, stream=self.name,
+                    info=type(exc).__name__)
+        self.pipe._incident("lane_failed",
+                            reason=f"contained lane failure: {exc!r}")
         log.error(f"[fleet:{self.name}] stream failed (contained): "
                   f"{exc!r}")
         self._stop.request_stop()
@@ -754,7 +764,8 @@ class _StreamLane:
             self._staged_emit = None
         while self.pending:
             item = self.pending.popleft()
-            self.pipe._account_dropped()
+            self.pipe._account_dropped(
+                trace=getattr(item[0], "trace_id", 0))
             self._live_add(-1)
             rel = getattr(self.pipe.processor, "release_staging", None)
             if rel is not None:
@@ -869,6 +880,8 @@ class StreamFleet:
             return False
         metrics.add("device_reinits")
         metrics.add("device_reinits", labels={"stream": faulting})
+        events.emit("fleet.reinit", trace=0, stream=faulting,
+                    info=type(exc).__name__)
         log.warning(f"[fleet] device halt (stream {faulting!r}): "
                     "shared reinit — rebuilding every lane's plan "
                     f"({exc!r})")

@@ -77,7 +77,7 @@ from srtb_tpu.pipeline.work import SegmentResultWork, SegmentWork
 from srtb_tpu.resilience.errors import DEVICE_HALT, WatchdogEscalation
 from srtb_tpu.resilience.faults import FaultInjector
 from srtb_tpu.resilience.retry import RetryPolicy, retry_call
-from srtb_tpu.utils import telemetry
+from srtb_tpu.utils import events, slo, telemetry
 from srtb_tpu.utils.logging import log
 from srtb_tpu.utils.metrics import metrics
 from srtb_tpu.utils.tracing import StageTimer, trace_annotation
@@ -237,6 +237,20 @@ class Pipeline:
                 cfg, donate_input=on_accelerator())
         self.processor = processor
         self._owned_writer_pool = None
+        # causal tracing + flight recorder (utils/events.py): arm the
+        # process-global hub from this config and hold the None-hook
+        # handle — every hot-path emit below is one attribute read +
+        # None check when disabled.  Incident bundles + SLO burn-rate
+        # tracking follow the same zero-cost-off contract.
+        events.configure(
+            enabled=bool(getattr(cfg, "events_enable", True)),
+            ring_size=int(getattr(cfg, "events_ring_size", 0)
+                          or events.DEFAULT_RING_SIZE))
+        self._events_enabled = bool(getattr(cfg, "events_enable",
+                                            True))
+        from srtb_tpu.utils.incidents import IncidentRecorder
+        self.incidents = IncidentRecorder.from_config(cfg)
+        self._slo_armed = slo.configure(cfg) is not None
         # durable exactly-once outputs (io/manifest.py): opening the
         # manifest RUNS RECOVERY — torn WAL tail truncated,
         # uncommitted artifact groups rolled back, the done-set of
@@ -258,11 +272,25 @@ class Pipeline:
                          or StreamCheckpoint._load(
                              cfg.checkpoint_path + ".bak") or {})
                 hint = int(state.get("segments_done", 0))
+            loss0 = metrics.get("manifest_loss_flags")
             self.manifest = RunManifest.open(
                 cfg.run_manifest_path,
                 fsync=bool(getattr(cfg, "manifest_fsync", True)),
                 hash_content=bool(getattr(cfg, "manifest_hash", True)),
                 checkpoint_floor_hint=hint)
+            if self.incidents is not None and \
+                    metrics.get("manifest_loss_flags") > loss0:
+                # fsck-grade LOSS surfaced during startup recovery:
+                # bundle the evidence before the run overwrites the
+                # recent past (the recovery events are on the ring)
+                self.incidents.dump(
+                    "manifest_loss",
+                    reason="manifest recovery flagged unrecoverable "
+                           "data loss (see events.jsonl)",
+                    stream=str(getattr(cfg, "stream_name", "") or ""),
+                    cfg=cfg, processor=self.processor,
+                    journal_path=getattr(cfg, "telemetry_journal_path",
+                                         ""))
         self.checkpoint = None
         if cfg.checkpoint_path:
             from srtb_tpu.pipeline.checkpoint import StreamCheckpoint
@@ -381,7 +409,10 @@ class Pipeline:
             from srtb_tpu.utils.telemetry import SpanJournal
             self.journal = SpanJournal(
                 jpath, max_bytes=getattr(
-                    cfg, "telemetry_journal_max_bytes", 64 << 20))
+                    cfg, "telemetry_journal_max_bytes", 64 << 20),
+                compress=bool(getattr(cfg,
+                                      "telemetry_journal_compress",
+                                      True)))
 
     @contextlib.contextmanager
     def _stage(self, name: str):
@@ -426,7 +457,22 @@ class Pipeline:
         with trace_annotation("srtb:ingest"):
             seg = self._op("ingest", index, lambda: next(it, None))
         if seg is not None:
-            self.stage_timer.record("ingest", time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.stage_timer.record("ingest", dt)
+            if self.events is not None:
+                # stamp the causal trace id at the segment's birth (a
+                # source that pre-stamped its own keeps it) and bind
+                # the ambient context so retry/fault events attribute
+                tid = getattr(seg, "trace_id", 0)
+                if not tid:
+                    tid = events.next_trace_id()
+                    try:
+                        seg.trace_id = tid
+                    except AttributeError:  # read-only stub segments
+                        pass
+                events.set_current(tid, self.stream)
+                self.events.emit("stage.ingest", trace=tid,
+                                 stream=self.stream, seg=index, dur=dt)
         return seg
 
     def _record_segment(self, index: int, seg, det_res, positive: bool,
@@ -449,6 +495,12 @@ class Pipeline:
             metrics.add("samples", n_samples,
                         labels=self._stream_labels)
         telemetry.mark_segment(self.stream or None)
+        if self.slo is not None:
+            # the latency objective scores the segment's HOST wall
+            # clock (the span's summed stages — what the journal's
+            # synthetic 'segment' stage reports); overlap-hidden time
+            # is concurrent and deliberately excluded
+            self.slo.note_segment(self.stream, sum(span.values()))
         det_count = 0
         counts = getattr(det_res, "signal_counts", None)
         if counts is not None:
@@ -467,7 +519,8 @@ class Pipeline:
                 overlap_hidden_s=overlap_hidden_s,
                 inflight_depth=inflight_depth,
                 active_plan=getattr(self.processor, "plan_name", None),
-                stream=self.stream or None))
+                stream=self.stream or None,
+                trace_id=getattr(seg, "trace_id", 0) or None))
 
     # ---------------------------------------------- async segment engine
 
@@ -539,16 +592,58 @@ class Pipeline:
             # tenants still dispatch through it; segment.py guards)
             retire()
 
-    def _account_dropped(self, n: int = 1) -> None:
+    def _account_dropped(self, n: int = 1,
+                         trace: int | None = None) -> None:
         """Account ``n`` whole shed segments: the process-wide counter
         + loss window, plus the per-stream labeled twin when this
         pipeline is a named fleet lane (loss must be attributable to
-        its tenant)."""
+        its tenant).  ``trace`` is the SHED segment's own causal id —
+        callers that hold the work item pass it; the ambient context
+        belongs to the most recently dispatched segment and would
+        blame the wrong one."""
         metrics.add("segments_dropped", n)
         metrics.window("segments_dropped").add(n)
         if self._stream_labels is not None:
             metrics.add("segments_dropped", n,
                         labels=self._stream_labels)
+        if self.slo is not None:
+            self.slo.note_dropped(self.stream, n)
+        ev = self.events
+        if ev is not None:
+            ev.emit("shed.segment",
+                    trace=(trace if trace is not None
+                           else events.current()[0]),
+                    stream=self.stream, info=f"n={n}")
+
+    @property
+    def events(self):
+        """The LIVE process-global hub (or None).  Deliberately not
+        cached at construction: a later pipeline may reconfigure the
+        global hub (different ring size), and a stale handle would
+        silently split one process's causal story across two
+        recorders — half in this pipeline's orphaned hub, half (the
+        module-level emits) in the new one.  The disabled path stays
+        one property call + global read + None check."""
+        return events.hub if self._events_enabled else None
+
+    @property
+    def slo(self):
+        """The LIVE process-global SLO tracker (or None) — same
+        no-stale-handle rule as :attr:`events`: a later pipeline
+        reconfiguring the global tracker must not leave this one
+        feeding an orphan that /healthz and /metrics never read."""
+        return slo.tracker if self._slo_armed else None
+
+    def _incident(self, kind: str, reason: str = "",
+                  trace: int | None = None) -> None:
+        """Dump an incident bundle (None-hook off; best-effort,
+        rate-limited and bounded by the recorder)."""
+        if self.incidents is not None:
+            self.incidents.dump(
+                kind, reason=reason, trace=trace, stream=self.stream,
+                cfg=self.cfg, processor=self.processor,
+                journal_path=getattr(self.cfg,
+                                     "telemetry_journal_path", ""))
 
     # ------------------------------------------------- ingest ring state
 
@@ -566,6 +661,12 @@ class Pipeline:
         continuity breaks — watchdog requeue, shed segment — and at
         run start/end (a checkpoint resume is a fresh run, so resume
         re-dispatch is cold by construction)."""
+        if self._ring_carry is not None and self.events is not None:
+            # a live carry is being dropped: the warm chain breaks
+            # here and the next dispatch pays a full upload
+            self.events.emit("ring.invalidate",
+                             trace=events.current()[0],
+                             stream=self.stream)
         self._ring_carry = None
         self._ring_prev = None
 
@@ -614,6 +715,11 @@ class Pipeline:
 
             out, next_carry = self._op("dispatch", index, run_it)
         else:
+            if self.events is not None:
+                self.events.emit("ring.cold",
+                                 trace=getattr(seg, "trace_id", 0),
+                                 stream=self.stream, seg=index,
+                                 info="requeue" if requeue else "")
             staged = self._op("h2d", index, lambda: stage_in(seg.data))
             first = [True]
 
@@ -647,6 +753,9 @@ class Pipeline:
         Returns the in-flight record (the trailing ``index`` is the
         dispatch-order segment index, which the watchdog uses to bound
         requeues and the fault injector to schedule)."""
+        tid = getattr(seg, "trace_id", 0)
+        if self.events is not None:
+            events.set_current(tid, self.stream)
         with self._stage("dispatch"):
             stage_in = getattr(self.processor, "stage_input", None)
             if self._ring_live:
@@ -674,6 +783,11 @@ class Pipeline:
                     lambda: self.processor.process(seg.data))
         span = {"ingest": ingest_s,
                 "dispatch": self.stage_timer.last["dispatch"]}
+        if self.events is not None:
+            self.events.emit("stage.dispatch", trace=tid,
+                             stream=self.stream, seg=index,
+                             dur=span["dispatch"],
+                             info="requeue" if requeue else "")
         return (seg, wf, det_res, offset_after, span,
                 time.perf_counter(), index)
 
@@ -710,6 +824,12 @@ class Pipeline:
             det_i = jax.tree_util.tree_map(
                 lambda x, j=i: x[j], det_b)
             span = {"ingest": ingests[i], "dispatch": per_seg}
+            if self.events is not None:
+                self.events.emit("stage.dispatch",
+                                 trace=getattr(seg, "trace_id", 0),
+                                 stream=self.stream,
+                                 seg=first_index + i, dur=per_seg,
+                                 info=f"batch={len(segs)}")
             items.append((seg, wf_b[i], det_i, offsets[i], span,
                           time.perf_counter(), first_index + i))
         return items
@@ -781,6 +901,12 @@ class Pipeline:
         cfg = self.cfg
         (seg, wf, det_res, offset_after, span, hidden, depth, live,
          index, degrade_level, sinks_done) = item
+        if self.events is not None:
+            # bind the causal context on the SINK thread: manifest
+            # intent/commit/done records and sink-side retries emitted
+            # below attribute to this segment's trace
+            events.set_current(getattr(seg, "trace_id", 0),
+                               self.stream)
         san = self.sanitizer
         if san is not None:
             # the sink side is single-owner too: either the sink pipe
@@ -826,6 +952,12 @@ class Pipeline:
                                               done=sinks_done,
                                               seg_key=mkey))
         span["sink"] = self.stage_timer.last["sink"]
+        if self.events is not None:
+            self.events.emit("stage.sink",
+                             trace=getattr(seg, "trace_id", 0),
+                             stream=self.stream, seg=index,
+                             dur=span["sink"],
+                             info="dump" if positive else "")
         # host staging-buffer pool: copies staged for this segment
         # (micro-batch stacks, non-contiguous inputs) are reusable once
         # the segment drained — the device program that consumed the
@@ -1025,7 +1157,7 @@ class Pipeline:
         # shutdown_join_timeout_s / the fetch deadline), never sheds.
         real_time = not cfg.input_file_path
 
-        def shed_segment(seg_data, in_flight: bool) -> None:
+        def shed_segment(seg, in_flight: bool) -> None:
             """Account one shed segment as explicit loss (counter +
             loss window) and return its host buffer to the reader pool
             (file mode — sinks never retained it); ``in_flight`` frees
@@ -1036,7 +1168,7 @@ class Pipeline:
             next dispatch re-arms cold (an undispatched shed breaks
             the source-adjacency chain; an in-flight shed is just
             conservative hygiene, at one full upload's cost)."""
-            self._account_dropped()
+            self._account_dropped(trace=getattr(seg, "trace_id", 0))
             self._ring_invalidate()
             if in_flight:
                 live_add(-1)
@@ -1048,10 +1180,10 @@ class Pipeline:
             # staged transfer has provably completed.
             rel = getattr(self.processor, "release_staging", None)
             if rel is not None:
-                rel(seg_data)
+                rel(seg.data)
             pool = getattr(self.source, "pool", None)
             if pool is not None and cfg.input_file_path:
-                pool.release(seg_data)
+                pool.release(seg.data)
 
         def push_sink(item) -> bool:
             """Bounded push to the sink pipe: blocks while the queue is
@@ -1085,8 +1217,13 @@ class Pipeline:
                             "[watchdog] sink pipe wedged past "
                             f"{deadline_s:g}s with no drain progress: "
                             "shedding segment as accounted loss")
+                        self._incident(
+                            "sink_wedge",
+                            trace=getattr(item[0], "trace_id", 0),
+                            reason=f"sink pipe wedged > {deadline_s:g}s"
+                                   " with no drain progress")
                         # sink_f will never see this item
-                        shed_segment(item[0].data, in_flight=True)
+                        shed_segment(item[0], in_flight=True)
                         return True
                 time.sleep(0.002)
             return True
@@ -1210,15 +1347,23 @@ class Pipeline:
             kind = h.classify(exc)
             if kind is None:
                 return False
+            events.emit("fault.device",
+                        info=f"{kind}:{type(exc).__name__}")
             if kind == DEVICE_HALT:
                 if reinit_and_redispatch(exc):
                     return True
+                self._incident(
+                    "reinit_budget_exceeded",
+                    reason=f"device halt beyond reinit budget: {exc}")
                 raise ReinitBudgetExceeded(
                     "device halt beyond reinit recovery "
                     "(device_reinit_max budget spent or disabled): "
                     f"{exc}") from exc
             newp = h.demote(exc, kind)
             if newp is None:
+                self._incident(
+                    "ladder_exhausted",
+                    reason=f"device fault survived every rung: {exc}")
                 raise LadderExhausted(
                     f"device fault survived every demotion rung: "
                     f"{exc}") from exc
@@ -1344,13 +1489,24 @@ class Pipeline:
                 if time.perf_counter() - waited_since >= deadline_s:
                     index = item[6]
                     used = requeue_counts.get(index, 0)
+                    tid = getattr(item[0], "trace_id", 0)
                     if used >= watchdog_max:
+                        events.emit("watchdog.escalate", trace=tid,
+                                    stream=self.stream, seg=index,
+                                    info=f"requeues={used}")
+                        self._incident(
+                            "watchdog_escalation", trace=tid,
+                            reason=f"segment {index} wedged through "
+                                   f"{used} requeue(s)")
                         raise WatchdogEscalation(
                             f"segment {index} fetch still not ready "
                             f"after {deadline_s:g}s at the drain head "
                             f"and {used} requeue(s): device wedged")
                     requeue_counts[index] = used + 1
                     metrics.add("watchdog_requeues")
+                    events.emit("watchdog.requeue", trace=tid,
+                                stream=self.stream, seg=index,
+                                info=f"attempt={used + 1}")
                     log.warning(
                         f"[watchdog] segment {index} in-flight past "
                         f"{deadline_s:g}s (fetch never ready): "
@@ -1433,8 +1589,16 @@ class Pipeline:
             log.error("[watchdog] sink wedged with a full in-flight "
                       "window: shedding ingested segment as accounted "
                       "loss")
+            self._incident(
+                "sink_wedge",
+                trace=getattr(one[0], "trace_id", 0),
+                reason="whole window parked behind a wedged sink; "
+                       "shedding ingest as accounted loss")
+            events.emit("shed.ingest",
+                        trace=getattr(one[0], "trace_id", 0),
+                        stream=self.stream, seg=dispatched[0] - 1)
             # never dispatched, so it holds no window slot
-            shed_segment(one[0].data, in_flight=False)
+            shed_segment(one[0], in_flight=False)
             return True
 
         sink_wedged = False
@@ -1507,6 +1671,10 @@ class Pipeline:
                 sink_pipe.join(join_s if join_s > 0 else None)
                 if sink_pipe.thread.is_alive():
                     sink_wedged = True
+                    self._incident(
+                        "sink_wedge_shutdown",
+                        reason=f"sink pipe still alive after the "
+                               f"{join_s:g}s shutdown join budget")
                     # flagged HERE, inside the finally: an exception
                     # escaping run() (fatal fault, watchdog
                     # escalation) still reaches close(), which must
@@ -1526,7 +1694,7 @@ class Pipeline:
                             break
                         if leftover is fw.SENTINEL:
                             continue
-                        shed_segment(leftover[0].data, in_flight=True)
+                        shed_segment(leftover[0], in_flight=True)
                     # the item the wedged worker holds mid-drain is
                     # loss too if it never reached accounting
                     # (sink_f's finally never runs): count it, or it
@@ -1551,7 +1719,9 @@ class Pipeline:
                         with self._handoff_lock:
                             if drained[0] == progress[0]:
                                 held[-1].add("abandoned")
-                                self._account_dropped()
+                                self._account_dropped(
+                                    trace=getattr(held[0], "trace_id",
+                                                  0))
                                 live_add(-1)
                     log.error("[pipeline] wedged sink: still-queued "
                               "segments accounted as segments_dropped")
@@ -1708,6 +1878,9 @@ class Pipeline:
         fetch (= device completion of the whole segment program); a lazy
         waterfall transfer lands in the consuming sink's time."""
         seg, wf, det_res, offset_after, span = item
+        if self.events is not None:
+            events.set_current(getattr(seg, "trace_id", 0),
+                               self.stream)
         with self._stage("fetch"):
             # explicit D2H (device_get) — this is the engine's one
             # sanctioned blocking fetch; implicit np.asarray here
@@ -1719,6 +1892,11 @@ class Pipeline:
                 lambda: self._sync_with_deadline(
                     lambda: jax.device_get(det_res)))
         span["fetch"] = self.stage_timer.last["fetch"]
+        if self.events is not None:
+            self.events.emit("stage.fetch",
+                             trace=getattr(seg, "trace_id", 0),
+                             stream=self.stream, seg=index,
+                             dur=span["fetch"])
         if wf is not None and self.cfg.segment_deadline_s > 0:
             wf = _DeadlineArray(wf, self._sync_with_deadline)
         return seg, wf, det_res, offset_after, span
@@ -1743,6 +1921,18 @@ class Pipeline:
         if self.journal is not None:
             self.journal.close()
             self.journal = None
+        dump_path = getattr(self.cfg, "events_dump_path", "")
+        if dump_path and self.events is not None:
+            # persist the flight recorder's view of this run (ring-
+            # bounded: the LAST events_ring_size events per thread) —
+            # the input of `python -m srtb_tpu.tools.trace_export`
+            try:
+                n = self.events.dump_jsonl(dump_path)
+                log.info(f"[events] {n} flight-recorder events -> "
+                         f"{dump_path}")
+            except OSError as e:
+                log.warning(f"[events] dump to {dump_path} failed: "
+                            f"{e}")
 
     def __enter__(self):
         return self
@@ -1899,6 +2089,9 @@ class ThreadedPipeline(Pipeline):
                 newp = h.promote()
                 if newp is not None:
                     self._swap_processor(newp)
+            if self.events is not None:
+                events.set_current(getattr(seg, "trace_id", 0),
+                                   self.stream)
             with self._stage("dispatch"):
                 while True:
                     try:
@@ -1926,6 +2119,11 @@ class ThreadedPipeline(Pipeline):
                         self._swap_processor(newp)
             span = {"ingest": ingest_dt,
                     "dispatch": self.stage_timer.last["dispatch"]}
+            if self.events is not None:
+                self.events.emit("stage.dispatch",
+                                 trace=getattr(seg, "trace_id", 0),
+                                 stream=self.stream, seg=index,
+                                 dur=span["dispatch"])
             self.stats.segments += 1
             self.stats.samples += cfg.baseband_input_count
             return (seg, wf, det_res,
@@ -1949,6 +2147,9 @@ class ThreadedPipeline(Pipeline):
 
         def _drain_body(stop_token, item, index):
             seg, wf, det_res, offset_after, span = item
+            if self.events is not None:
+                events.set_current(getattr(seg, "trace_id", 0),
+                                   self.stream)
             if self.sanitizer is not None:
                 self._sanitize_check(wf, det_res)
             positive = has_signal(
@@ -1971,6 +2172,12 @@ class ThreadedPipeline(Pipeline):
                                                   positive, done=done,
                                                   seg_key=mkey))
             span["sink"] = self.stage_timer.last["sink"]
+            if self.events is not None:
+                self.events.emit("stage.sink",
+                                 trace=getattr(seg, "trace_id", 0),
+                                 stream=self.stream, seg=index,
+                                 dur=span["sink"],
+                                 info="dump" if positive else "")
             pool = getattr(self.source, "pool", None)
             if pool is not None and cfg.input_file_path:
                 pool.release(seg.data)

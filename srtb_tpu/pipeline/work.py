@@ -33,6 +33,12 @@ class SegmentWork:
     # (DropOldestSegmentBuffer) or an interleaved multi-receiver stream
     # must never be warm-assembled against a foreign carry.
     seq: int = -1
+    # causal trace id (utils/events.py): stamped at ingest by the
+    # pipeline (0 = unstamped); every subsystem that touches this
+    # segment — stage edges, retries, heal decisions, manifest
+    # records — emits flight-recorder events carrying it, so one
+    # segment's whole journey is reconstructable across threads.
+    trace_id: int = 0
 
 
 @dataclass

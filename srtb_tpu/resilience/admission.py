@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import itertools
 
+from srtb_tpu.utils import events
 from srtb_tpu.utils.logging import log
 from srtb_tpu.utils.metrics import metrics
 
@@ -69,6 +70,7 @@ class AdmissionController:
         metrics.add(counter, labels={"stream": name})
         metrics.set("fleet_running", len(self.running))
         metrics.set("fleet_queued_depth", len(self._queue))
+        events.emit("admission", trace=0, stream=name, info=decision)
 
     def request(self, name: str, priority: int = 0) -> str:
         """One stream asking to run; returns ADMIT / QUEUE / REJECT.

@@ -48,6 +48,7 @@ its own level (``degrade_level`` vs ``plan_ladder_level``).
 
 from __future__ import annotations
 
+from srtb_tpu.utils import events
 from srtb_tpu.utils.logging import log
 from srtb_tpu.utils.metrics import metrics
 
@@ -107,6 +108,10 @@ class DegradationLadder:
             self.level += 1
             self._above = 0
             metrics.add("degrade_steps")
+            events.emit("degrade",
+                        stream=(self._labels or {}).get("stream"),
+                        info=f"{LEVELS[self.level - 1]}->"
+                             f"{LEVELS[self.level]}")
             log.warning(
                 f"[degrade] sustained pressure (occupancy "
                 f"{occupancy:.2f}, loss={loss_active}): stepping up to "
@@ -115,6 +120,10 @@ class DegradationLadder:
             self.level -= 1
             self._below = 0
             metrics.add("degrade_recoveries")
+            events.emit("degrade",
+                        stream=(self._labels or {}).get("stream"),
+                        info=f"{LEVELS[self.level + 1]}->"
+                             f"{LEVELS[self.level]}")
             log.info(f"[degrade] pressure cleared: recovering to level "
                      f"{self.level} ({LEVELS[self.level]})")
         self._set_gauge(self.level)
@@ -187,6 +196,8 @@ class FleetShedPolicy:
             self._above = 0
             metrics.add("fleet_sheds")
             metrics.add("fleet_sheds", labels={"stream": name})
+            events.emit("fleet.force_shed", trace=0, stream=name,
+                        info=f"priority={prio}")
             log.warning(
                 f"[fleet] sustained fleet pressure {pressure:.2f} "
                 f"(loss={loss_active}): shedding lowest-priority "
@@ -197,6 +208,8 @@ class FleetShedPolicy:
             self._below = 0
             metrics.add("fleet_restores")
             metrics.add("fleet_restores", labels={"stream": name})
+            events.emit("fleet.restore", trace=0, stream=name,
+                        info=f"priority={prio}")
             log.info(f"[fleet] pressure cleared: restoring stream "
                      f"{name!r} (priority {prio})")
         metrics.set("fleet_shed_streams", len(self.shed))

@@ -72,6 +72,7 @@ from dataclasses import dataclass
 from srtb_tpu.pipeline import registry
 from srtb_tpu.resilience.errors import classify_device
 from srtb_tpu.resilience.supervisor import Supervisor
+from srtb_tpu.utils import events
 from srtb_tpu.utils.logging import log
 from srtb_tpu.utils.metrics import metrics
 
@@ -279,6 +280,9 @@ class ComputeHealer:
         self._healthy = 0
         rung = self._rungs[self._level - 1]
         self._mark("plan_demotions")
+        events.emit("heal.demote",
+                    stream=(self._labels or {}).get("stream"),
+                    info=f"{rung.step}@{self._level} ({kind})")
         log.warning(
             f"[selfheal] device fault ({kind}) — demoting to ladder "
             f"rung {self._level}/{len(self._rungs)} ({rung.step}): "
@@ -297,6 +301,9 @@ class ComputeHealer:
         metrics.add("device_reinits")
         if self._labels is not None:
             metrics.add("device_reinits", labels=self._labels)
+        events.emit("heal.reinit",
+                    stream=(self._labels or {}).get("stream"),
+                    info=f"{self.active_step}@{self._level}")
         log.warning(
             f"[selfheal] device halt — reinitializing backend at "
             f"ladder rung {self._level} ({self.active_step}): {exc!r}")
@@ -331,6 +338,9 @@ class ComputeHealer:
         self._level -= 1
         self._healthy = 0
         self._mark("plan_promotions")
+        events.emit("heal.promote",
+                    stream=(self._labels or {}).get("stream"),
+                    info=f"{self.active_step}@{self._level}")
         log.info(
             f"[selfheal] {self.promote_after} healthy segments — "
             f"promotion probe back to rung {self._level} "

@@ -54,6 +54,7 @@ from dataclasses import dataclass, field
 
 from srtb_tpu.resilience.errors import (DataLossError, FatalError,
                                         TransientError)
+from srtb_tpu.utils import events
 from srtb_tpu.utils.logging import log
 from srtb_tpu.utils.metrics import metrics
 
@@ -210,6 +211,7 @@ class FaultInjector:
             return
         spec.fired = True
         metrics.add("faults_injected")
+        events.emit("fault.injected", seg=index, info=str(spec))
         log.warning(f"[faults] firing {spec}")
         if spec.action == "stall":
             time.sleep(spec.arg)

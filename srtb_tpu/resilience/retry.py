@@ -24,6 +24,7 @@ import zlib
 from dataclasses import dataclass
 
 from srtb_tpu.resilience.errors import DATA_LOSS, TRANSIENT, classify
+from srtb_tpu.utils import events
 from srtb_tpu.utils.logging import log
 from srtb_tpu.utils.metrics import metrics
 
@@ -102,6 +103,9 @@ def retry_call(fn, policy: RetryPolicy, site: str, sleep=time.sleep):
             raise exc
         metrics.add("retries_total")
         metrics.add(f"retries_{site}")
+        # flight-recorder: the ambient context (set by the engine at
+        # each guarded site) attributes the retry to its segment
+        events.emit("retry", info=f"{site}:{cat}:{attempt}")
         log.warning(
             f"[resilience] {site}: {cat} {exc!r}; retrying "
             f"({attempt}/{policy.max_attempts - 1}) in "

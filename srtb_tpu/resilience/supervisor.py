@@ -24,6 +24,7 @@ import collections
 import time
 
 from srtb_tpu.resilience.errors import FATAL, classify
+from srtb_tpu.utils import events
 from srtb_tpu.utils.logging import log
 from srtb_tpu.utils.metrics import metrics
 
@@ -82,6 +83,8 @@ class Supervisor:
         if self.counter:
             metrics.add(self.counter)
             metrics.add(f"{self.counter}_{self.name}")
+        events.emit("supervisor.restart",
+                    info=f"{self.name}:{len(self._restarts)}")
         log.warning(
             f"[supervisor] {self.name}: crashed with {exc!r}; "
             f"restarting ({len(self._restarts)}/{self.max_restarts} "

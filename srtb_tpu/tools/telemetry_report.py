@@ -51,17 +51,46 @@ import os
 import sys
 
 
+def _open_journal(path: str):
+    """Plaintext or gzip (rotation compresses generations to
+    ``.jsonl.gz``) — readers must not care which."""
+    if path.endswith(".gz"):
+        import gzip
+        return gzip.open(path, "rt")
+    return open(path)
+
+
 def load(path: str, include_rotated: bool = True) -> list[dict]:
     """Parse span records, oldest first, tolerating partial lines (a
-    journal being written concurrently ends mid-record)."""
+    journal being written concurrently ends mid-record).  The rotated
+    generation (``<path>.1.gz``, or legacy plaintext ``<path>.1``) is
+    read first when present; a torn gzip tail (crash mid-rotation)
+    yields its readable prefix."""
     records = []
     paths = []
-    if include_rotated and os.path.exists(path + ".1"):
-        paths.append(path + ".1")
+    if include_rotated:
+        cands = [p for p in (path + ".1.gz", path + ".1")
+                 if os.path.exists(p)]
+        if len(cands) == 2:
+            # both exist only after a failed compress left the newer
+            # plaintext next to an older .gz — single-generation
+            # semantics: the newer one IS the previous generation.
+            # The mtime read races with a live journal's rotation
+            # (compress unlinks the .1 it just gzipped): a vanished
+            # candidate sorts oldest and drops out.
+            def _mtime(p: str) -> float:
+                try:
+                    return os.path.getmtime(p)
+                except OSError:
+                    return -1.0
+            cands.sort(key=_mtime)
+            cands = cands[-1:]
+        paths.extend(cands)
     paths.append(path)
+    import zlib
     for p in paths:
         try:
-            with open(p) as f:
+            with _open_journal(p) as f:
                 for line in f:
                     line = line.strip()
                     if not line.startswith("{"):
@@ -72,7 +101,12 @@ def load(path: str, include_rotated: bool = True) -> list[dict]:
                         continue
                     if rec.get("type") == "segment_span":
                         records.append(rec)
-        except OSError:
+        except (OSError, EOFError, zlib.error):
+            # includes BadGzipFile, a truncated compressed tail AND a
+            # corrupt deflate stream (zlib.error — e.g. zero-filled
+            # blocks after power loss): keep whatever already parsed —
+            # the report must not crash on the journal it was asked
+            # to diagnose
             continue
     return records
 

@@ -1,0 +1,262 @@
+"""Incident bundles: self-contained evidence dumps on escalation.
+
+When a run escalates — :class:`LadderExhausted`,
+:class:`ReinitBudgetExceeded`, :class:`WatchdogEscalation`, a wedged
+sink, a failed fleet lane, manifest-recovery LOSS — the counters say
+*that* it happened; the bundle says *what* happened: the flight
+recorder's recent past, the offending segment's full causal trace, the
+active plan identity, the config, a metrics snapshot and the last
+journal spans, all in one directory an operator (or a bug report) can
+carry away whole.
+
+Layout (one directory per incident)::
+
+    <incident_dir>/incident_NNN_<kind>/
+        incident.json     kind, reason, wall time, trace_id, stream
+        events.jsonl      flight-recorder tail (EventHub.dump format)
+        trace.jsonl       events filtered to the offending trace_id
+        plan.json         plan_name, plan_signature, ladder level
+        config.json       full Config snapshot
+        metrics.json      metrics registry snapshot
+        spans_tail.jsonl  last spans of the telemetry journal
+
+Bundles are published ATOMICALLY with the repo's temp+rename
+convention (the whole directory is assembled under ``.srtb_tmp`` and
+renamed into place — a crash mid-dump leaves a temp dir the next
+recorder construction sweeps, never a half-bundle that looks whole),
+**rate-limited** (``incident_min_interval_s`` between bundles — an
+escalation storm must not turn the incident dir into its own outage)
+and **bounded in count** (``incident_max_bundles`` directories kept;
+beyond that new incidents are counted as ``incidents_suppressed``
+and only logged — the FIRST escalations of an outage carry the causal
+story, and an unbounded dump directory on a wedged disk is exactly
+the failure mode the pipeline is trying to survive).
+
+Dumping is best-effort by contract: a failure to write a bundle logs
+and counts (``incident_dump_failures``) but never masks or replaces
+the escalation it was documenting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import threading
+import time
+
+from srtb_tpu.utils import events
+from srtb_tpu.utils.logging import log
+from srtb_tpu.utils.metrics import metrics
+
+# matches io/writers.TMP_SUFFIX (not imported: the recorder must stay
+# importable without the sink stack)
+TMP_SUFFIX = ".srtb_tmp"
+
+BUNDLE_SCHEMA_VERSION = 1
+
+# tail size of the journal snapshot: enough spans to cover the flight
+# recorder's horizon without re-shipping a 64 MB journal per incident
+SPANS_TAIL_LINES = 200
+
+
+def _json_default(o):
+    try:
+        return list(o)
+    except TypeError:
+        return repr(o)
+
+
+class IncidentRecorder:
+    """Per-pipeline handle on the (filesystem-global) incident
+    directory.  ``None`` when ``Config.incident_dir`` is empty — the
+    zero-cost-off None-hook pattern shared with the sanitizer and
+    fault injector."""
+
+    # rate-limit state is keyed on the DIRECTORY, not the recorder
+    # instance: N fleet lanes each own a recorder pointing at the same
+    # incident_dir, and a fleet-wide outage (shared device halt) fails
+    # them near-simultaneously — per-instance clocks would let N
+    # duplicate bundles burn the whole bounded budget in one second,
+    # exactly the storm the limiter exists to prevent
+    _last_dump_by_dir: dict = {}
+    _rate_lock = threading.Lock()
+
+    def __init__(self, directory: str, max_bundles: int = 8,
+                 min_interval_s: float = 30.0):
+        self.directory = os.path.abspath(directory)
+        self.max_bundles = max(1, int(max_bundles))
+        self.min_interval_s = float(min_interval_s)
+        os.makedirs(directory, exist_ok=True)
+        # sweep half-assembled bundles from a previous life (the
+        # atomic-rename contract: anything still under .srtb_tmp never
+        # became a bundle)
+        for name in os.listdir(directory):
+            if name.endswith(TMP_SUFFIX):
+                shutil.rmtree(os.path.join(directory, name),
+                              ignore_errors=True)
+
+    @classmethod
+    def from_config(cls, cfg) -> "IncidentRecorder | None":
+        d = str(getattr(cfg, "incident_dir", "") or "")
+        if not d:
+            return None
+        return cls(
+            d,
+            max_bundles=int(getattr(cfg, "incident_max_bundles", 8)
+                            or 8),
+            min_interval_s=float(getattr(cfg, "incident_min_interval_s",
+                                         30.0)))
+
+    # ------------------------------------------------------- dumping
+
+    def _existing(self) -> list[str]:
+        try:
+            return sorted(n for n in os.listdir(self.directory)
+                          if n.startswith("incident_")
+                          and not n.endswith(TMP_SUFFIX))
+        except OSError:
+            return []
+
+    def dump(self, kind: str, reason: str = "",
+             trace: int | None = None, stream: str = "",
+             cfg=None, processor=None,
+             journal_path: str = "") -> str | None:
+        """Write one bundle; returns its directory, or None when
+        rate-limited / bounded / failed.  Never raises."""
+        try:
+            return self._dump(kind, reason, trace, stream, cfg,
+                              processor, journal_path)
+        except Exception as e:  # noqa: BLE001 - best-effort contract
+            metrics.add("incident_dump_failures")
+            log.error(f"[incident] bundle dump failed ({kind}): {e!r}")
+            return None
+
+    def _dump(self, kind, reason, trace, stream, cfg, processor,
+              journal_path) -> str | None:
+        now = time.monotonic()
+        with self._rate_lock:
+            last = self._last_dump_by_dir.get(self.directory, 0.0)
+            if last and now - last < self.min_interval_s:
+                rate_limited = True
+            else:
+                # claim the slot atomically: two lanes failing in the
+                # same instant must not both pass the check
+                self._last_dump_by_dir[self.directory] = now
+                rate_limited = False
+        if rate_limited:
+            metrics.add("incidents_suppressed")
+            log.warning(f"[incident] {kind}: rate-limited "
+                        f"(< {self.min_interval_s:g}s since the last "
+                        "bundle)")
+            return None
+        existing = self._existing()
+        if len(existing) >= self.max_bundles:
+            # give the claimed rate slot back: a count-suppressed
+            # attempt must not also rate-limit a later incident into
+            # a dir the operator has since cleared
+            with self._rate_lock:
+                if self._last_dump_by_dir.get(self.directory) == now:
+                    self._last_dump_by_dir[self.directory] = last
+            metrics.add("incidents_suppressed")
+            log.warning(
+                f"[incident] {kind}: {len(existing)} bundle(s) already "
+                f"kept (incident_max_bundles={self.max_bundles}); "
+                "suppressing — the earliest escalations hold the story")
+            return None
+        if trace is None:
+            trace = events.current()[0]
+        seq = 0
+        for name in existing:
+            try:
+                seq = max(seq, int(name.split("_")[1]) + 1)
+            except (IndexError, ValueError):
+                continue
+        safe_kind = "".join(c if c.isalnum() or c in "-_" else "_"
+                            for c in str(kind)) or "incident"
+        final = os.path.join(self.directory,
+                             f"incident_{seq:03d}_{safe_kind}")
+        tmp = final + TMP_SUFFIX
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+
+        def put(name: str, obj) -> None:
+            with open(os.path.join(tmp, name), "w") as f:
+                json.dump(obj, f, sort_keys=True, indent=1,
+                          default=_json_default)
+                f.write("\n")
+
+        put("incident.json", {
+            "schema": BUNDLE_SCHEMA_VERSION,
+            "kind": str(kind),
+            "reason": str(reason),
+            "ts": time.time(),
+            "trace_id": int(trace or 0),
+            "stream": str(stream or ""),
+            "pid": os.getpid(),
+        })
+        hub = events.hub
+        n_ev = n_tr = 0
+        if hub is not None:
+            n_ev = hub.dump_jsonl(os.path.join(tmp, "events.jsonl"))
+            if trace:
+                n_tr = hub.dump_jsonl(os.path.join(tmp, "trace.jsonl"),
+                                      trace=int(trace))
+        if processor is not None:
+            plan = {"plan_name": getattr(processor, "plan_name", None)}
+            sig = getattr(processor, "plan_signature", None)
+            if callable(sig):
+                try:
+                    plan["plan_signature"] = sig()
+                except Exception as e:  # noqa: BLE001 - a retired
+                    # processor raises loudly by design; the bundle
+                    # still names the plan
+                    plan["plan_signature"] = f"<unavailable: {e!r}>"
+            # a fleet lane's bundle must report ITS OWN ladder level:
+            # the flat gauge is last-writer-wins across lanes, so a
+            # named stream reads its labeled twin
+            plan["plan_ladder_level"] = int(metrics.get(
+                "plan_ladder_level",
+                labels={"stream": stream} if stream else None))
+            put("plan.json", plan)
+        if cfg is not None:
+            try:
+                snap = dataclasses.asdict(cfg)
+            except TypeError:
+                snap = {k: v for k, v in vars(cfg).items()
+                        if not k.startswith("_")}
+            put("config.json", snap)
+        put("metrics.json", metrics.snapshot())
+        jp = journal_path or (getattr(cfg, "telemetry_journal_path", "")
+                              if cfg is not None else "")
+        if jp and os.path.exists(jp):
+            try:
+                # bounded tail read: the active journal can be tens
+                # of MB, and the escalation path must not materialize
+                # it whole — seek to a byte budget generous enough
+                # for SPANS_TAIL_LINES spans and split there
+                with open(jp, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    size = f.tell()
+                    budget = SPANS_TAIL_LINES * 4096
+                    f.seek(max(0, size - budget))
+                    chunk = f.read()
+                lines = chunk.splitlines(keepends=True)
+                if size > budget and lines:
+                    lines = lines[1:]  # drop the torn first line
+                with open(os.path.join(tmp, "spans_tail.jsonl"),
+                          "wb") as f:
+                    f.writelines(lines[-SPANS_TAIL_LINES:])
+            except OSError as e:
+                log.warning(f"[incident] journal tail unavailable: {e}")
+        os.replace(tmp, final)
+        metrics.add("incident_bundles")
+        if stream:
+            metrics.add("incident_bundles", labels={"stream": stream})
+        events.emit("incident", trace=int(trace or 0),
+                    stream=str(stream or ""),
+                    info=os.path.basename(final))
+        log.error(f"[incident] {kind}: bundle written to {final} "
+                  f"({n_ev} events, {n_tr} on the offending trace)")
+        return final

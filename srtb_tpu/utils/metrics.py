@@ -295,6 +295,84 @@ class Metrics:
 
     # ---- Prometheus text exposition (format version 0.0.4) ----
 
+    # HELP text per family (exposition-format conformance: every
+    # family gets a # HELP + # TYPE pair; unknown names fall back to
+    # a generic line so third-party counters are still conformant).
+    # Newlines/backslashes would need escaping per the format — keep
+    # these single-line.
+    _HELP = {
+        "segments": "Segments drained end-to-end (lifetime)",
+        "samples": "Baseband samples processed (lifetime)",
+        "signals": "Segments whose detection gate fired",
+        "segments_dropped": "Whole segments shed as accounted loss",
+        "packets_total": "UDP packets expected (counter-derived)",
+        "packets_lost": "UDP packets lost (counter gaps)",
+        "packet_loss_rate": "Lifetime packet loss fraction",
+        "packet_loss_rate_window": "Windowed packet loss fraction",
+        "msamples_per_sec": "Lifetime megasamples per second",
+        "elapsed_s": "Seconds since registry start/reset",
+        "inflight_depth": "Dispatched-through-sink segments in flight",
+        "degrade_level": "Sink-side degradation ladder level",
+        "plan_ladder_level": "Compute demotion ladder level",
+        "plan_demotions": "Self-healing plan demotions",
+        "plan_promotions": "Self-healing promotion probes taken",
+        "device_reinits": "Backend reinitializations after halts",
+        "retries_total": "Guarded-operation retries (all sites)",
+        "watchdog_requeues": "In-flight segments cancelled+requeued",
+        "worker_restarts": "Supervised worker restarts",
+        "shed_waterfalls": "Waterfall dumps withheld by degradation",
+        "shed_baseband": "Sheddable sink pushes skipped",
+        "data_loss_total": "Data-loss-classified faults (retried)",
+        "faults_injected": "Deterministic fault-plan firings",
+        "h2d_bytes": "Host-to-device bytes staged",
+        "ring_cold_dispatches": "Ingest-ring cold (full-upload) "
+                                "dispatches",
+        "recovered_segments": "Segments rescued by manifest recovery",
+        "replayed_skips": "Sink pushes skipped as already committed",
+        "rolled_back_intents": "Uncommitted artifacts rolled back",
+        "manifest_loss_flags": "Unrecoverable-loss flags from "
+                               "manifest recovery",
+        "incident_bundles": "Incident bundles written",
+        "incidents_suppressed": "Incident dumps suppressed "
+                                "(rate/count bound)",
+        "incident_dump_failures": "Incident bundle writes that failed",
+        "slo_burn_rate": "SLO error-budget burn rate (1.0 = spending "
+                         "exactly the budget)",
+        "slo_state": "SLO objective state (0 ok / 1 degraded / "
+                     "2 burning)",
+        "fleet_plan_compiles": "Shared plan-cache processor builds",
+        "fleet_plan_cache_hits": "Shared plan-cache hits",
+        "fleet_admitted": "Streams admitted by the fleet gate",
+        "fleet_queued": "Streams queued behind fleet capacity",
+        "fleet_rejected": "Streams rejected by admission",
+        "fleet_running": "Streams currently running in the fleet",
+        "fleet_queued_depth": "Streams waiting in the admission queue",
+        "fleet_sheds": "Fleet fairness force-shed transitions",
+        "fleet_restores": "Fleet fairness restore transitions",
+        "fleet_shed_streams": "Streams currently force-shed",
+        "fleet_streams_total": "Streams submitted to the fleet",
+        "stage_seconds": "Per-stage host wall clock (seconds)",
+        "last_segment_monotonic": "Monotonic stamp of the last "
+                                  "drained segment",
+        "last_segment_unix": "Wall-clock stamp of the last drained "
+                             "segment",
+        "segment_pool_in_use": "Reader buffer-pool buffers in use",
+        "file_bytes_read": "Bytes read from baseband input files",
+    }
+
+    @classmethod
+    def _help_line(cls, prom_name: str, bare: str) -> str:
+        text = cls._HELP.get(bare)
+        if text is None and bare.startswith("retries_"):
+            text = f"Guarded-operation retries at site {bare[8:]}"
+        elif text is None and bare.startswith("worker_restarts_"):
+            text = f"Supervised restarts of component {bare[16:]}"
+        elif text is None and bare.endswith("_per_sec"):
+            text = f"Windowed rate of {bare[:-8]} per second"
+        if text is None:
+            text = "srtb_tpu runtime metric"
+        return f"# HELP {prom_name} {text}"
+
     @staticmethod
     def _prom_name(name: str) -> str:
         return "srtb_" + re.sub(r"[^a-zA-Z0-9_]", "_", name)
@@ -329,6 +407,7 @@ class Metrics:
             labeled_by_name.setdefault(n, []).append((lk, v))
         for k in sorted(scalars):
             name = self._prom_name(k)
+            lines.append(self._help_line(name, k))
             lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {val(scalars[k])}")
             # labeled samples of the SAME family must stay adjacent
@@ -340,17 +419,20 @@ class Metrics:
                     f"{name}{self._prom_labels(dict(lk))} {val(v)}")
         for bare in sorted(labeled_by_name):
             name = self._prom_name(bare)
+            lines.append(self._help_line(name, bare))
             lines.append(f"# TYPE {name} gauge")
             for lk, v in labeled_by_name[bare]:
                 lines.append(
                     f"{name}{self._prom_labels(dict(lk))} {val(v)}")
         for w in windows:
             name = self._prom_name(w.name) + "_per_sec"
+            lines.append(self._help_line(name, w.name + "_per_sec"))
             lines.append(f"# TYPE {name} gauge")
             lines.append(
                 f'{name}{{window_s="{w.window_s:g}"}} {val(w.rate())}')
         for hname in sorted({h.name for h in hists}):
             name = self._prom_name(hname)
+            lines.append(self._help_line(name, hname))
             lines.append(f"# TYPE {name} histogram")
             for h in hists:
                 if h.name != hname:

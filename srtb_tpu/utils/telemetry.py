@@ -50,10 +50,15 @@ from srtb_tpu.utils.metrics import metrics
 # label of the stream this span belongs to — omitted on unnamed
 # single-stream runs, never a fake placeholder) so a fleet journal
 # (or N per-stream journals merged) attributes every span, loss
-# burst, demotion and shed to its tenant.  Readers must tolerate
-# mixed v1-v6 journals: rotation can leave an older-schema tail in
-# ``<path>.1`` after an upgrade.
-SPAN_SCHEMA_VERSION = 6
+# burst, demotion and shed to its tenant.
+# v7 (causal tracing): adds ``trace_id`` (the SegmentWork's causal id,
+# utils/events.py — omitted when the engine never stamped one, e.g.
+# events disabled) so a journal span and the flight recorder's events
+# for the same segment correlate exactly; an incident bundle's
+# spans_tail.jsonl joins its trace.jsonl on this field.  Readers must
+# tolerate mixed v1-v7 journals: rotation can leave an older-schema
+# tail in the previous generation after an upgrade.
+SPAN_SCHEMA_VERSION = 7
 
 # gauge names shared between the pipeline (writer) and health() (reader)
 LAST_SEGMENT_MONOTONIC = "last_segment_monotonic"
@@ -61,21 +66,57 @@ LAST_SEGMENT_UNIX = "last_segment_unix"
 
 
 class SpanJournal:
-    """Append-only JSONL with single-generation size rotation: when the
-    active file would exceed ``max_bytes`` it is renamed to ``<path>.1``
-    (replacing the previous generation) and a fresh file starts — an
-    always-on journal on a long observation can never fill the disk,
-    and the last ~2 x max_bytes of spans are always on hand."""
+    """Append-only JSONL with single-generation size rotation: when
+    the active file would exceed ``max_bytes`` the previous generation
+    is replaced and a fresh file starts — an always-on journal on a
+    long observation can never fill the disk, and the last
+    ~2 x max_bytes of spans are always on hand.  With ``compress``
+    (the default) the rotated generation is gzipped to ``<path>.1.gz``
+    (level 1 — ~10x smaller JSONL for one cheap pass, off the
+    dispatch path since rotation happens at most once per max_bytes of
+    spans); ``compress=False`` keeps the legacy plaintext ``<path>.1``.
+    Readers (tools/telemetry_report.load) handle both transparently."""
 
-    def __init__(self, path: str, max_bytes: int = 64 << 20):
+    def __init__(self, path: str, max_bytes: int = 64 << 20,
+                 compress: bool = True):
         if max_bytes <= 0:
             raise ValueError("max_bytes must be positive")
         self.path = path
         self.max_bytes = int(max_bytes)
+        self.compress = bool(compress)
         self._lock = threading.Lock()
+        # serializes gzip passes: a journal whose max_bytes fills
+        # faster than one generation compresses must queue the second
+        # pass, not interleave two writers into one temp file
+        self._compress_lock = threading.Lock()
+        self._rot_seq = 0
+        self._published_seq = 0  # newest generation already in .1.gz
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
+        # finish a rotation a previous life died in the middle of:
+        # an orphaned .rotN plaintext generation becomes the legacy
+        # .1 (newest wins, older orphans dropped — single-generation
+        # semantics)
+        base = os.path.basename(path)
+        try:
+            orphans = sorted(
+                (os.path.join(d or ".", n)
+                 for n in os.listdir(d or ".")
+                 if n.startswith(base + ".rot")),
+                key=lambda p: os.path.getmtime(p))
+        except OSError:
+            orphans = []
+        for p in orphans[:-1]:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        if orphans:
+            try:
+                os.replace(orphans[-1], path + ".1")
+            except OSError:
+                pass
         self._file = open(path, "a")
         self._size = self._file.tell()
 
@@ -84,12 +125,13 @@ class SpanJournal:
         rename error) logs once and disables the journal — telemetry
         must never abort the observation it is describing."""
         line = json.dumps(record, sort_keys=True) + "\n"
+        rotated = None
         with self._lock:
             if self._file is None:
                 return
             try:
                 if self._size and self._size + len(line) > self.max_bytes:
-                    self._rotate()
+                    rotated = self._rotate()
                 self._file.write(line)
                 self._file.flush()
                 self._size += len(line)
@@ -101,12 +143,84 @@ class SpanJournal:
                 except OSError:
                     pass
                 self._file = None
+        if rotated:
+            # gzip OUTSIDE the lock: concurrent writers keep
+            # appending to the fresh file while the one writer that
+            # tripped rotation pays the (single, per-max_bytes)
+            # compress pass
+            self._compress(*rotated)
 
-    def _rotate(self) -> None:
+    def _rotate(self) -> str | None:
+        """Swap in a fresh active file (cheap: close + rename + open,
+        under the lock).  Returns the renamed-out generation's path
+        for :meth:`_compress` when compression is on.  The rename
+        target is UNIQUE per rotation (``<path>.rotN``): a second
+        rotation completing while the previous generation is still
+        gzipping must not clobber the file being read, and the
+        in-flight compress must not unlink a newer generation that
+        reused its name."""
         self._file.close()
-        os.replace(self.path, self.path + ".1")
+        if self.compress:
+            self._rot_seq += 1
+            plain = f"{self.path}.rot{self._rot_seq}"
+        else:
+            plain = self.path + ".1"
+        os.replace(self.path, plain)
         self._file = open(self.path, "a")
         self._size = 0
+        return (plain, self._rot_seq) if self.compress else None
+
+    def _compress(self, plain: str, seq: int) -> None:
+        """Gzip one rotated generation to ``<path>.1.gz`` (atomic via
+        a per-generation temp + rename; on failure the generation is
+        renamed to the legacy plaintext ``.1`` — never lost, just
+        uncompressed).  Serialized by ``_compress_lock`` AND ordered
+        by ``seq``: a lock alone doesn't order contenders, so a
+        slower/preempted pass for an OLDER generation that loses the
+        race is dropped instead of overwriting the newer ``.1.gz`` —
+        single-generation semantics keep the newest."""
+        import gzip
+        import shutil
+        with self._compress_lock:
+            if seq < self._published_seq:
+                # a newer generation already published while this one
+                # waited: keeping ours would resurrect older data
+                try:
+                    os.unlink(plain)
+                except OSError:
+                    pass
+                return
+            gz = self.path + ".1.gz"
+            tmp = plain + ".gz.srtb_tmp"  # unique per generation
+            try:
+                with open(plain, "rb") as src, \
+                        gzip.open(tmp, "wb", compresslevel=1) as dst:
+                    shutil.copyfileobj(src, dst)
+                os.replace(tmp, gz)  # a crash mid-compress leaves
+                # only the temp + the .rotN plain (swept at next
+                # open), never a torn .gz
+                self._published_seq = seq
+                os.unlink(plain)
+                # a plaintext generation from a pre-compression run
+                # (or a past failed compress) must not linger as a
+                # phantom second history
+                try:
+                    os.unlink(self.path + ".1")
+                except FileNotFoundError:
+                    pass
+            except OSError as e:
+                log.warning(f"[telemetry] journal rotation gzip "
+                            f"failed ({e!r}); keeping the plaintext "
+                            "generation")
+                for cleanup in (tmp,):
+                    try:
+                        os.unlink(cleanup)
+                    except OSError:
+                        pass
+                try:
+                    os.replace(plain, self.path + ".1")
+                except OSError:
+                    pass
 
     def close(self) -> None:
         with self._lock:
@@ -127,7 +241,8 @@ def segment_span(segment: int, stages_s: dict, queue_depth: int,
                  overlap_hidden_s: float | None = None,
                  inflight_depth: int | None = None,
                  active_plan: str | None = None,
-                 stream: str | None = None) -> dict:
+                 stream: str | None = None,
+                 trace_id: int | None = None) -> dict:
     """One journal record.  ``stages_s`` maps stage name -> seconds for
     THIS segment; loss/drop counters are the cumulative registry values
     at drain time (deltas between consecutive records localize a loss
@@ -214,6 +329,10 @@ def segment_span(segment: int, stages_s: dict, queue_depth: int,
                     "plan_demotions", "plan_promotions",
                     "device_reinits", "plan_ladder_level"):
             rec[key] = type(rec[key])(metrics.get(key, labels=lbl))
+    if trace_id:
+        # v7: joins this span to its flight-recorder events (omitted
+        # when tracing is off — never a fake 0)
+        rec["trace_id"] = int(trace_id)
     if extra:
         rec.update(extra)
     return rec
@@ -311,4 +430,17 @@ def health(stale_after_s: float = 30.0) -> dict:
         out.update(status="stale", ok=False)
     else:
         out.update(status="ok", ok=True)
+    # SLO burn-rate evaluation (utils/slo.py): "degraded but within
+    # budget" and "burning error budget" as distinct, scrapeable
+    # states, per stream.  Deliberately NOT folded into ``ok`` — this
+    # endpoint's 503 is a LIVENESS contract (restart the pod); a
+    # burning SLO is an alerting concern, answered by the payload and
+    # the slo_burn_rate / slo_state gauges, not by killing the
+    # process that is still making (too slow / too lossy) progress.
+    from srtb_tpu.utils import slo as _slo
+    slo_report = _slo.evaluate()
+    if slo_report is not None:
+        out["slo"] = slo_report
+        out["slo_ok"] = all(v.get("ok", True)
+                            for v in slo_report.values())
     return out

@@ -16,8 +16,8 @@ one faulty tenant, blast radius exactly itself —
 - admission control rejects/queues over capacity in priority order,
   and the fleet shed policy sheds lowest-priority real-time streams
   first with hysteresis;
-- per-stream observability: ``stream``-labeled metrics, v6 journal
-  attribution, per-stream /healthz staleness, mixed v5/v6 reports.
+- per-stream observability: ``stream``-labeled metrics, v7 journal
+  attribution, per-stream /healthz staleness, mixed v5/v6/v7 reports.
 """
 
 import json
@@ -323,10 +323,10 @@ def test_fleet_victim_oom_isolated(tmp_path):
     # victim: decisions exact (time series may carry the demoted
     # plan's documented tolerance)
     _decisions_equal(caps["s1"].out, solo["s1"][1], ts_exact=False)
-    # v6 journals: stream-stamped; per-stream attribution fields
+    # v7 journals: stream-stamped; per-stream attribution fields
     for t in bbs:
         recs = [json.loads(line) for line in open(jp[t])]
-        assert all(r["v"] == 6 and r["stream"] == t for r in recs)
+        assert all(r["v"] == 7 and r["stream"] == t for r in recs)
         want = 1 if t == "s1" else 0
         assert recs[-1]["plan_demotions"] == want, t
 
@@ -543,15 +543,15 @@ def test_fleet_prometheus_labels(tmp_path):
     assert 'srtb_segments{stream="beam0"}' in prom
 
 
-# ------------------------------------------------- v6 schema + report
+# ------------------------------------------------- v7 schema + report
 
 
-def test_span_schema_v6_stream_field():
+def test_span_schema_v7_stream_field():
     from srtb_tpu.utils.telemetry import (SPAN_SCHEMA_VERSION,
                                           segment_span)
-    assert SPAN_SCHEMA_VERSION == 6
+    assert SPAN_SCHEMA_VERSION == 7
     rec = segment_span(0, {"ingest": 0.01}, 1, 0, False, 4)
-    assert rec["v"] == 6 and "stream" not in rec
+    assert rec["v"] == 7 and "stream" not in rec
     metrics.set("plan_demotions", 7)  # global; must NOT leak into a
     metrics.add("plan_demotions", 2, labels={"stream": "x"})
     rec = segment_span(0, {"ingest": 0.01}, 1, 0, False, 4,

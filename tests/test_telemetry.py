@@ -5,6 +5,7 @@ pipeline -> journal -> telemetry_report path on the CPU backend."""
 
 import json
 import math
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -130,6 +131,105 @@ def test_prometheus_exposition_format():
         assert name_part and float(val) == float(val)
 
 
+def test_prometheus_help_type_conformance():
+    """Exposition-format conformance: every family carries exactly one
+    # HELP and one # TYPE line, HELP first, and all of a family's
+    samples stay contiguous after its metadata (strict expfmt
+    parsers reject re-opened families and samples before TYPE)."""
+    m = Metrics()
+    m.add("segments", 7)
+    m.add("segments", 2, labels={"stream": "beam0"})
+    m.add("custom_thing", 1)  # unknown family: generic HELP fallback
+    m.add("only_labeled", 1, labels={"stream": "beam1"})
+    m.histogram("stage_seconds", labels={"stage": "fetch"}).observe(0.1)
+    m.window("samples", window_s=10.0).add(5)
+    lines = m.prometheus().strip().split("\n")
+    seen_help: dict[str, int] = {}
+    seen_type: dict[str, int] = {}
+    current = None
+    families_order = []
+    for ln in lines:
+        if ln.startswith("# HELP "):
+            name = ln.split()[2]
+            seen_help[name] = seen_help.get(name, 0) + 1
+            assert len(ln.split(" ", 3)) == 4 and ln.split(" ", 3)[3]
+        elif ln.startswith("# TYPE "):
+            name = ln.split()[2]
+            seen_type[name] = seen_type.get(name, 0) + 1
+            # HELP precedes TYPE for the same family
+            assert seen_help.get(name) == seen_type[name]
+            current = name
+            families_order.append(name)
+        else:
+            sample = ln.split("{")[0].split(" ")[0]
+            # a sample belongs to the most recently opened family
+            # (histograms append _bucket/_sum/_count)
+            assert sample == current or sample.startswith(
+                current + "_"), (sample, current)
+    # one HELP + one TYPE per family, no family opened twice
+    assert seen_help == seen_type
+    assert all(v == 1 for v in seen_type.values())
+    assert len(families_order) == len(set(families_order))
+    # known families get real help text, unknown the generic fallback
+    text = "\n".join(lines)
+    assert ("# HELP srtb_segments Segments drained end-to-end "
+            "(lifetime)") in text
+    assert "# HELP srtb_custom_thing srtb_tpu runtime metric" in text
+    assert "# HELP srtb_only_labeled" in text
+    assert "# HELP srtb_samples_per_sec" in text
+    assert "# HELP srtb_stage_seconds" in text
+
+
+def test_labeled_series_concurrent_with_scraper():
+    """Satellite: fleet lanes hammer add/set(labels=) on one registry
+    while a scraper snapshots — no torn reads, no lost updates, and
+    the final totals are exact."""
+    m = Metrics()
+    n_threads, n_iter = 8, 2000
+    stop = threading.Event()
+    scrape_errors = []
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                snap = m.snapshot()
+                text = m.prometheus()
+                # every rendered sample parses back as a float; the
+                # labeled samples stay contiguous with their family
+                for ln in text.strip().split("\n"):
+                    if not ln.startswith("#"):
+                        float(ln.rpartition(" ")[2])
+                assert isinstance(snap, dict)
+            except Exception as e:  # noqa: BLE001 - recorded, asserted
+                scrape_errors.append(e)
+                return
+
+    def lane(i):
+        labels = {"stream": f"beam{i % 4}"}
+        for k in range(n_iter):
+            m.add("segments_dropped", 1, labels=labels)
+            m.add("segments_dropped", 1)  # flat twin
+            m.set("inflight_depth", k % 5, labels=labels)
+
+    threads = [threading.Thread(target=lane, args=(i,))
+               for i in range(n_threads)]
+    scr = threading.Thread(target=scraper)
+    scr.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    scr.join()
+    assert not scrape_errors, scrape_errors
+    assert m.get("segments_dropped") == n_threads * n_iter
+    per = m.by_label("segments_dropped")
+    assert sum(per.values()) == n_threads * n_iter
+    # 8 lanes over 4 stream labels: each label saw exactly 2 lanes
+    assert set(per) == {f"beam{i}" for i in range(4)}
+    assert all(v == 2 * n_iter for v in per.values())
+
+
 def test_prometheus_includes_derived_series():
     """The derived scalars the JSON snapshot computes (loss rates,
     lifetime Msamples/s, elapsed) are exposed to Prometheus too — an
@@ -170,7 +270,7 @@ def test_span_journal_roundtrip_and_rotation(tmp_path):
     recs = TR.load(path)
     assert len(recs) == 3
     r = recs[-1]
-    assert r["type"] == "segment_span" and r["v"] == 6
+    assert r["type"] == "segment_span" and r["v"] == 7
     assert r["segment"] == 2 and r["detections"] == 2 and r["dump"]
     assert r["samples"] == 1 << 16 and r["timestamp_ns"] == 123
     assert r["queue_depth"] == 1
@@ -178,17 +278,29 @@ def test_span_journal_roundtrip_and_rotation(tmp_path):
     assert r["stages_ms"]["fetch"] == 100.0
     assert "ts" in r and "packets_lost" in r
 
-    # rotation: a tiny cap forces <path> -> <path>.1; load() reads both
+    # rotation: a tiny cap forces the previous generation out — gzip'd
+    # to <path>.1.gz by default; load() reads both transparently
     small = str(tmp_path / "rot.jsonl")
     with SpanJournal(small, max_bytes=600) as j:
         for i in range(10):
             j.write(segment_span(i, {"sink": 0.001}, 0, 0, False, 1))
     rotated = TR.load(small)
-    assert (tmp_path / "rot.jsonl.1").exists()
+    assert (tmp_path / "rot.jsonl.1.gz").exists()
+    assert not (tmp_path / "rot.jsonl.1").exists()
     # the active file never exceeds the cap; the newest spans and the
     # previous generation both survive, oldest first
     assert (tmp_path / "rot.jsonl").stat().st_size <= 600
     segs = [r["segment"] for r in rotated]
+    assert segs and segs[-1] == 9 and segs == sorted(segs)
+
+    # legacy plaintext rotation still available (compress=False), and
+    # the reader handles it identically
+    plain = str(tmp_path / "plain.jsonl")
+    with SpanJournal(plain, max_bytes=600, compress=False) as j:
+        for i in range(10):
+            j.write(segment_span(i, {"sink": 0.001}, 0, 0, False, 1))
+    assert (tmp_path / "plain.jsonl.1").exists()
+    segs = [r["segment"] for r in TR.load(plain)]
     assert segs and segs[-1] == 9 and segs == sorted(segs)
 
 
@@ -260,6 +372,65 @@ def test_telemetry_report_stats_and_timeline(tmp_path):
     empty = tmp_path / "empty.jsonl"
     empty.write_text("")
     assert TR.main([str(empty)]) == 1
+
+
+def test_report_json_matches_md_sections(tmp_path, capsys):
+    """Satellite: --format json is machine-readable with the SAME
+    sections the text report renders — CI/dashboards must not scrape
+    human tables."""
+    from srtb_tpu.tools import telemetry_report as TR
+    from srtb_tpu.utils.telemetry import SpanJournal, segment_span
+
+    path = str(tmp_path / "j.jsonl")
+    with SpanJournal(path) as j:
+        for i in range(4):
+            j.write(segment_span(
+                i, {"ingest": 0.001, "dispatch": 0.01, "fetch": 0.02,
+                    "sink": 0.002}, 1, i % 2, bool(i % 2), 1 << 16,
+                overlap_hidden_s=0.005, inflight_depth=2,
+                active_plan="four_step+ftail", stream="beam0",
+                trace_id=i + 1))
+    assert TR.main([path, "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    # every section of the dict report is present in the JSON output
+    assert set(doc) == set(TR.report(path))
+    assert set(doc) >= {"journal", "records", "stages", "overlap",
+                        "resilience", "compute", "durability",
+                        "fleet", "timeline"}
+    assert doc["records"] == 4
+    assert doc["stages"]["dispatch"]["count"] == 4
+    assert doc["fleet"]["beam0"]["records"] == 4
+    # and the md rendering consumes the identical dict
+    md = TR._md(doc)
+    assert "## Per-stage wall clock" in md and "| beam0 |" in md
+
+
+def test_gzip_rotated_generation_reads_transparently(tmp_path):
+    """Satellite: a .jsonl.gz previous generation (and a torn gzip
+    tail) feed the report exactly like plaintext."""
+    import gzip
+
+    from srtb_tpu.tools import telemetry_report as TR
+
+    path = str(tmp_path / "j.jsonl")
+    with gzip.open(path + ".1.gz", "wt", compresslevel=1) as f:
+        for i in range(3):
+            f.write(json.dumps({"type": "segment_span", "v": 7,
+                                "ts": 1000.0 + i, "segment": i,
+                                "stages_ms": {"sink": 1.0},
+                                "samples": 1}) + "\n")
+    with open(path, "w") as f:
+        f.write(json.dumps({"type": "segment_span", "v": 7,
+                            "ts": 1003.0, "segment": 3,
+                            "stages_ms": {"sink": 1.0},
+                            "samples": 1}) + "\n")
+    recs = TR.load(path)
+    assert [r["segment"] for r in recs] == [0, 1, 2, 3]
+    # torn gzip tail (crash mid-rotation): readable prefix survives
+    raw = open(path + ".1.gz", "rb").read()
+    open(path + ".1.gz", "wb").write(raw[:len(raw) - 8])
+    recs = TR.load(path)
+    assert recs and recs[-1]["segment"] == 3
 
 
 def test_timeline_tail_record_no_rate_spike(tmp_path):
