@@ -185,11 +185,18 @@ def parse_args(argv=None):
     # the env spelling the queue scripts use.
     p.add_argument("--ring", choices=("on", "off", "none"),
                    default=os.environ.get("SRTB_BENCH_RING", "none"))
+    # perf-ledger output (utils/perf_ledger.py): append this run's
+    # measurement — value, per-rep seconds, plan signature hash, host
+    # fingerprint, git sha — to the queryable trajectory.
+    # SRTB_PERF_LEDGER is the env spelling the queue scripts use.
+    p.add_argument("--ledger",
+                   default=os.environ.get("SRTB_PERF_LEDGER", ""))
     return p.parse_args(argv)
 
 
 def run_bench(platform_error, overlap: str = "on",
-              fused_tail: str = "auto", ring: str = "none"):
+              fused_tail: str = "auto", ring: str = "none",
+              ledger: str = ""):
     import jax
 
     from srtb_tpu.utils.platform import apply_platform_env
@@ -270,6 +277,19 @@ def run_bench(platform_error, overlap: str = "on",
     # host-side constant building (chirp banks) isn't miscounted as
     # compile.
     t0 = time.perf_counter()
+    # uniform compile accounting (perf observatory): ONE timer started
+    # before construction for BOTH protocols — compile_ms covers
+    # construction + warmup sync whether the compile happened inside
+    # __init__ (AOT load-or-compile) or inside the first dispatch
+    # (lazy jit), unlike the legacy compile_s whose start point
+    # differs by path (kept below for row comparability with rounds
+    # 2+).  The plan/AOT cache counters are metric deltas across the
+    # same window.
+    from srtb_tpu.utils.metrics import metrics as _metrics
+    cache0 = {k: _metrics.get(k) for k in
+              ("aot_cache_hits", "aot_cache_misses", "plan_compiles",
+               "compile_seconds")}
+    t_build = time.perf_counter()
     from srtb_tpu.pipeline import registry
     proc = registry.build_processor(
         cfg, staged=None if staged_env == "" else bool(int(staged_env)))
@@ -297,6 +317,8 @@ def run_bench(platform_error, overlap: str = "on",
         wf, res = proc.run_device(raw_dev)
         np.asarray(res.signal_counts)
     compile_s = time.perf_counter() - t0
+    compile_ms = (time.perf_counter() - t_build) * 1e3
+    cache_delta = {k: _metrics.get(k) - cache0[k] for k in cache0}
     del wf, res  # a retained 4 GB waterfall would OOM the next 2^30 run
 
     # optional profiler capture of the steady state (xprof format)
@@ -323,7 +345,12 @@ def run_bench(platform_error, overlap: str = "on",
     t0 = time.perf_counter()
     last = None
     carry = None
+    rep_seconds = []  # per-rep wall: REAL per-segment samples with
+    # overlap off (each rep ends in a blocking sync); dispatch-issue
+    # times with overlap on (the device sync lands after the loop) —
+    # the regression gate should feed on overlap=off legs
     for _ in range(reps):
+        t_rep = time.perf_counter()
         if ring == "none":
             wf, res = proc.run_device(raw_dev)
         elif ring == "on" and carry is not None:
@@ -355,6 +382,7 @@ def run_bench(platform_error, overlap: str = "on",
             # per-segment dispatch + tunnel RTT (~60 ms, PERF.md) is
             # paid every time
             np.asarray(last)
+        rep_seconds.append(round(time.perf_counter() - t_rep, 5))
     np.asarray(last)
     del carry
     dt = (time.perf_counter() - t0) / reps
@@ -374,6 +402,16 @@ def run_bench(platform_error, overlap: str = "on",
         "log2n": int(math.log2(n)),
         "segment_time_s": round(dt, 4),
         "compile_s": round(compile_s, 1),
+        # uniform-semantics compile time (construction -> warmup sync,
+        # both AOT and lazy-jit protocols) + the cache/compile counter
+        # deltas over the same window — every line now says whether
+        # its compile was a cache hit, a miss, or a lazy first
+        # dispatch, identically across protocols
+        "compile_ms": round(compile_ms, 1),
+        "aot_cache_hits": int(cache_delta["aot_cache_hits"]),
+        "aot_cache_misses": int(cache_delta["aot_cache_misses"]),
+        "plan_compiles": int(cache_delta["plan_compiles"]),
+        "rep_seconds": rep_seconds,
         "model_gflops": round(flops / 1e9, 1),
         "achieved_gflops_s": round(flops / dt / 1e9, 1),
         "model_hbm_gb": round(bytes_moved / 1e9, 3),
@@ -440,6 +478,25 @@ def run_bench(platform_error, overlap: str = "on",
     out["pass"] = baseline_pass(on_accel, realtime_factor)
     if platform_error:
         out["accelerator_error"] = platform_error
+    if ledger:
+        try:
+            from srtb_tpu.utils import perf_ledger as PL
+            extra = {k: out[k] for k in
+                     ("overlap", "ring", "hbm_passes", "fused_tail",
+                      "compile_s", "compile_ms", "roofline_frac",
+                      "achieved_gbps", "vs_baseline", "search_mode")
+                     if k in out}
+            PL.PerfLedger(ledger).append(PL.make_record(
+                "bench", out["value"], out["unit"],
+                plan=proc.plan_name,
+                plan_signature=proc.plan_signature(),
+                shape={"log2n": out["log2n"], "channels": channels,
+                       "nbits": cfg.baseband_input_bits},
+                platform=platform, samples_s=rep_seconds,
+                extra=extra))
+        except Exception as e:  # the artifact line must still land
+            print(f"bench: WARNING: perf-ledger append failed: {e}",
+                  file=sys.stderr)
     emit(out)
 
 
@@ -482,7 +539,7 @@ def main():
     watchdog = _arm_watchdog(platform, err)
     try:
         run_bench(err, overlap=args.overlap, fused_tail=args.fused_tail,
-                  ring=args.ring)
+                  ring=args.ring, ledger=args.ledger)
         # disarm before teardown: a slow runtime shutdown must not fire
         # a second, contradictory diagnostic line after the real result
         if watchdog is not None:

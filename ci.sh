@@ -24,13 +24,13 @@ if [ "${1:-}" = "--hardware" ]; then
   exit 0
 fi
 
-echo "== [1/17] native build =="
+echo "== [1/18] native build =="
 make -C srtb_tpu/native
 
-echo "== [2/17] native sanitizer harness (ASan/UBSan) =="
+echo "== [2/18] native sanitizer harness (ASan/UBSan) =="
 make -C srtb_tpu/native check
 
-echo "== [3/17] static checks (compile + import) =="
+echo "== [3/18] static checks (compile + import) =="
 python -m compileall -q srtb_tpu tests bench.py __graft_entry__.py
 python - <<'EOF'
 import importlib, pkgutil
@@ -45,7 +45,7 @@ assert not bad, bad
 print(f"all srtb_tpu modules import cleanly")
 EOF
 
-echo "== [4/17] srtb-lint (static analysis vs baseline) =="
+echo "== [4/18] srtb-lint (static analysis vs baseline) =="
 # fails on findings not in srtb_tpu/analysis/baseline.json; accept an
 # intentional finding with --write-baseline + a note, or a pragma.
 # The machine-readable run lands next to the other CI artifacts.
@@ -54,7 +54,7 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.lint srtb_tpu/ \
   --format json > artifacts/lint.json \
   || { cat artifacts/lint.json; exit 1; }
 
-echo "== [5/17] plan audit (compile-time HLO cards vs baseline) =="
+echo "== [5/18] plan audit (compile-time HLO cards vs baseline) =="
 # AOT-lowers every plan family and audits the compiled artifacts:
 # spectrum-sized HBM sweeps vs the declared hbm_passes floor, donation
 # proven aliased (not silently dropped), no f64/host-callback/
@@ -66,7 +66,7 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.plan_audit \
   --out artifacts/plan_cards_audit.json
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.plan_audit --selftest
 
-echo "== [6/17] pytest (8-device CPU mesh) =="
+echo "== [6/18] pytest (8-device CPU mesh) =="
 FAST_ARGS=()
 if [ "${1:-}" = "--fast" ]; then
   # one source of truth for what "slow" means: the pytest marker
@@ -75,11 +75,11 @@ if [ "${1:-}" = "--fast" ]; then
 fi
 python -m pytest tests/ -q "${FAST_ARGS[@]}"
 
-echo "== [7/17] bench smoke (with the roofline/audit cross-check) =="
+echo "== [7/18] bench smoke (with the roofline/audit cross-check) =="
 JAX_PLATFORMS=cpu SRTB_BENCH_LOG2N=16 SRTB_BENCH_AUDIT=1 \
   python bench.py | tail -1
 
-echo "== [8/17] fused-plan parity (spectrum-pass fusion, Pallas interpret on CPU) =="
+echo "== [8/18] fused-plan parity (spectrum-pass fusion, Pallas interpret on CPU) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import numpy as np
 
@@ -122,13 +122,13 @@ print(f"fused-plan parity OK: plan {fused.plan_name} "
       "detections bit-identical")
 EOF
 
-echo "== [9/17] ring parity smoke (incremental H2D ring on vs off, Pallas interpret) =="
+echo "== [9/18] ring parity smoke (incremental H2D ring on vs off, Pallas interpret) =="
 # The ISSUE-8 acceptance gate: ring-on output is bit-identical to
 # ring-off on a Pallas-kernel plan (interpret mode on CPU), and the
 # per-segment h2d_bytes counter equals the stride model exactly — the
 # full segment on the one cold dispatch, stride_bytes (segment minus
 # the reserved overlap tail) on every warm dispatch.  The plan-audit
-# stage [5/17] already proved the carry donation is a real alias for
+# stage [5/18] already proved the carry donation is a real alias for
 # every ring-v1 family; this proves the runtime keeps its half of the
 # contract.
 JAX_PLATFORMS=cpu python - <<'EOF'
@@ -191,7 +191,7 @@ print(f"ring parity OK: plan {proc.plan_name}, {s_on.segments} segments "
       f"{proc.reserved_bytes / seg_b:.1%} per warm segment)")
 EOF
 
-echo "== [10/17] telemetry + sanitizer smoke (journal + report + /metrics + /healthz + Config.sanitize) =="
+echo "== [10/18] telemetry + sanitizer smoke (journal + report + /metrics + /healthz + Config.sanitize) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import json, os, tempfile, urllib.request
 
@@ -223,12 +223,20 @@ assert stats.segments >= 2, stats
 # journal non-empty and parseable by telemetry_report
 recs = TR.load(journal)
 assert recs, "telemetry journal is empty"
-# schema-v3 span fields (async engine + resilience) on every record
+# v8 span fields (async engine + resilience + perf observatory) on
+# every record: device-time accounting + live roofline + compile/cache
+# books must ride every span, not just /metrics
 for rec in recs:
-    assert rec["v"] == 7, rec
+    assert rec["v"] == 8, rec
     assert "overlap_hidden_ms" in rec and rec["inflight_depth"] >= 1, rec
-    for key in ("degrade_level", "retries", "requeues", "restarts"):
+    for key in ("degrade_level", "retries", "requeues", "restarts",
+                "device_ms", "achieved_msamps", "roofline_frac",
+                "compile_ms", "plan_compiles", "aot_cache_hits",
+                "aot_cache_misses"):
         assert key in rec, (key, rec)
+    assert rec["device_ms"] > 0 and rec["roofline_frac"] > 0, rec
+# the lazy-jit first dispatch was counted as the run's compile event
+assert recs[-1]["plan_compiles"] >= 1 and recs[-1]["compile_ms"] > 0
 rep = TR.report(journal)
 for stage in ("ingest", "dispatch", "fetch", "sink", "overlap"):
     assert rep["stages"][stage]["count"] == stats.segments, (stage, rep)
@@ -243,6 +251,14 @@ try:
     assert 'srtb_stage_seconds_bucket{le="+Inf",stage="dispatch"}' in prom
     assert 'srtb_stage_seconds_bucket{le="+Inf",stage="overlap"}' in prom
     assert "srtb_inflight_depth" in prom
+    # perf-observatory families (ISSUE 14): live roofline gauges,
+    # device-time histogram, compile/cache counters all scrapeable
+    assert "# TYPE srtb_device_seconds histogram" in prom
+    for fam in ("srtb_roofline_frac", "srtb_achieved_msamps",
+                "srtb_achieved_gbps", "srtb_compile_seconds",
+                "srtb_plan_compiles", "srtb_aot_cache_hits",
+                "srtb_aot_cache_misses"):
+        assert f"\n{fam} " in prom or prom.startswith(f"{fam} "), fam
     h = json.loads(urllib.request.urlopen(base + "/healthz").read())
     assert h["ok"] and h["status"] == "ok", h
 finally:
@@ -267,7 +283,7 @@ print(f"sanitizer smoke OK: {stats_s.segments} segments with "
       "Config.sanitize on, tripwire restored")
 EOF
 
-echo "== [11/17] fault-injection smoke (one transient fault at every site -> recovery + v7 telemetry) =="
+echo "== [11/18] fault-injection smoke (one transient fault at every site -> recovery + v8 telemetry) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import json, os, tempfile
 
@@ -334,7 +350,7 @@ assert "srtb_retries_total 6" in prom, prom[:400]
 assert "srtb_faults_injected 6" in prom
 # v3 journal fields + report resilience section
 recs = TR.load(journal)
-assert recs and all(r["v"] == 7 for r in recs)
+assert recs and all(r["v"] == 8 for r in recs)
 # the checkpoint-site retry of the last segment lands after that
 # segment's journal write: the final record carries 5 of the 6
 assert recs[-1]["retries"] == 5 and recs[-1]["requeues"] == 0
@@ -342,10 +358,10 @@ rep = TR.report(journal)
 assert rep["resilience"]["retries"] == 5, rep["resilience"]
 print(f"fault-injection smoke OK: {st1.segments} segments recovered "
       "bit-identical through 6 injected faults, retries accounted in "
-      "/metrics + v7 journal")
+      "/metrics + v8 journal")
 EOF
 
-echo "== [12/17] chaos smoke (self-healing compute: oom + compile_fail + device_halt in one run) =="
+echo "== [12/18] chaos smoke (self-healing compute: oom + compile_fail + device_halt in one run) =="
 # The ISSUE-9 acceptance gate: a deterministic fault plan injecting all
 # three device-fault classes completes with accounted-only loss,
 # detection decisions identical to the clean run, and the
@@ -359,7 +375,7 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.chaos_soak --segments 6 \
   | tail -1
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.chaos_soak --selftest
 
-echo "== [13/17] crash-soak smoke (SIGKILL exactly-once: manifest recovery + fsck + bit-identical union) =="
+echo "== [13/18] crash-soak smoke (SIGKILL exactly-once: manifest recovery + fsck + bit-identical union) =="
 # The ISSUE-10 acceptance gate, CI-sized: a deterministic two-kill plan
 # — one SIGKILL mid-checkpoint-flush (between sink commit and the
 # checkpoint update, the duplicate-on-resume window) and one mid-
@@ -374,18 +390,18 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.crash_soak --segments 5 \
   --kills 2 --kill-plan "ckpt_stall@1,rename@1" --log2n 13 | tail -1
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.fsck --selftest
 
-echo "== [14/17] multichip dryrun (8 virtual devices) =="
+echo "== [14/18] multichip dryrun (8 virtual devices) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== [15/17] fleet smoke (multi-tenant bulkheads: 3 streams, 1 victim, shared plan cache) =="
+echo "== [15/18] fleet smoke (multi-tenant bulkheads: 3 streams, 1 victim, shared plan cache) =="
 # The ISSUE-11 acceptance gate, CI-sized: 3 seeded streams on one
 # device, a stream-selector fault plan injected into stream0 (oom ->
 # victim-only demotion, plus a transient sink fault and a fetch
 # stall).  Gate: every healthy stream's output set (paths + SHA-256)
 # bit-identical to its solo single-stream golden run, the victim's
 # loss accounted-only with demotions attributed to its stream id in
-# the v7 journal, and the shared AOT plan cache recording exactly ONE
+# the v8 journal, and the shared AOT plan cache recording exactly ONE
 # compile for the shared plan family.  The selftest then proves the
 # gate catches cross-stream leakage (an UNSCOPED fault plan arming in
 # every lane must FAIL the healthy-journal attribution check).
@@ -393,7 +409,7 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.fleet_soak --streams 3 \
   --segments 4 --log2n 12 | tail -1
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.fleet_soak --selftest
 
-echo "== [16/17] archive-replay smoke (full-throughput replay: SIGTERM resume + bit-identical union + micro-batch tolerance) =="
+echo "== [16/18] archive-replay smoke (full-throughput replay: SIGTERM resume + bit-identical union + micro-batch tolerance) =="
 # The ISSUE-12 acceptance gate, CI-sized: a 2-file fleet-fanned replay
 # (deterministic timestamps, per-file checkpoint + manifest namespaces)
 # killed by a SIGTERM steered into one lane's sink-write window, then
@@ -405,7 +421,7 @@ echo "== [16/17] archive-replay smoke (full-throughput replay: SIGTERM resume + 
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.archive_replay --selftest \
   --segments 4 --log2n 13 | tail -1
 
-echo "== [17/17] trace/incident smoke (causal tracing + flight recorder + bundle + Chrome-trace export) =="
+echo "== [17/18] trace/incident smoke (causal tracing + flight recorder + bundle + Chrome-trace export) =="
 # The ISSUE-13 acceptance gate, CI-sized: a clean traced run proves
 # every segment leaves a complete ingest->dispatch->fetch->sink causal
 # chain whose export is valid Chrome-trace JSON (schema-checked, flow
@@ -490,6 +506,39 @@ print(f"trace/incident smoke OK: {stats.segments} traced segments "
       f"exported with {len(starts)} cross-thread flows; escalation "
       f"produced exactly one bundle ({bundles[0]}) carrying trace "
       f"{meta['trace_id']}")
+EOF
+
+echo "== [18/18] perf-gate smoke (noise-aware regression gate + ledger trajectory) =="
+# The ISSUE-14 acceptance gate: (a) the gate's selftest proves an
+# injected dispatch-path slowdown (Config.fault_plan stall) FAILS the
+# statistical gate while a clean rerun passes within the COMPUTED
+# noise floor; (b) a calibrated mini-bench is compared against the
+# checked-in CPU baseline (PERF_BASELINE.json) — cross-host runs are
+# rescaled by the calibration workload and gated at a generous
+# smoke-alarm effect floor, so CI catches a gross regression without
+# flaking on scheduler noise; (c) the legacy BENCH_r0*.json history
+# imports into a perf ledger idempotently and perf_report renders the
+# trajectory.
+JAX_PLATFORMS=cpu python -m srtb_tpu.tools.perf_gate --selftest | tail -1
+JAX_PLATFORMS=cpu python -m srtb_tpu.tools.perf_gate \
+  --baseline PERF_BASELINE.json --min-effect 0.5 \
+  --ledger artifacts/perf_ledger.jsonl | tail -1
+python -m srtb_tpu.tools.perf_ledger artifacts/perf_ledger.jsonl \
+  --import 'BENCH_r0*.json'
+# idempotent: a second import must skip everything it already ingested
+python -m srtb_tpu.tools.perf_ledger artifacts/perf_ledger.jsonl \
+  --import 'BENCH_r0*.json' | grep -q '"imported": 0'
+python -m srtb_tpu.tools.perf_report artifacts/perf_ledger.jsonl \
+  --format json > artifacts/perf_trajectory.json
+python - <<'EOF'
+import json
+doc = json.load(open("artifacts/perf_trajectory.json"))
+assert doc["records"] >= 5, doc["records"]
+rows = [r for g in doc["groups"].values() for r in g["rows"]]
+assert any(r["source"] == "import" for r in rows)
+assert any(r["source"] == "gate" for r in rows)
+print(f"perf trajectory OK: {doc['records']} records across "
+      f"{len(doc['groups'])} group(s), imports + gate captures present")
 EOF
 
 echo "CI OK"

@@ -420,6 +420,24 @@ class Config:
     slo_fast_window_s: float = 300.0
     slo_slow_window_s: float = 3600.0
     slo_burn_threshold: float = 1.0
+    # ---- performance observatory ----
+    # HBM peak (GB/s) the live roofline_frac gauge divides by (v5e
+    # public number by default; set per accelerator generation).  The
+    # gauge is a LOWER bound by construction: the traffic model is the
+    # active plan's audited hbm_passes floor and the device wall is an
+    # upper bound (see pipeline/runtime.py _device_time_account).
+    hbm_peak_gbps: float = 819.0
+    # record a REAL jax.profiler (XLA) trace of the first N drained
+    # segments of a run into profile_capture_dir, next to the Perfetto
+    # event export; the capture.json sidecar records the covered
+    # trace_ids so the device timeline and the causal-event timeline
+    # join exactly.  0 = off (zero cost).
+    profile_capture_segments: int = 0
+    profile_capture_dir: str = "artifacts/profile"
+    # append one "steady" perf record per finished run to this perf
+    # ledger (utils/perf_ledger.py JSONL; tools/perf_report.py renders
+    # the trajectory, tools/perf_gate.py gates regressions).  "" = off.
+    perf_ledger_path: str = ""
     # /healthz flips to 503 when the last processed segment is older
     # than this many seconds (gui/server.py staleness detection)
     health_stale_after_s: float = 30.0
@@ -474,7 +492,7 @@ class Config:
         "fleet_queue_limit", "periodicity_harmonics",
         "periodicity_candidates", "periodicity_fold_bins",
         "periodicity_min_bin", "events_ring_size",
-        "incident_max_bundles",
+        "incident_max_bundles", "profile_capture_segments",
     })
     _FLOAT_FIELDS = frozenset({
         "baseband_freq_low", "baseband_bandwidth", "baseband_sample_rate",
@@ -490,7 +508,7 @@ class Config:
         "incident_min_interval_s", "slo_latency_ms",
         "slo_latency_budget", "slo_loss_budget", "slo_staleness_s",
         "slo_staleness_budget", "slo_fast_window_s",
-        "slo_slow_window_s", "slo_burn_threshold",
+        "slo_slow_window_s", "slo_burn_threshold", "hbm_peak_gbps",
     })
     _BOOL_FIELDS = frozenset({
         "baseband_reserve_sample", "baseband_write_all", "gui_enable",

@@ -132,6 +132,11 @@ class SharedPlanCache:
         # carries the mode)
         from srtb_tpu.pipeline import registry
         key = registry.plan_cache_key(cfg, donate_input=donate_input)
+        # per-stream labeled twins (performance observatory): which
+        # tenant paid a compile and which rode a shared plan for free
+        # must be scrapeable, not just the fleet totals
+        lbl = ({"stream": cfg.stream_name}
+               if getattr(cfg, "stream_name", "") else None)
         proc = self._by_key.get(key)
         if proc is None:
             proc = registry.build_processor(
@@ -139,11 +144,15 @@ class SharedPlanCache:
             self._by_key[key] = proc
             self.compiles += 1
             metrics.add("fleet_plan_compiles")
+            if lbl is not None:
+                metrics.add("fleet_plan_compiles", labels=lbl)
             log.info(f"[fleet] plan cache MISS: built shared plan "
                      f"{proc.plan_name} ({self.compiles} families)")
         else:
             self.hits += 1
             metrics.add("fleet_plan_cache_hits")
+            if lbl is not None:
+                metrics.add("fleet_plan_cache_hits", labels=lbl)
         return proc
 
     def invalidate(self) -> None:

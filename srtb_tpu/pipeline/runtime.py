@@ -403,6 +403,20 @@ class Pipeline:
         self.stage_timer = StageTimer(
             on_stage=lambda name, dt: metrics.histogram(
                 "stage_seconds", labels={"stage": name}).observe(dt))
+        # ---- performance observatory (always-on) ----
+        # pre-register the compile/cache families so /metrics exposes
+        # them from the first scrape (a counter that was never bumped
+        # is still an answer: zero compiles so far), and the labeled
+        # twins for a named fleet lane
+        for fam in ("compile_seconds", "plan_compiles",
+                    "aot_cache_hits", "aot_cache_misses"):
+            metrics.add(fam, 0.0)
+            if self._stream_labels is not None:
+                metrics.add(fam, 0.0, labels=self._stream_labels)
+        # on-demand jax.profiler capture of the first N segments
+        # (Config.profile_capture_segments; None = off, zero-cost)
+        from srtb_tpu.utils.tracing import ProfileCapture
+        self.profile_capture = ProfileCapture.from_config(cfg)
         self.journal = None
         jpath = getattr(cfg, "telemetry_journal_path", "")
         if jpath:
@@ -475,15 +489,56 @@ class Pipeline:
                                  stream=self.stream, seg=index, dur=dt)
         return seg
 
+    def _device_time_account(self, device_s: float,
+                             n_samples: int) -> tuple:
+        """Always-on device-time accounting for one drained segment:
+        the ``device_seconds`` histogram plus the LIVE roofline gauges
+        — achieved Msamples/s and modeled-HBM GB/s over this segment's
+        device wall, and ``roofline_frac`` against the configured HBM
+        peak (``Config.hbm_peak_gbps``).  The traffic model is the
+        active plan's audited ``hbm_passes`` floor (the quantity the
+        HLO plan auditor pins in plan_cards.json), so the gauges are
+        per-plan LOWER bounds: device_s is an upper bound on device
+        busy time and hbm_passes a floor on traffic.  Returns
+        (achieved_msamps, roofline_frac) for the journal span (None
+        when the active processor has no plan model — duck-typed
+        stubs)."""
+        metrics.histogram("device_seconds").observe(device_s)
+        if self._stream_labels is not None:
+            metrics.histogram(
+                "device_seconds",
+                labels=self._stream_labels).observe(device_s)
+        proc = self.processor
+        passes = getattr(proc, "hbm_passes", None)
+        n_spec = getattr(proc, "n_spectrum", None)
+        if passes is None or n_spec is None or device_s <= 0:
+            return None, None
+        seg_bytes = getattr(proc, "_segment_bytes",
+                            self.cfg.segment_bytes(1))
+        model_bytes = seg_bytes + 8.0 * n_spec * passes
+        gbps = model_bytes / device_s / 1e9
+        msamps = n_samples / device_s / 1e6
+        peak = float(getattr(self.cfg, "hbm_peak_gbps", 819.0) or 819.0)
+        frac = gbps / peak
+        for name, val in (("achieved_msamps", msamps),
+                          ("achieved_gbps", gbps),
+                          ("roofline_frac", frac)):
+            metrics.set(name, val)
+            if self._stream_labels is not None:
+                metrics.set(name, val, labels=self._stream_labels)
+        return msamps, frac
+
     def _record_segment(self, index: int, seg, det_res, positive: bool,
                         span: dict, queue_depth: int,
                         n_samples: int,
                         overlap_hidden_s: float | None = None,
-                        inflight_depth: int | None = None) -> None:
+                        inflight_depth: int | None = None,
+                        device_s: float | None = None) -> None:
         """Per-drained-segment telemetry: lifetime counters, sliding
         window rates (segments/s and samples/s over the last 10 s — a
         stall is visible immediately, unlike the lifetime average), the
-        /healthz liveness stamp, and one journal span record."""
+        /healthz liveness stamp, device-time/roofline accounting, and
+        one journal span record."""
         metrics.add("segments")
         metrics.add("samples", n_samples)
         if positive:
@@ -495,6 +550,16 @@ class Pipeline:
             metrics.add("samples", n_samples,
                         labels=self._stream_labels)
         telemetry.mark_segment(self.stream or None)
+        msamps = frac = None
+        if device_s is not None:
+            msamps, frac = self._device_time_account(device_s,
+                                                     n_samples)
+        if self.profile_capture is not None:
+            # counts drained segments and auto-stops after N; the
+            # sidecar records the covered trace_ids so the device
+            # trace joins the causal-event timeline
+            self.profile_capture.note_segment(
+                index, getattr(seg, "trace_id", 0))
         if self.slo is not None:
             # the latency objective scores the segment's HOST wall
             # clock (the span's summed stages — what the journal's
@@ -520,7 +585,10 @@ class Pipeline:
                 inflight_depth=inflight_depth,
                 active_plan=getattr(self.processor, "plan_name", None),
                 stream=self.stream or None,
-                trace_id=getattr(seg, "trace_id", 0) or None))
+                trace_id=getattr(seg, "trace_id", 0) or None,
+                device_s=device_s,
+                achieved_msamps=msamps,
+                roofline_frac=frac))
 
     # ---------------------------------------------- async segment engine
 
@@ -884,14 +952,22 @@ class Pipeline:
         self.stage_timer.record("overlap", hidden)
         seg, wf, det_res, offset_after, span = self._fetch_device(
             (seg, wf, det_res, offset_after, span), index)
+        # device-time accounting (always-on): dispatch-return ->
+        # fetch-complete wall for THIS segment.  The blocking fetch
+        # proves device completion, so this is an UPPER bound on the
+        # segment's device busy time — exact in serial mode, inflated
+        # by drain-queue wait when the window runs deep — which makes
+        # every gauge derived from it (achieved Msamp/s, roofline
+        # fraction) an honest LOWER bound.
+        device_s = max(0.0, time.perf_counter() - t_dispatched)
         # the dispatch-order index rides along so the sink-side fault
         # sites (sink_write, checkpoint) address segments in the SAME
         # index space as ingest/h2d/dispatch/fetch — the drain counter
         # starts at the checkpoint on resume and skips shed segments,
         # so one fault_plan index would otherwise mean different
         # segments at different sites
-        return (seg, wf, det_res, offset_after, span, hidden, depth,
-                live_depth, index)
+        return (seg, wf, det_res, offset_after, span, hidden, device_s,
+                depth, live_depth, index)
 
     def _drain_body(self, item: tuple, drained: list) -> None:
         """Sink-side half of one segment: detection gate, sink pushes,
@@ -899,8 +975,8 @@ class Pipeline:
         sink pipe thread in overlapped mode (off the dispatch critical
         path), inline in serial mode."""
         cfg = self.cfg
-        (seg, wf, det_res, offset_after, span, hidden, depth, live,
-         index, degrade_level, sinks_done) = item
+        (seg, wf, det_res, offset_after, span, hidden, device_s, depth,
+         live, index, degrade_level, sinks_done) = item
         if self.events is not None:
             # bind the causal context on the SINK thread: manifest
             # intent/commit/done records and sink-side retries emitted
@@ -989,7 +1065,8 @@ class Pipeline:
                              span, queue_depth=depth,
                              n_samples=cfg.baseband_input_count,
                              overlap_hidden_s=hidden,
-                             inflight_depth=live)
+                             inflight_depth=live,
+                             device_s=device_s)
         if self.checkpoint is not None:
             # a checkpointed segment must be durable: flush queued
             # async candidate writes before recording it as done.
@@ -1036,6 +1113,10 @@ class Pipeline:
                 "micro_batch_segments > 1 requires the fused plan "
                 "(staged segments are already dispatch-amortized)")
         start = time.perf_counter()
+        if self.profile_capture is not None:
+            # arm the on-demand XLA trace BEFORE the first dispatch so
+            # the capture covers compile + the first N segments
+            self.profile_capture.start()
         n_samples_per_seg = cfg.baseband_input_count
         drained = [self.checkpoint.segments_done if self.checkpoint else 0]
         # ring carry starts cold every run: a checkpoint-resumed (or
@@ -1730,6 +1811,10 @@ class Pipeline:
             # drop the carry's device buffer at run end (a retained
             # reserved-tail array would pin HBM between runs)
             self._ring_invalidate()
+            if self.profile_capture is not None:
+                # a run shorter than N segments (or one that raised)
+                # still flushes a valid trace + sidecar
+                self.profile_capture.stop()
         if sink_pipe is not None and sink_pipe.exception is not None:
             raise sink_pipe.exception
         if sink_wedged:
@@ -1743,9 +1828,19 @@ class Pipeline:
             self._drain_sinks()
         self.stats.elapsed_s = time.perf_counter() - start
         self.stats.extras["stages"] = self.stage_timer.summary()
+        self._perf_ledger_record()
         log.info(f"[pipeline] {self.stats.segments} segments, "
                  f"{self.stats.msamples_per_sec:.1f} Msamples/s")
         return self.stats
+
+    def _perf_ledger_record(self) -> None:
+        """One "steady" perf-ledger record per finished run
+        (Config.perf_ledger_path; off by default) — steady-state runs
+        feed the same queryable trajectory bench rounds do."""
+        if getattr(self.cfg, "perf_ledger_path", ""):
+            from srtb_tpu.utils import perf_ledger as PL
+            PL.record_steady_state(self.cfg, self.stats,
+                                   self.processor)
 
     def _sanitize_check(self, wf, det_res) -> None:
         """Per-segment sanitizer checks at the drain boundary: NaN/Inf
@@ -1912,6 +2007,10 @@ class Pipeline:
         nothing — but explicit close gives deterministic shutdown.
         After a bounded shutdown gave up on a wedged sink, the pool is
         abandoned instead of drained (same bounded-exit contract)."""
+        if self.profile_capture is not None:
+            # idempotent: a crashed threaded run may not have reached
+            # its engine-side stop
+            self.profile_capture.stop()
         if self._owned_writer_pool is not None:
             self._owned_writer_pool.close(drain=not self._sink_wedged)
             self._owned_writer_pool = None
@@ -2061,6 +2160,8 @@ class ThreadedPipeline(Pipeline):
 
         cfg = self.cfg
         start_t = time.perf_counter()
+        if self.profile_capture is not None:
+            self.profile_capture.start()
         it = iter(self.source)
         count = [0]
         drained = [self.checkpoint.segments_done if self.checkpoint else 0]
@@ -2243,8 +2344,11 @@ class ThreadedPipeline(Pipeline):
                 raise p.exception
         if not self._sink_wedged:
             self._drain_sinks()
+        if self.profile_capture is not None:
+            self.profile_capture.stop()
         self.stats.elapsed_s = time.perf_counter() - start_t
         self.stats.extras["stages"] = self.stage_timer.summary()
+        self._perf_ledger_record()
         log.info(f"[pipeline threaded] {self.stats.segments} segments, "
                  f"{self.stats.msamples_per_sec:.1f} Msamples/s")
         return self.stats

@@ -20,6 +20,7 @@ Everything is batched over data streams (polarizations): shape [S, ...].
 from __future__ import annotations
 
 import os
+import time
 
 import jax
 import jax.numpy as jnp
@@ -374,6 +375,17 @@ class SegmentProcessor:
         self._staging_out: "dict[int, tuple]" = {}
         self._staging_cap = 2 * max(
             1, int(getattr(cfg, "inflight_segments", 2) or 1)) + 4
+        # performance-observatory compile accounting (always-on): the
+        # lazy-jit protocol traces+compiles inside the FIRST dispatch
+        # of each program, so that call's wall clock is the live
+        # compile measurement (an upper bound — it includes the first
+        # execution's dispatch; the AOT protocol measures exactly in
+        # aot_cache.get_or_compile instead).  Per-stream labeled twins
+        # when this processor serves a named fleet lane.
+        self._dispatched_programs: set[str] = set()
+        self._metric_labels = ({"stream": cfg.stream_name}
+                               if getattr(cfg, "stream_name", "")
+                               else None)
         self.aot_active = False
         if cfg.aot_plan_path:
             if not self.enable_aot(cfg.aot_plan_path):
@@ -1122,7 +1134,8 @@ class SegmentProcessor:
         wrappers stay in place and behavior is unchanged."""
         from srtb_tpu.utils.aot_cache import AotPlanCache
 
-        cache = AotPlanCache(path, allow_cpu=allow_cpu)
+        cache = AotPlanCache(path, allow_cpu=allow_cpu,
+                             labels=self._metric_labels)
         if not cache.enabled():
             return False
         sig = self.plan_signature()
@@ -1309,7 +1322,9 @@ class SegmentProcessor:
         if raw.ndim != 2 or raw.shape[1] != expected:
             raise ValueError(
                 f"batch must be [B, {expected}] bytes, got {raw.shape}")
-        out = self._batch_jit()(raw, self.chirp, self.chirp_w)
+        out = self._timed_first(
+            "batch",
+            lambda: self._batch_jit()(raw, self.chirp, self.chirp_w))
         if self._sanitize and self._donate_input:
             from srtb_tpu.analysis import sanitizer as S
             # the sanitizer is the sanctioned holder of the donated
@@ -1332,6 +1347,38 @@ class SegmentProcessor:
                 f"segment must be {expected} bytes, got {raw.shape}")
         return self.run_device(raw)
 
+    def _timed_first(self, name: str, fn):
+        """Dispatch ``fn`` with first-call compile accounting: the
+        first dispatch of program family ``name`` on this processor is
+        where lazy jit traces+compiles, so its wall clock feeds the
+        ``compile_seconds`` / ``plan_compiles`` / ``last_compile_ms``
+        metrics (per-stream twins when labeled).  An AOT-active
+        processor compiled in ``enable_aot`` (counted exactly there by
+        the cache), so its first dispatch is marked but not counted.
+        Steady-state dispatches pay one set-membership check."""
+        if name in self._dispatched_programs:
+            return fn()
+        if self.aot_active:
+            self._dispatched_programs.add(name)
+            return fn()
+        from srtb_tpu.utils.metrics import metrics
+        t0 = time.perf_counter()
+        out = fn()
+        dt = time.perf_counter() - t0
+        # marked only AFTER fn() returned: a transient failure inside
+        # the first dispatch leaves the family unmarked, so the retry
+        # (where the trace+compile actually completes) is the timed
+        # compile event instead of slipping past the books
+        self._dispatched_programs.add(name)
+        metrics.add("plan_compiles")
+        metrics.add("compile_seconds", dt)
+        metrics.set("last_compile_ms", dt * 1e3)
+        if self._metric_labels is not None:
+            metrics.add("plan_compiles", labels=self._metric_labels)
+            metrics.add("compile_seconds", dt,
+                        labels=self._metric_labels)
+        return out
+
     def run_device(self, raw: jnp.ndarray):
         """Run one segment on an already-device-resident byte array,
         dispatching between the fused and staged execution plans.
@@ -1343,7 +1390,10 @@ class SegmentProcessor:
         no-op and the bug would otherwise only corrupt on the TPU).
         This serializes dispatch — sanitize is a debugging mode."""
         if not self.staged:
-            out = self._jit_process(raw, self.chirp, self.chirp_w)
+            out = self._timed_first(
+                "fused",
+                lambda: self._jit_process(raw, self.chirp,
+                                          self.chirp_w))
             if self._sanitize and self._donate_input:
                 from srtb_tpu.analysis import sanitizer as S
                 # sanctioned holder: expiry deletes the donated
@@ -1351,12 +1401,29 @@ class SegmentProcessor:
                 S.expire_donated(raw, out)
             return out
         if not self._sanitize:
-            return self._jit_stage_c(
-                self._jit_stage_b(self._jit_stage_a(raw)))
-        # the sanitizer is the sanctioned holder of the donated input
-        # (it expires it)  # srtb-lint: disable=use-after-donate
-        a = self._staged_a_checks(self._jit_stage_a(raw), raw)
-        return self._staged_tail(a)
+            def _run_staged():
+                # the fused branch above returned, so its donation
+                # can never reach this chain's read
+                a = self._jit_stage_a(
+                    raw)  # srtb-lint: disable=use-after-donate
+                return self._jit_stage_c(self._jit_stage_b(a))
+
+            return self._timed_first("staged", _run_staged)
+
+        def _run_checked():
+            # the sanitizer is the sanctioned holder of the donated
+            # input (it expires it); the fused branch above returned,
+            # so its lambda-wrapped donation never reaches this read
+            a = self._staged_a_checks(
+                self._jit_stage_a(raw),
+                raw)  # srtb-lint: disable=use-after-donate
+            return self._staged_tail(a)
+
+        # the WHOLE three-stage chain under one first-dispatch timer:
+        # stage_b/stage_c compile on the first call too, and counting
+        # only stage_a would report a third of the staged plan's cost
+        # (the fused branch times its entire program — uniform books)
+        return self._timed_first("staged", _run_checked)
 
     def _staged_a_checks(self, a, consumed, donated: bool | None = None):
         """Sanitizer hooks at the stage (a) boundary: contract + NaN
@@ -1400,19 +1467,26 @@ class SegmentProcessor:
             raise ValueError("ingest ring disabled for this plan "
                              "(Config.ingest_ring / no reserved tail)")
         if self.staged:
-            a, next_carry = self._jit_stage_a_ring(carry, new)
-            if not self._sanitize:
-                out = self._jit_stage_c(self._jit_stage_b(a))
-            else:
+            def _run_ring():
+                # whole chain under one timer (see run_device): the
+                # b/c stages compile on first dispatch too
+                a, nc = self._jit_stage_a_ring(carry, new)
+                if not self._sanitize:
+                    return self._jit_stage_c(self._jit_stage_b(a)), nc
                 # sanctioned holder: _staged_a_checks expires the
                 # carry, which is donated UNCONDITIONALLY (unlike the
                 # raw input)
-                out = self._staged_tail(self._staged_a_checks(
+                return self._staged_tail(self._staged_a_checks(
                     a, carry,  # srtb-lint: disable=use-after-donate
-                    donated=True))
+                    donated=True)), nc
+
+            out, next_carry = self._timed_first("staged_ring",
+                                                _run_ring)
         else:
-            out, next_carry = self._jit_ring(carry, new, self.chirp,
-                                             self.chirp_w)
+            out, next_carry = self._timed_first(
+                "ring",
+                lambda: self._jit_ring(carry, new, self.chirp,
+                                       self.chirp_w))
             if self._sanitize:
                 from srtb_tpu.analysis import sanitizer as S
                 # sanctioned holder: the donated carry is expired
@@ -1431,16 +1505,23 @@ class SegmentProcessor:
             raise ValueError("ingest ring disabled for this plan "
                              "(Config.ingest_ring / no reserved tail)")
         if self.staged:
-            a, next_carry = self._jit_stage_a_cold(raw)
-            if not self._sanitize:
-                out = self._jit_stage_c(self._jit_stage_b(a))
-            else:
+            def _run_cold():
+                # whole chain under one timer (see run_device)
+                a, nc = self._jit_stage_a_cold(raw)
+                if not self._sanitize:
+                    return self._jit_stage_c(self._jit_stage_b(a)), nc
                 # sanctioned holder: _staged_a_checks expires the
-                # donated input  # srtb-lint: disable=use-after-donate
-                out = self._staged_tail(self._staged_a_checks(a, raw))
+                # donated input
+                return self._staged_tail(self._staged_a_checks(
+                    a,
+                    raw)), nc  # srtb-lint: disable=use-after-donate
+
+            out, next_carry = self._timed_first("staged_ring_cold",
+                                                _run_cold)
         else:
-            out, next_carry = self._jit_cold(raw, self.chirp,
-                                             self.chirp_w)
+            out, next_carry = self._timed_first(
+                "ring_cold",
+                lambda: self._jit_cold(raw, self.chirp, self.chirp_w))
             if self._sanitize and self._donate_input:
                 from srtb_tpu.analysis import sanitizer as S
                 # sanctioned holder  # srtb-lint: disable=use-after-donate
@@ -1481,8 +1562,10 @@ class SegmentProcessor:
                              "(Config.ingest_ring / no reserved tail)")
         news = self._as_device_bytes(news)
         self._check_batch(news, self.stride_bytes)
-        out, next_carry = self._batch_ring_jit()(carry, news, self.chirp,
-                                                 self.chirp_w)
+        out, next_carry = self._timed_first(
+            "batch_ring",
+            lambda: self._batch_ring_jit()(carry, news, self.chirp,
+                                           self.chirp_w))
         if self._sanitize:
             from srtb_tpu.analysis import sanitizer as S
             # sanctioned holder  # srtb-lint: disable=use-after-donate
@@ -1499,8 +1582,10 @@ class SegmentProcessor:
         metrics.add("ring_cold_dispatches")  # one per full-batch upload
         raws = self._as_device_bytes(raws)
         self._check_batch(raws, self._segment_bytes)
-        out, next_carry = self._batch_cold_jit()(raws, self.chirp,
-                                                 self.chirp_w)
+        out, next_carry = self._timed_first(
+            "batch_cold",
+            lambda: self._batch_cold_jit()(raws, self.chirp,
+                                           self.chirp_w))
         if self._sanitize and self._donate_input:
             from srtb_tpu.analysis import sanitizer as S
             # sanctioned holder  # srtb-lint: disable=use-after-donate

@@ -16,7 +16,7 @@ the golden reference — and then (2) together through a
   (drained + dropped == source segments, nothing vanishes), its
   detection DECISIONS match its solo run exactly (recovery may change
   the plan, never the science), and the demotions/sheds are
-  attributed to the victim's stream id in the v7 journal (healthy
+  attributed to the victim's stream id in the v8 journal (healthy
   journals carry zero);
 - **(c) shared plan economy**: the fleet's plan cache records exactly
   ONE compile for the shared plan family across all streams
@@ -252,9 +252,9 @@ def run_soak(streams: int = 3, segments: int = 5, log2n: int = 13,
     for name in names:
         recs = [json.loads(line) for line in open(jpaths[name])
                 if line.strip().startswith("{")]
-        check(recs and all(r.get("stream") == name and r["v"] == 7
+        check(recs and all(r.get("stream") == name and r["v"] == 8
                            for r in recs),
-              f"stream {name}: v7 journal records not stream-stamped")
+              f"stream {name}: v8 journal records not stream-stamped")
         total_demote = int(recs[-1].get("plan_demotions", 0))
         if name in victims:
             check(total_demote == n_demote,

@@ -1,0 +1,126 @@
+"""Perf-ledger CLI: inspect the ledger and backfill legacy history.
+
+``--import`` ingests the driver-captured legacy artifacts
+(``BENCH_r0*.json`` — one file per bench round, a JSON object whose
+``parsed`` field holds bench.py's emitted line) into the append-only
+ledger (utils/perf_ledger.py), so the perf trajectory starts populated
+instead of empty.  Idempotent: every imported record carries an
+``import_key`` (file basename + round) and re-runs skip keys already
+present.  Rounds that died before emitting a metric line (rc != 0, no
+``parsed``) are recorded as value-0 failure records — the trajectory
+must show the outage rounds, not silently skip them.
+
+Usage:
+  python -m srtb_tpu.tools.perf_ledger LEDGER.jsonl            # summary
+  python -m srtb_tpu.tools.perf_ledger LEDGER.jsonl --import BENCH_r0*.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import sys
+
+from srtb_tpu.utils import perf_ledger as PL
+
+
+def _import_one(path: str, seen: set) -> dict | None:
+    """One legacy artifact -> one ledger record (or None when its
+    import_key is already in the ledger / the file is not a legacy
+    round artifact)."""
+    base = os.path.basename(path)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or "rc" not in doc:
+        return None
+    key = f"{base}#n{doc.get('n', 0)}"
+    if key in seen:
+        return None
+    parsed = doc.get("parsed") or {}
+    # file mtime orders the trajectory when the artifact itself has no
+    # timestamp (the legacy rounds don't)
+    try:
+        ts = os.path.getmtime(path)
+    except OSError:
+        ts = None
+    extra = {"import_key": key, "rc": int(doc.get("rc", -1))}
+    # provenance note: the legacy artifact does not record which host/
+    # commit produced it — stamping the IMPORTER's identity would
+    # fabricate comparability the gate's calibration logic then
+    # trusts, so both records pass explicit blank provenance
+    if parsed.get("value") is not None:
+        shape = {"log2n": int(parsed.get("log2n", 0) or 0)}
+        for k in ("compile_s", "segment_time_s", "achieved_gbps",
+                  "model_hbm_gb", "roofline_frac", "vs_baseline",
+                  "overlap", "hbm_passes", "fused_tail", "ring"):
+            if k in parsed:
+                extra[k] = parsed[k]
+        return PL.make_record(
+            "import", float(parsed["value"]),
+            str(parsed.get("unit", "Msamples/s/chip")),
+            plan=str(parsed.get("plan", "")),
+            shape=shape, platform=str(parsed.get("platform", "")),
+            extra=extra, ts=ts, host_fp="", git_sha_value="")
+    # failed round: value 0, the error preserved (truncated) — the
+    # trajectory must show the outage, not skip it
+    err = parsed.get("error") or (doc.get("tail") or "")[-200:]
+    extra["error"] = str(err)[:300]
+    return PL.make_record("import", 0.0, "Msamples/s/chip",
+                          extra=extra, ts=ts, host_fp="",
+                          git_sha_value="")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("ledger", help="ledger JSONL path")
+    p.add_argument("--import", dest="imports", nargs="+", default=None,
+                   metavar="GLOB",
+                   help="legacy BENCH_r0*.json files/globs to ingest")
+    args = p.parse_args(argv)
+
+    ledger = PL.PerfLedger(args.ledger)
+    if args.imports:
+        existing = ledger.load()
+        seen = PL.import_keys(existing)
+        paths = []
+        for pat in args.imports:
+            hits = sorted(glob.glob(pat))
+            if not hits and os.path.exists(pat):
+                hits = [pat]
+            paths.extend(hits)
+        imported = skipped = 0
+        for path in paths:
+            rec = _import_one(path, seen)
+            if rec is None:
+                skipped += 1
+                continue
+            ledger.append(rec)
+            seen.add(rec["extra"]["import_key"])
+            imported += 1
+        print(json.dumps({"imported": imported, "skipped": skipped,
+                          "ledger": args.ledger}))
+        return 0 if imported or skipped else 1
+
+    records = ledger.load()
+    ok = [r for r in records if r["value"] > 0]
+    out = {"ledger": args.ledger, "records": len(records),
+           "measured": len(ok),
+           "sources": sorted({r["source"] for r in records})}
+    if ok:
+        vals = [r["value"] for r in ok]
+        out["best"] = max(vals)
+        out["latest"] = ok[-1]["value"]
+        out["geomean"] = round(
+            math.exp(sum(math.log(v) for v in vals) / len(vals)), 3)
+    print(json.dumps(out, sort_keys=True))
+    return 0 if records else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

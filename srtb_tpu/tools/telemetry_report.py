@@ -30,8 +30,14 @@ pipeline writes (one record per segment) and reports
   attribution fields are the stream's own labeled series, so each
   tenant's books balance independently); feed it one lane's journal
   or several lanes' merged.
+- device (schema-v8 spans): the performance observatory's device-time
+  accounting — per-segment dispatch->ready wall percentiles,
+  device-time-derived Msamples/s and roofline_frac (lower bounds: the
+  traffic model is the plan's audited hbm_passes floor over an
+  upper-bound device wall), and the cumulative compile / plan-cache /
+  AOT-cache totals.
 
-Mixed v1-v6 journals (rotation can leave an older-schema tail
+Mixed v1-v8 journals (rotation can leave an older-schema tail
 after an upgrade) are summarized tolerantly: records simply lack the
 newer fields and drop out of the sections that need them.
 
@@ -376,6 +382,47 @@ def fleet_stats(records: list[dict]) -> dict:
     return out
 
 
+def device_stats(records: list[dict]) -> dict:
+    """Device-time accounting from v8 spans (performance
+    observatory).  ``device_ms`` is per-segment (an upper bound on
+    device busy time — dispatch->drain-head-ready wall), the
+    roofline/throughput fields are per-segment lower bounds, and the
+    compile/cache counters are cumulative (last record = run totals).
+    Older records (no device fields) are skipped; empty dict when
+    none qualify."""
+    v8 = [r for r in records if "device_ms" in r
+          or "compile_ms" in r]
+    if not v8:
+        return {}
+    dev = sorted(float(r["device_ms"]) for r in v8
+                 if "device_ms" in r)
+    fracs = [float(r["roofline_frac"]) for r in v8
+             if "roofline_frac" in r]
+    msamps = [float(r["achieved_msamps"]) for r in v8
+              if "achieved_msamps" in r]
+    last = v8[-1]
+    out = {"records": len(v8)}
+    if dev:
+        out.update(
+            device_p50_ms=round(_percentile(dev, 0.50), 3),
+            device_p95_ms=round(_percentile(dev, 0.95), 3),
+            device_max_ms=round(dev[-1], 3),
+            device_total_s=round(sum(dev) / 1e3, 3))
+    if msamps:
+        out["achieved_msamps_median"] = round(
+            _percentile(sorted(msamps), 0.50), 2)
+    if fracs:
+        out["roofline_frac_median"] = round(
+            _percentile(sorted(fracs), 0.50), 4)
+        out["roofline_frac_max"] = round(max(fracs), 4)
+    out.update(
+        compile_ms=float(last.get("compile_ms", 0.0)),
+        plan_compiles=int(last.get("plan_compiles", 0)),
+        aot_cache_hits=int(last.get("aot_cache_hits", 0)),
+        aot_cache_misses=int(last.get("aot_cache_misses", 0)))
+    return out
+
+
 def report(path: str, bin_s: float = 10.0) -> dict:
     records = load(path)
     return {
@@ -387,6 +434,7 @@ def report(path: str, bin_s: float = 10.0) -> dict:
         "compute": compute_stats(records),
         "durability": durability_stats(records),
         "fleet": fleet_stats(records),
+        "device": device_stats(records),
         "timeline": timeline(records, bin_s),
     }
 
@@ -458,6 +506,28 @@ def _md(rep: dict) -> str:
                 f"{st['plan_demotions']} | {st['device_reinits']} | "
                 f"{st['degrade_level_max']} | "
                 f"{st['plan_ladder_level_last']} |")
+    dv = rep.get("device") or {}
+    if dv:
+        lines += ["", "## Device time (performance observatory)", ""]
+        if "device_p50_ms" in dv:
+            lines.append(
+                f"dispatch->ready wall: p50 {dv['device_p50_ms']} ms, "
+                f"p95 {dv['device_p95_ms']} ms, max "
+                f"{dv['device_max_ms']} ms "
+                f"(total {dv['device_total_s']} s; upper bound)")
+        if "roofline_frac_median" in dv:
+            lines.append(
+                f"roofline_frac: median {dv['roofline_frac_median']}, "
+                f"max {dv['roofline_frac_max']} (lower bound vs the "
+                "plan's audited hbm_passes floor)"
+                + (f"; achieved {dv['achieved_msamps_median']} "
+                   "Msamples/s median"
+                   if "achieved_msamps_median" in dv else ""))
+        lines.append(
+            f"compile: {dv['compile_ms']} ms cumulative over "
+            f"{dv['plan_compiles']} first-dispatch compile(s); AOT "
+            f"cache {dv['aot_cache_hits']} hit(s) / "
+            f"{dv['aot_cache_misses']} miss(es)")
     lines += ["", "## Throughput timeline", "",
               "| t (s) | segments | seg/s | Msamples/s | detections | "
               "dumps | pkts lost |", "|---|---|---|---|---|---|---|"]

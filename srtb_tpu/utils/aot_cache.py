@@ -54,10 +54,21 @@ class AotPlanCache:
     """Directory of serialized compiled executables, one file per
     (program name, plan signature, device key)."""
 
-    def __init__(self, root: str, allow_cpu: bool = False):
+    def __init__(self, root: str, allow_cpu: bool = False,
+                 labels: dict | None = None):
         self.root = root
         self.allow_cpu = allow_cpu or cpu_allowed()
+        # per-stream labeled twins for the hit/miss/compile counters
+        # (multi-tenant fleet: cache economics must be attributable
+        # to the tenant that paid the compile)
+        self.labels = dict(labels) if labels else None
         os.makedirs(root, exist_ok=True)
+
+    def _count(self, name: str, value: float = 1.0) -> None:
+        from srtb_tpu.utils.metrics import metrics
+        metrics.add(name, value)
+        if self.labels:
+            metrics.add(name, value, labels=self.labels)
 
     def enabled(self) -> bool:
         import jax
@@ -101,6 +112,7 @@ class AotPlanCache:
             except TypeError:
                 compiled = deserialize_and_load(blob, in_tree, out_tree)
             log.info(f"[aot_cache] loaded {name} from {path}")
+            self._count("aot_cache_hits")
             return compiled
         except Exception as e:  # corrupt blob / jax drift: recompile
             log.warning(f"[aot_cache] load failed for {name}: {e}; "
@@ -132,6 +144,18 @@ class AotPlanCache:
         (jax.ShapeDtypeStruct works)."""
         compiled = self.load(name, signature)
         if compiled is None:
+            # AOT-protocol compile accounting: unlike the lazy-jit
+            # first-dispatch timer (pipeline/segment.py), this measures
+            # the compile EXACTLY — lower+compile with no execution in
+            # the window
+            import time
+            t0 = time.perf_counter()
             compiled = jitted.lower(*example).compile()
+            dt = time.perf_counter() - t0
+            self._count("aot_cache_misses")
+            self._count("plan_compiles")
+            self._count("compile_seconds", dt)
+            from srtb_tpu.utils.metrics import metrics
+            metrics.set("last_compile_ms", dt * 1e3)
             self.save(name, signature, compiled)
         return compiled

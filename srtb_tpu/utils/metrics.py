@@ -352,6 +352,24 @@ class Metrics:
         "fleet_shed_streams": "Streams currently force-shed",
         "fleet_streams_total": "Streams submitted to the fleet",
         "stage_seconds": "Per-stage host wall clock (seconds)",
+        "device_seconds": "Per-segment dispatch-to-ready device wall "
+                          "(upper bound)",
+        "achieved_msamps": "Last segment device-time Msamples/s "
+                           "(lower bound)",
+        "achieved_gbps": "Last segment modeled HBM GB/s over device "
+                         "time (lower bound)",
+        "roofline_frac": "Last segment achieved_gbps over the "
+                         "configured HBM peak (lower bound)",
+        "compile_seconds": "Cumulative trace+compile wall "
+                           "(first-dispatch upper bound + AOT-miss "
+                           "compiles)",
+        "last_compile_ms": "Most recent trace+compile event "
+                           "(milliseconds)",
+        "plan_compiles": "First-dispatch trace+compile events",
+        "aot_cache_hits": "AOT executable cache loads (no compile)",
+        "aot_cache_misses": "AOT executable cache misses (compiled + "
+                            "persisted)",
+        "profile_captures": "On-demand jax.profiler captures written",
         "last_segment_monotonic": "Monotonic stamp of the last "
                                   "drained segment",
         "last_segment_unix": "Wall-clock stamp of the last drained "

@@ -1,0 +1,181 @@
+"""Noise-aware perf statistics: the math behind tools/perf_gate.py.
+
+PERF.md's methodology was "paired A/B, call anything within ±4% the
+CPU noise floor" — an eyeballed constant.  This module computes the
+floor from the samples instead and makes the regression verdict a
+statistical test over *per-rep samples*, not a comparison of two
+single numbers:
+
+- :func:`mann_whitney_u` — exact-tie-corrected normal-approximation
+  Mann-Whitney U (two-sided): "do these two sample sets come from the
+  same distribution at all?"  Rank-based, so one GC pause outlier
+  cannot manufacture (or hide) a verdict the way it moves a mean.
+- :func:`bootstrap_effect_ci` — percentile-bootstrap confidence
+  interval of the relative median effect (median_b / median_a - 1,
+  positive = B slower), deterministic (seeded) so CI reruns agree.
+- :func:`noise_floor` — the computed replacement for the hand-written
+  ±4%: the 95% standard error of the median-ratio under the observed
+  robust scatter (MAD-based sigma, immune to a single outlier rep).
+  With ~4%-sigma samples and n=9 reps this lands near the historical
+  4% — the constant was an okay eyeball; now it is derived.
+- :func:`compare` — the gate verdict: REGRESSION only when the
+  distributions differ (Mann-Whitney p < alpha), the bootstrap CI
+  excludes zero, AND the effect exceeds max(noise_floor, min_effect).
+
+Samples are *seconds per rep* (smaller = faster) everywhere: a
+positive effect means B is slower than A.
+
+No scipy/numpy dependency beyond numpy (already required): the gate
+must run in the same minimal environment as ci.sh.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+# two-sided 95% z quantile, used by the normal-approx U test and the
+# noise-floor standard error
+_Z975 = 1.959963984540054
+
+
+def _median(x: np.ndarray) -> float:
+    return float(np.median(x))
+
+
+def robust_rel_sigma(samples) -> float:
+    """Robust relative scatter of one sample set: 1.4826 * MAD /
+    median (the MAD-consistent sigma estimate for a normal core,
+    insensitive to a single pathological rep).  0.0 for degenerate
+    inputs (n < 2 or zero median)."""
+    x = np.asarray(samples, dtype=float)
+    if x.size < 2:
+        return 0.0
+    med = _median(x)
+    if med == 0:
+        return 0.0
+    mad = _median(np.abs(x - med))
+    return float(1.4826 * mad / abs(med))
+
+
+def noise_floor(a, b, z: float = _Z975) -> float:
+    """The computed noise floor for the relative median effect of B
+    vs A: ``z * sqrt(rsem_a^2 + rsem_b^2)`` where ``rsem`` is each
+    set's robust relative standard error of the median
+    (``1.2533 * rel_sigma / sqrt(n)`` — the asymptotic median
+    efficiency factor sqrt(pi/2)).  An effect smaller than this is
+    indistinguishable from sampling noise at the ~95% level, whatever
+    PERF.md's historical ±4% said."""
+    def rsem(x):
+        x = np.asarray(x, dtype=float)
+        if x.size < 2:
+            return 0.0
+        return 1.2533141373155003 * robust_rel_sigma(x) / math.sqrt(x.size)
+
+    return float(z * math.hypot(rsem(a), rsem(b)))
+
+
+def mann_whitney_u(a, b) -> tuple[float, float]:
+    """Two-sided Mann-Whitney U via the tie-corrected normal
+    approximation.  Returns ``(u, p)`` with ``u`` the statistic for
+    sample A.  For the gate's rep counts (>= ~8 per side) the normal
+    approximation is accurate to well under the alpha it is compared
+    against; tiny inputs degrade gracefully (p = 1.0 when a verdict
+    is impossible)."""
+    a = np.asarray(a, dtype=float)
+    b = np.asarray(b, dtype=float)
+    n1, n2 = a.size, b.size
+    if n1 == 0 or n2 == 0:
+        return 0.0, 1.0
+    both = np.concatenate([a, b])
+    order = np.argsort(both, kind="mergesort")
+    ranks = np.empty(both.size, dtype=float)
+    ranks[order] = np.arange(1, both.size + 1, dtype=float)
+    # midranks for ties (and the tie correction below)
+    vals, inv, counts = np.unique(both, return_inverse=True,
+                                  return_counts=True)
+    if vals.size != both.size:
+        cum = np.cumsum(counts)
+        start = cum - counts
+        mid = (start + 1 + cum) / 2.0
+        ranks = mid[inv]
+    r1 = float(ranks[:n1].sum())
+    u1 = r1 - n1 * (n1 + 1) / 2.0
+    mu = n1 * n2 / 2.0
+    n = n1 + n2
+    tie_term = float(((counts ** 3 - counts).sum())) / (n * (n - 1)) \
+        if n > 1 else 0.0
+    var = n1 * n2 / 12.0 * ((n + 1) - tie_term)
+    if var <= 0:
+        return u1, 1.0
+    # continuity-corrected two-sided p
+    z = (abs(u1 - mu) - 0.5) / math.sqrt(var)
+    z = max(z, 0.0)
+    p = math.erfc(z / math.sqrt(2.0))
+    return u1, min(1.0, max(0.0, p))
+
+
+def bootstrap_effect_ci(a, b, n_boot: int = 4000, seed: int = 0,
+                        alpha: float = 0.05) -> tuple[float, float]:
+    """Percentile-bootstrap CI of the relative median effect
+    ``median(b)/median(a) - 1`` (positive = B slower).  Deterministic
+    for a given seed so the gate's verdict reproduces."""
+    a = np.asarray(a, dtype=float)
+    b = np.asarray(b, dtype=float)
+    if a.size == 0 or b.size == 0:
+        return 0.0, 0.0
+    rng = np.random.default_rng(seed)
+    ia = rng.integers(0, a.size, size=(n_boot, a.size))
+    ib = rng.integers(0, b.size, size=(n_boot, b.size))
+    med_a = np.median(a[ia], axis=1)
+    med_b = np.median(b[ib], axis=1)
+    ok = med_a != 0
+    eff = np.zeros(n_boot)
+    eff[ok] = med_b[ok] / med_a[ok] - 1.0
+    lo, hi = np.quantile(eff, [alpha / 2.0, 1.0 - alpha / 2.0])
+    return float(lo), float(hi)
+
+
+def compare(a, b, alpha: float = 0.05, min_effect: float = 0.0,
+            n_boot: int = 4000, seed: int = 0) -> dict:
+    """The gate verdict for per-rep timing samples A (reference) vs B
+    (candidate), seconds per rep.  ``regression`` is True only when
+    ALL of:
+
+    - Mann-Whitney rejects "same distribution" at ``alpha``;
+    - the bootstrap CI of the median effect excludes zero from below
+      (``ci_low > 0``: B slower with ~95% confidence);
+    - the point effect exceeds ``max(noise_floor, min_effect)`` — the
+      computed floor formalizes PERF.md's hand ±4%; ``min_effect``
+      lets CI demand a materially larger slowdown (e.g. cross-host
+      calibrated comparisons, where scheduling noise dwarfs the
+      within-host floor).
+
+    ``improvement`` is the symmetric verdict (B faster).  Everything
+    that fed the decision is in the dict — the gate's JSON line is
+    auditable, not just a boolean."""
+    a = np.asarray(a, dtype=float)
+    b = np.asarray(b, dtype=float)
+    med_a = _median(a) if a.size else 0.0
+    med_b = _median(b) if b.size else 0.0
+    effect = (med_b / med_a - 1.0) if med_a else 0.0
+    u, p = mann_whitney_u(a, b)
+    ci_low, ci_high = bootstrap_effect_ci(a, b, n_boot=n_boot,
+                                          seed=seed, alpha=alpha)
+    floor = noise_floor(a, b)
+    threshold = max(floor, float(min_effect))
+    differs = p < alpha
+    return {
+        "n_a": int(a.size), "n_b": int(b.size),
+        "median_a_s": med_a, "median_b_s": med_b,
+        "effect": effect,          # + = B slower
+        "ci_low": ci_low, "ci_high": ci_high,
+        "u": u, "p": p, "alpha": alpha,
+        "noise_floor": floor, "min_effect": float(min_effect),
+        "threshold": threshold,
+        "regression": bool(differs and ci_low > 0.0
+                           and effect > threshold),
+        "improvement": bool(differs and ci_high < 0.0
+                            and -effect > threshold),
+    }

@@ -55,10 +55,21 @@ from srtb_tpu.utils.metrics import metrics
 # utils/events.py — omitted when the engine never stamped one, e.g.
 # events disabled) so a journal span and the flight recorder's events
 # for the same segment correlate exactly; an incident bundle's
-# spans_tail.jsonl joins its trace.jsonl on this field.  Readers must
-# tolerate mixed v1-v7 journals: rotation can leave an older-schema
-# tail in the previous generation after an upgrade.
-SPAN_SCHEMA_VERSION = 7
+# spans_tail.jsonl joins its trace.jsonl on this field.
+# v8 (performance observatory): adds per-segment DEVICE-time
+# accounting and live roofline fields — ``device_ms`` (dispatch-return
+# -> drain-head-ready wall clock: an upper bound on device busy time,
+# exact in serial mode; omitted when the engine did not measure it),
+# ``achieved_msamps`` / ``roofline_frac`` (this segment's throughput
+# against its plan's audited hbm_passes traffic floor and the
+# configured HBM peak — both LOWER bounds, since device_ms is an
+# upper bound) — plus the cumulative compile/cache accounting
+# ``compile_ms`` (first-dispatch trace+compile wall, plus AOT-miss
+# compiles), ``plan_compiles``, ``aot_cache_hits`` /
+# ``aot_cache_misses``.  Readers must tolerate mixed v1-v8 journals:
+# rotation can leave an older-schema tail in the previous generation
+# after an upgrade.
+SPAN_SCHEMA_VERSION = 8
 
 # gauge names shared between the pipeline (writer) and health() (reader)
 LAST_SEGMENT_MONOTONIC = "last_segment_monotonic"
@@ -242,7 +253,10 @@ def segment_span(segment: int, stages_s: dict, queue_depth: int,
                  inflight_depth: int | None = None,
                  active_plan: str | None = None,
                  stream: str | None = None,
-                 trace_id: int | None = None) -> dict:
+                 trace_id: int | None = None,
+                 device_s: float | None = None,
+                 achieved_msamps: float | None = None,
+                 roofline_frac: float | None = None) -> dict:
     """One journal record.  ``stages_s`` maps stage name -> seconds for
     THIS segment; loss/drop counters are the cumulative registry values
     at drain time (deltas between consecutive records localize a loss
@@ -300,12 +314,32 @@ def segment_span(segment: int, stages_s: dict, queue_depth: int,
         "recovered_segments": int(metrics.get("recovered_segments")),
         "replayed_skips": int(metrics.get("replayed_skips")),
         "rolled_back_intents": int(metrics.get("rolled_back_intents")),
+        # v8 compile/plan-cache accounting (cumulative at drain):
+        # compile_ms is first-dispatch trace+compile wall (an upper
+        # bound: it includes the first dispatch itself) plus exact
+        # AOT-miss compile time; the cache counters localize a
+        # mid-run recompile burst to a segment via deltas, like every
+        # other cumulative field
+        "compile_ms": round(metrics.get("compile_seconds") * 1e3, 1),
+        "plan_compiles": int(metrics.get("plan_compiles")),
+        "aot_cache_hits": int(metrics.get("aot_cache_hits")),
+        "aot_cache_misses": int(metrics.get("aot_cache_misses")),
     }
     if overlap_hidden_s is not None:
         rec["overlap_hidden_ms"] = round(
             max(overlap_hidden_s, 0.0) * 1e3, 3)
     if inflight_depth is not None:
         rec["inflight_depth"] = int(inflight_depth)
+    if device_s is not None:
+        # v8: dispatch->drain-head-ready wall for THIS segment.  NOT
+        # part of stages_ms (concurrent with, not additional to, the
+        # host stages); omitted when unmeasured (ThreadedPipeline) —
+        # never a fake 0, same rule as overlap_hidden_ms.
+        rec["device_ms"] = round(max(device_s, 0.0) * 1e3, 3)
+    if achieved_msamps is not None:
+        rec["achieved_msamps"] = round(achieved_msamps, 2)
+    if roofline_frac is not None:
+        rec["roofline_frac"] = round(roofline_frac, 4)
     if active_plan is not None:
         # the plan ACTIVE AT DRAIN TIME (like every cumulative field
         # above; in overlapped mode a demotion between this segment's
@@ -327,8 +361,15 @@ def segment_span(segment: int, stages_s: dict, queue_depth: int,
         for key in ("segments_dropped", "degrade_level",
                     "shed_waterfalls", "shed_baseband",
                     "plan_demotions", "plan_promotions",
-                    "device_reinits", "plan_ladder_level"):
+                    "device_reinits", "plan_ladder_level",
+                    # v8: compile/cache accounting is per-processor
+                    # and the processor knows its stream, so a named
+                    # span's books are the tenant's own
+                    "plan_compiles", "aot_cache_hits",
+                    "aot_cache_misses"):
             rec[key] = type(rec[key])(metrics.get(key, labels=lbl))
+        rec["compile_ms"] = round(
+            metrics.get("compile_seconds", labels=lbl) * 1e3, 1)
     if trace_id:
         # v7: joins this span to its flight-recorder events (omitted
         # when tracing is off — never a fake 0)

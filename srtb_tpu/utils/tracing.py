@@ -51,6 +51,111 @@ def device_trace(trace_dir: str):
             jax.profiler.stop_trace()
 
 
+class ProfileCapture:
+    """On-demand ``jax.profiler`` capture of the first N drained
+    segments of a run (``Config.profile_capture_segments``): a REAL
+    XLA/device trace recorded into ``Config.profile_capture_dir``,
+    next to the Perfetto event export (tools/trace_export.py), so the
+    device-level timeline and the causal-event timeline line up — the
+    sidecar ``capture.json`` records the first/last trace_id and
+    segment index covered, and the journal spans carry the same
+    trace_ids.
+
+    Lifecycle: :meth:`start` at run begin (tolerates a profiler-less
+    backend or an already-running trace — capture is best-effort
+    observability, never a run-killer), :meth:`note_segment` per
+    drained segment until N, then auto-stop; :meth:`stop` is
+    idempotent and also runs from the engine's ``finally`` so a short
+    or crashed run still flushes a valid trace."""
+
+    def __init__(self, out_dir: str, n_segments: int):
+        self.out_dir = out_dir
+        self.n_segments = int(n_segments)
+        self.active = False
+        self.first_trace_id = 0
+        self.last_trace_id = 0
+        self.first_segment = -1
+        self.last_segment = -1
+        self._seen = 0
+        self._t0 = 0.0
+
+    @classmethod
+    def from_config(cls, cfg) -> "ProfileCapture | None":
+        n = int(getattr(cfg, "profile_capture_segments", 0) or 0)
+        if n <= 0:
+            return None
+        return cls(getattr(cfg, "profile_capture_dir",
+                           "artifacts/profile") or "artifacts/profile",
+                   n)
+
+    def start(self) -> bool:
+        import os
+        try:
+            import jax
+            os.makedirs(self.out_dir, exist_ok=True)
+            jax.profiler.start_trace(self.out_dir)
+        except Exception as e:  # profiler-less backend / double start
+            log.warning(f"[tracing] profile capture unavailable: {e}")
+            return False
+        self.active = True
+        self._t0 = time.time()
+        log.info(f"[tracing] profiling first {self.n_segments} "
+                 f"segment(s) -> {self.out_dir}")
+        return True
+
+    def note_segment(self, segment: int, trace_id: int = 0) -> None:
+        """One drained segment; stops the capture once N are in."""
+        if not self.active:
+            return
+        if self._seen == 0:
+            self.first_segment = int(segment)
+            self.first_trace_id = int(trace_id)
+        self.last_segment = int(segment)
+        self.last_trace_id = int(trace_id)
+        self._seen += 1
+        if self._seen >= self.n_segments:
+            self.stop()
+
+    def stop(self) -> None:
+        if not self.active:
+            return
+        self.active = False
+        import json
+        import os
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception as e:  # pragma: no cover - backend quirk
+            log.warning(f"[tracing] profiler stop failed: {e}")
+            return
+        # the trace_id join key: device timeline <-> causal events /
+        # journal spans.  Written last so a capture.json implies a
+        # complete capture.
+        sidecar = {
+            "type": "profile_capture",
+            "dir": self.out_dir,
+            "segments": self._seen,
+            "first_segment": self.first_segment,
+            "last_segment": self.last_segment,
+            "first_trace_id": self.first_trace_id,
+            "last_trace_id": self.last_trace_id,
+            "wall_start": self._t0,
+            "wall_end": time.time(),
+        }
+        try:
+            with open(os.path.join(self.out_dir, "capture.json"),
+                      "w") as f:
+                json.dump(sidecar, f, indent=1, sort_keys=True)
+                f.write("\n")
+        except OSError as e:
+            log.warning(f"[tracing] capture sidecar failed: {e}")
+        from srtb_tpu.utils.metrics import metrics
+        metrics.add("profile_captures")
+        log.info(f"[tracing] profile capture complete: {self._seen} "
+                 f"segment(s), trace_ids {self.first_trace_id}.."
+                 f"{self.last_trace_id} -> {self.out_dir}")
+
+
 class StageTimer:
     """Accumulates wall-clock per named stage; the per-pipe-timestamp logs
     of the reference, queryable instead of grep-able.

@@ -170,9 +170,9 @@ def test_pipeline_causal_chain_across_threads(tmp_path):
         # chain crosses the thread boundary
         assert chain[3]["thread"] != chain[0]["thread"]
         assert chain[3]["thread"].startswith("sink_drain")
-    # v7 journal spans join the recorder on trace_id
+    # v8 journal spans join the recorder on trace_id
     recs = TR.load(journal)
-    assert [r["v"] for r in recs] == [7] * stats.segments
+    assert [r["v"] for r in recs] == [8] * stats.segments
     assert sorted(r["trace_id"] for r in recs) == sorted(by_trace)
     # the run-end dump landed for the exporter
     assert os.path.exists(str(tmp_path / "events.jsonl"))

@@ -270,7 +270,7 @@ def test_span_journal_roundtrip_and_rotation(tmp_path):
     recs = TR.load(path)
     assert len(recs) == 3
     r = recs[-1]
-    assert r["type"] == "segment_span" and r["v"] == 7
+    assert r["type"] == "segment_span" and r["v"] == 8
     assert r["segment"] == 2 and r["detections"] == 2 and r["dump"]
     assert r["samples"] == 1 << 16 and r["timestamp_ns"] == 123
     assert r["queue_depth"] == 1
@@ -281,7 +281,7 @@ def test_span_journal_roundtrip_and_rotation(tmp_path):
     # rotation: a tiny cap forces the previous generation out — gzip'd
     # to <path>.1.gz by default; load() reads both transparently
     small = str(tmp_path / "rot.jsonl")
-    with SpanJournal(small, max_bytes=600) as j:
+    with SpanJournal(small, max_bytes=1400) as j:
         for i in range(10):
             j.write(segment_span(i, {"sink": 0.001}, 0, 0, False, 1))
     rotated = TR.load(small)
@@ -289,14 +289,14 @@ def test_span_journal_roundtrip_and_rotation(tmp_path):
     assert not (tmp_path / "rot.jsonl.1").exists()
     # the active file never exceeds the cap; the newest spans and the
     # previous generation both survive, oldest first
-    assert (tmp_path / "rot.jsonl").stat().st_size <= 600
+    assert (tmp_path / "rot.jsonl").stat().st_size <= 1400
     segs = [r["segment"] for r in rotated]
     assert segs and segs[-1] == 9 and segs == sorted(segs)
 
     # legacy plaintext rotation still available (compress=False), and
     # the reader handles it identically
     plain = str(tmp_path / "plain.jsonl")
-    with SpanJournal(plain, max_bytes=600, compress=False) as j:
+    with SpanJournal(plain, max_bytes=1400, compress=False) as j:
         for i in range(10):
             j.write(segment_span(i, {"sink": 0.001}, 0, 0, False, 1))
     assert (tmp_path / "plain.jsonl.1").exists()
