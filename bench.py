@@ -175,6 +175,15 @@ def parse_args(argv=None):
     # env spelling the queue scripts use.
     p.add_argument("--fused-tail", choices=("auto", "on", "off"),
                    default=os.environ.get("SRTB_BENCH_FUSED_TAIL", "auto"))
+    # front-fused staged megakernel A/B legs (Config.front_fuse, the
+    # staged_ffuse family): "on" forces the raw-bytes pass-1 + fused
+    # pass-2-epilogue kernels (requires SRTB_BENCH_STAGED=1 +
+    # SRTB_STAGED_ROWS_IMPL=pallas2), "off" the classic staged front,
+    # "auto" the plan's own resolution.  SRTB_BENCH_FRONT_FUSE is the
+    # env spelling the queue scripts use.
+    p.add_argument("--front-fuse", choices=("auto", "on", "off"),
+                   default=os.environ.get("SRTB_BENCH_FRONT_FUSE",
+                                          "auto"))
     # incremental H2D ring A/B legs (Config.ingest_ring).  Both ring
     # legs upload bytes PER REP (the streaming pipeline's real transfer
     # pattern, with overlap-save reserving a tail): "on" re-uploads only
@@ -196,7 +205,7 @@ def parse_args(argv=None):
 
 def run_bench(platform_error, overlap: str = "on",
               fused_tail: str = "auto", ring: str = "none",
-              ledger: str = ""):
+              ledger: str = "", front_fuse: str = "auto"):
     import jax
 
     from srtb_tpu.utils.platform import apply_platform_env
@@ -249,6 +258,7 @@ def run_bench(platform_error, overlap: str = "on",
         use_pallas_sk=bool(int(os.environ.get("SRTB_BENCH_USE_PALLAS_SK",
                                               "0"))),
         fused_tail=fused_tail,
+        front_fuse=front_fuse,
         # AOT executable cache A/B (utils/aot_cache): run the same
         # config twice with this set — the second run's compile_s is
         # the AOT warm-restart number
@@ -424,6 +434,8 @@ def run_bench(platform_error, overlap: str = "on",
         "plan": proc.plan_name,
         "hbm_passes": proc.hbm_passes,
         "fused_tail": "on" if proc.fused_tail else "off",
+        "front_fuse": "on" if getattr(proc, "front_fuse", False)
+        else "off",
         "ring": ring,
         "search_mode": proc.MODE,
     }
@@ -483,8 +495,9 @@ def run_bench(platform_error, overlap: str = "on",
             from srtb_tpu.utils import perf_ledger as PL
             extra = {k: out[k] for k in
                      ("overlap", "ring", "hbm_passes", "fused_tail",
-                      "compile_s", "compile_ms", "roofline_frac",
-                      "achieved_gbps", "vs_baseline", "search_mode")
+                      "front_fuse", "compile_s", "compile_ms",
+                      "roofline_frac", "achieved_gbps", "vs_baseline",
+                      "search_mode")
                      if k in out}
             PL.PerfLedger(ledger).append(PL.make_record(
                 "bench", out["value"], out["unit"],
@@ -539,7 +552,8 @@ def main():
     watchdog = _arm_watchdog(platform, err)
     try:
         run_bench(err, overlap=args.overlap, fused_tail=args.fused_tail,
-                  ring=args.ring, ledger=args.ledger)
+                  ring=args.ring, ledger=args.ledger,
+                  front_fuse=args.front_fuse)
         # disarm before teardown: a slow runtime shutdown must not fire
         # a second, contradictory diagnostic line after the real result
         if watchdog is not None:

@@ -39,6 +39,34 @@ unnormalized in both directions and held to the same float64 oracle
 tests (tests/test_pallas_fft2.py); the TPU answer to the reference's
 single-call vendor FFTs for full segments (ref: fft/fft.hpp:54-160,
 fft_pipe.hpp:44-78).
+
+Front fusion (the ``staged_ffuse`` plan family, pipeline/segment.py):
+
+  * :func:`pass1_front` takes the **raw uint8 segment** as its operand:
+    each grid step DMAs its column block of packed bytes, unpacks
+    (1/2/4/8-bit, simple or 2-pol byte-interleaved), applies the window
+    and the even/odd pack in VMEM, runs the pass-1 column FFT +
+    four-step twiddle, and writes the blocked intermediate exactly once
+    — HBM pass 1 is one raw-byte read plus one blocked write, with the
+    Parseval pieces of the RFI-s1 mean power accumulated on the side.
+  * :func:`pass2_spectrum` appends the whole spectrum tail to pass 2's
+    epilogue (the slot the skzap tail occupies on the waterfall side):
+    row FFT, the Hermitian R2C post-process assembled in-kernel from
+    mirrored row blocks, RFI-s1 zap/normalize/manual-mask, and the
+    dedispersion chirp — the df64 in-register phase in production
+    (staged plans are always bankless; the precombined
+    ``(c, cw = c·w)`` blocked premul operands stay available for
+    tests and non-staged callers) — so pass 2 emits the dedispersed
+    spectrum directly.
+
+  This is the traffic-minimizing move of the PIM-FFT literature
+  (PAPERS.md: *Collaborative Acceleration for FFT on PIM*, *Near Memory
+  Acceleration on Radio Astronomy Imaging*): do the format conversion
+  where the data already is, never re-read what a kernel just wrote.
+  Below the production leg window the passes fall back to single-stage
+  DFT-matrix legs (``_leg``) so the family stays auditable/testable at
+  CPU/CI shapes; Mosaic acceptance of the unpack lane interleave is
+  gated like ops/pallas_kernels.UNPACK_MOSAIC_OK (see FFUSE_MOSAIC_OK).
 """
 
 from __future__ import annotations
@@ -191,32 +219,171 @@ def _pick_block(candidates, fits, floor: int) -> int:
     return floor
 
 
+def _choose_block(env_var: str, cands, fallback: int, small: bool,
+                  bytes_fn, floor: int) -> int:
+    """Shared block-chooser rule of the four pass pickers below: the
+    env pin overrides absolutely (hardware tuning); small-leg
+    (sub-production) shapes take the largest candidate — the whole
+    block is tiny and the padded-footprint model doesn't apply;
+    otherwise the largest candidate whose modeled footprint fits the
+    VMEM budget, or the floor."""
+    env = os.environ.get(env_var)
+    if env:
+        return int(env)
+    if small or not cands:
+        return cands[0] if cands else fallback
+    budget = _vmem_budget()
+    return _pick_block(cands, lambda c: bytes_fn(c) <= budget, floor)
+
+
 def _block_cols(n1: int, n2: int) -> int:
     """Pass-1 column-block width (= rows of the in-kernel leg FFT):
     largest power-of-two divisor of n2 in [128, 1024] that fits the
     padded-footprint budget.  bb >= 128 always — below that the block's
     lane padding keeps VMEM cost flat while throwing away strided-DMA
     width.  SRTB_PALLAS2_BB overrides absolutely (hardware tuning)."""
-    env = os.environ.get("SRTB_PALLAS2_BB")
-    if env:
-        return int(env)
-    budget = _vmem_budget()
-    cands = [c for c in (1024, 512, 256, 128) if n2 % c == 0]
-    return _pick_block(
-        cands, lambda c: _pass1_bytes(n1, c) <= budget, 128)
+    return _choose_block(
+        "SRTB_PALLAS2_BB",
+        [c for c in (1024, 512, 256, 128) if n2 % c == 0],
+        min(n2, 128), PF._split_la_lb(n1) is None,
+        lambda c: _pass1_bytes(n1, c), 128)
 
 
 def _block_rows(n2: int, n1: int) -> int:
     """Pass-2 row-block height: largest power-of-two divisor of n1 in
     [8, 256] that fits the budget (rb is the sublane dim — lane-dense
     at any size, so small rb is cheap and correct here)."""
-    env = os.environ.get("SRTB_PALLAS2_RB")
-    if env:
-        return int(env)
-    budget = _vmem_budget()
-    cands = [c for c in (256, 128, 64, 32, 16, 8) if n1 % c == 0]
-    return _pick_block(
-        cands, lambda c: _pass2_bytes(n2, c) <= budget, 8)
+    return _choose_block(
+        "SRTB_PALLAS2_RB",
+        [c for c in (256, 128, 64, 32, 16, 8) if n1 % c == 0],
+        min(n1, 8), PF._split_la_lb(n2) is None,
+        lambda c: _pass2_bytes(n2, c), 8)
+
+
+def _pass1_front_bytes(n1: int, bb: int, streams: int, nbits: int,
+                       windowed: bool) -> int:
+    """:func:`_pass1_bytes` extended for the front-fused kernel
+    (:func:`pass1_front`): the double-buffered raw-byte tile, the
+    optional (w_even, w_odd) window blocks and the 2S output blocks +
+    3S accumulators replace the classic 2-in/2-out ref model; the
+    in-kernel unpack adds its int32 byte view plus the widened f32
+    sample planes as live scratch; the per-stream column FFT keeps the
+    classic live-intermediate term (streams are processed serially, so
+    one stream's FFT intermediates are live at a time)."""
+    la, lb = PF._split_la_lb(n1)
+    blk_bytes = bb * 2 * streams * abs(nbits) // 8
+    refs = 2 * n1 * max(blk_bytes, 128)               # u8 byte tile
+    if windowed:
+        refs += 2 * 2 * n1 * max(bb, 128) * 4         # (w_even, w_odd)
+    refs += 2 * 2 * streams * n1 * max(bb, 128) * 4   # output blocks
+    refs += 2 * 3 * streams * 8 * 128 * 4             # accumulators
+    # unpack scratch: the int32 byte view plus ~2 widened f32 sample
+    # planes covering all streams (field stack + lane de-interleave)
+    scratch = (n1 * max(blk_bytes, 128) * 4
+               + 2 * n1 * 2 * streams * max(bb, 128) * 4)
+    live = (4 * la * lb * bb * 4 + 2 * bb * la * max(lb, 128) * 4
+            + 2 * n1 * max(bb, 128) * 4)
+    return refs + scratch + live + _leg_const_bytes(la, lb)
+
+
+def _block_cols_front(n1: int, n2: int, streams: int, nbits: int,
+                      windowed: bool) -> int:
+    """Pass-1 column-block width for the front-fused kernel — the
+    :func:`_block_cols` rule with the fused footprint model (the
+    raw-byte tile + unpack scratch + per-stream outputs all count).
+    SRTB_PALLAS2_BB still overrides absolutely."""
+    return _choose_block(
+        "SRTB_PALLAS2_BB",
+        [c for c in (1024, 512, 256, 128) if n2 % c == 0],
+        min(n2, 128), PF._split_la_lb(n1) is None,
+        lambda c: _pass1_front_bytes(n1, c, streams, nbits, windowed),
+        128)
+
+
+def _pass2_spec_bytes(n2: int, rb: int, has_mask: bool,
+                      has_premul: bool) -> int:
+    """:func:`_pass2_bytes` extended for the fused-epilogue kernel
+    (:func:`pass2_spectrum`): SIX streamed [rb, n2] input blocks (row
+    + mirror + next pairs) plus the mask/premul operand blocks, two
+    row FFTs live per step (the block's own rows and its mirror rows),
+    and the Hermitian/zap/chirp elementwise planes."""
+    la, lb = PF._split_la_lb(n2)
+    plb = max(lb, 128)
+    prb = max(rb, 8)
+    nin = 6 + (1 if has_mask else 0) + (4 if has_premul else 0)
+    refs = 2 * (nin + 2) * prb * n2 * 4        # lane-dense [rb, n2] refs
+    live = (2 * 6 * la * rb * plb * 4          # two row-FFT bodies
+            + 10 * prb * n2 * 4)               # hermitian/zap/chirp planes
+    return refs + live + _leg_const_bytes(la, lb)
+
+
+def _block_rows_spec(n2: int, n1: int, has_mask: bool,
+                     has_premul: bool) -> int:
+    """Pass-2 row-block height for the fused-epilogue kernel — the
+    :func:`_block_rows` rule with the fused footprint model.
+    SRTB_PALLAS2_RB still overrides absolutely."""
+    return _choose_block(
+        "SRTB_PALLAS2_RB",
+        [c for c in (256, 128, 64, 32, 16, 8) if n1 % c == 0],
+        min(n1, 8), PF._split_la_lb(n2) is None,
+        lambda c: _pass2_spec_bytes(n2, c, has_mask, has_premul), 8)
+
+
+# ------------------------------------------------------------------
+# in-kernel DFT "legs".  The production window runs the two-level
+# 128-lane VMEM leg (ops/pallas_fft); below it — the front-fuse
+# family's CI/audit shapes — a leg is a single DFT-matrix contraction,
+# so the same kernels stay lowerable at any power-of-two >= 8.
+
+_SMALL_LEG_MAX = 512  # [L, L] f32 DFT-matrix pair tops out at 2 MB
+
+
+def _leg(length: int, inverse: bool):
+    """(kind, la, lb, const arrays) for the in-kernel DFT along one
+    axis: kind "two" = the two-level 128-lane leg (PF.leg_consts),
+    kind "one" = one [L, L] DFT-matrix dot_general (small lengths)."""
+    if PF._split_la_lb(length) is not None:
+        la, lb, consts = PF.leg_consts(length, inverse)
+        return "two", la, lb, consts
+    if length & (length - 1) or not 8 <= length <= _SMALL_LEG_MAX:
+        raise ValueError(f"leg length {length} unsupported")
+    wr, wi = PF._dft_matrix_np(length, inverse)
+    return "one", length, 1, (jnp.asarray(wr), jnp.asarray(wi))
+
+
+def _leg_specs(kind: str, la: int, lb: int):
+    if kind == "two":
+        return PF.leg_const_specs(la, lb)
+    return [PF._Launch.const_spec((la, la)),
+            PF._Launch.const_spec((la, la))]
+
+
+def leg_supported(length: int) -> bool:
+    return PF._split_la_lb(length) is not None or (
+        not length & (length - 1) and 8 <= length <= _SMALL_LEG_MAX)
+
+
+def ffuse_factor(m):
+    """[n1, n2] factorization for the front-fused kernels: the standard
+    production window (:func:`_factor`) first; below it a small-leg
+    split so the ``staged_ffuse`` plan family stays auditable and
+    testable at CPU/CI shapes.  None when ``m`` has no usable split."""
+    fac = _factor(m, strict=False)
+    if fac is not None:
+        return fac
+    if m & (m - 1) or m < (1 << 10):
+        return None
+
+    def ok(n1):
+        if not 8 <= n1 <= _SMALL_LEG_MAX or m % n1:
+            return False
+        return leg_supported(m // n1) and m // n1 >= 128
+
+    n1 = min(1 << ((m.bit_length() - 1) // 2), _SMALL_LEG_MAX)
+    for cand in (n1, m // 4096, m // 128):
+        if ok(cand):
+            return cand, m // cand
+    return None
 
 
 def _phase_cos_sin(r, m: int, sign: float):
@@ -234,18 +401,24 @@ def _phase_cos_sin(r, m: int, sign: float):
     return ca * cb - sa * sb, sa * cb + ca * sb
 
 
-def _pass1_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref, wbi_ref,
-                  twr_ref, twi_ref, out_re_ref, out_im_ref, *,
-                  n1, bb, la, lb, m, sign):
-    from jax.experimental import pallas as pl
-
-    j2_0 = pl.program_id(0) * bb
-    # column-native: both DFT contractions run against the j1 axes
-    # of the [n1(j1), bb(j2)] block in place — no input transpose,
-    # no padded intermediate, one dense 3D relayout at the end
+def _col_fft_block(x2r, x2i, cref, *, kind, n1, bb, la, lb):
+    """Column-axis leg DFT of one [n1(j1), bb(j2)] value-block pair
+    (contract j1) — the column-native body shared by the packed
+    (:func:`pass1_2d`) and raw-front (:func:`pass1_front`) pass-1
+    kernels.  Returns the y[k1, d] pair [n1, bb]."""
     dg = PF.dot_mid
-    x3r = re_ref[:].reshape(la, lb, bb)
-    x3i = im_ref[:].reshape(la, lb, bb)
+    if kind == "one":
+        # small-leg: one DFT-matrix contraction over j1
+        war, wai = cref[0][:], cref[1][:]
+        yr = dg(war, x2r, 0) - dg(wai, x2i, 0)  # [n1(k1), bb]
+        yi = dg(war, x2i, 0) + dg(wai, x2r, 0)
+        return yr, yi
+    # column-native two-level leg: both DFT contractions run against
+    # the j1 axes of the block in place — no input transpose, no padded
+    # intermediate, one dense 3D relayout at the end
+    war_ref, wai_ref, wbr_ref, wbi_ref, twr_ref, twi_ref = cref
+    x3r = x2r.reshape(la, lb, bb)
+    x3i = x2i.reshape(la, lb, bb)
     war, wai = war_ref[:], wai_ref[:]
     # stage 1, contract j1a: A[j2, d, k1]
     ar = dg(x3r, war, 0) - dg(x3i, wai, 0)      # [lb, bb, la]
@@ -262,6 +435,17 @@ def _pass1_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref, wbi_ref,
     # leg-natural index k = k2*la + k1 -> [k2, k1, d] -> [n1, bb]
     yr = jnp.transpose(cr, (2, 1, 0)).reshape(n1, bb)
     yi = jnp.transpose(ci, (2, 1, 0)).reshape(n1, bb)
+    return yr, yi
+
+
+def _pass1_kernel(re_ref, im_ref, *rest, n1, bb, la, lb, m, sign, kind):
+    from jax.experimental import pallas as pl
+
+    cref = rest[:-2]
+    out_re_ref, out_im_ref = rest[-2:]
+    j2_0 = pl.program_id(0) * bb
+    yr, yi = _col_fft_block(re_ref[:], im_ref[:], cref, kind=kind,
+                            n1=n1, bb=bb, la=la, lb=lb)
     # four-step twiddle at [k, d] orientation
     wr, wi = _fourstep_twiddle_t(n1, bb, m, sign, j2_0)
     out_re_ref[:] = yr * wr - yi * wi
@@ -278,18 +462,41 @@ def _fourstep_twiddle_t(n1: int, cols_j2: int, m: int, sign: float, j2_0):
     return _phase_cos_sin(d * k1, m, sign)
 
 
-def _pass2_kernel(re_ref, im_ref, war_ref, wai_ref, wbr_ref, wbi_ref,
-                  twr_ref, twi_ref, out_re_ref, out_im_ref, *,
-                  n2, rb, la, lb):
+def _row_fft_block(xr, xi, cref, *, kind, n2, rb, la, lb):
+    """Row-axis leg DFT of one [rb, n2] value-block pair (length-n2
+    C2C along each row), natural order, as a flat [rb, n2] pair.  The
+    two-level kind flattens the helper's [rb, la, lb] view in-kernel —
+    a minor-lb reshape real Mosaic rejects, sanctioned here because
+    every caller is either interpret-mode (CPU CI) or behind the
+    FFUSE_MOSAIC_OK hardware-probe gate; the classic
+    :func:`_pass2_kernel` path keeps the 3D-out-ref spelling."""
+    dg = PF.dot_mid
+    if kind == "one":
+        wr, wi = cref[0][:], cref[1][:]
+        yr = dg(xr, wr, 1) - dg(xi, wi, 1)      # [rb, n2]
+        yi = dg(xr, wi, 1) + dg(xi, wr, 1)
+        return yr, yi
+    yr3, yi3 = PF.vmem_fft_rows(xr, xi, *[r[:] for r in cref],
+                                la=la, lb=lb, rows=rb)
+    return yr3.reshape(rb, n2), yi3.reshape(rb, n2)
+
+
+def _pass2_kernel(re_ref, im_ref, *rest, n2, rb, la, lb, kind):
+    cref = rest[:-2]
+    out_re_ref, out_im_ref = rest[-2:]
+    if kind == "one":
+        yr, yi = _row_fft_block(re_ref[:], im_ref[:], cref, kind=kind,
+                                n2=n2, rb=rb, la=la, lb=lb)
+        out_re_ref[:] = yr
+        out_im_ref[:] = yi
+        return
     # output stays k1-major blocked (a natural-order [n2, rb] column
     # block would lane-pad rb -> 128 in VMEM, 8-32 MB per plane at
     # production n2) — callers restore order with unblock(), an XLA
     # transpose the next elementwise pass absorbs.  The helper returns
     # its [rb, la, lb] natural-flat view; the 3D out refs match and the
     # caller's flatten to [rb, n2] happens outside the pallas_call.
-    yr, yi = PF.vmem_fft_rows(re_ref[:], im_ref[:], war_ref[:],
-                              wai_ref[:], wbr_ref[:], wbi_ref[:],
-                              twr_ref[:], twi_ref[:],
+    yr, yi = PF.vmem_fft_rows(re_ref[:], im_ref[:], *[r[:] for r in cref],
                               la=la, lb=lb, rows=rb)
     out_re_ref[:] = yr
     out_im_ref[:] = yi
@@ -311,11 +518,11 @@ def pass1_2d(re2, im2, inverse: bool = False, interpret: bool = False):
     bb = _block_cols(n1, n2)
     if n2 % bb:
         raise ValueError(f"pass-1 block {bb} must divide n2={n2}")
-    la1, lb1, consts1 = PF.leg_consts(n1, inverse)
+    kind1, la1, lb1, consts1 = _leg(n1, inverse)
     col_block = pl.BlockSpec((n1, bb), lambda i: (0, i),
                              memory_space=pltpu.VMEM)
     k1 = functools.partial(_pass1_kernel, n1=n1, bb=bb, la=la1, lb=lb1,
-                           m=m, sign=sign)
+                           m=m, sign=sign, kind=kind1)
     mid_shape = jax.ShapeDtypeStruct((n1, n2), jnp.float32)
     kwargs = {}
     if not interpret:
@@ -324,7 +531,7 @@ def pass1_2d(re2, im2, inverse: bool = False, interpret: bool = False):
     return pl.pallas_call(
         k1,
         grid=(n2 // bb,),
-        in_specs=[col_block, col_block] + PF.leg_const_specs(la1, lb1),
+        in_specs=[col_block, col_block] + _leg_specs(kind1, la1, lb1),
         out_specs=[col_block, col_block],
         out_shape=[mid_shape, mid_shape],
         interpret=interpret,
@@ -345,13 +552,18 @@ def pass2_2d(br, bi, inverse: bool = False, interpret: bool = False):
     rb = _block_rows(n2, n1)
     if n1 % rb:
         raise ValueError(f"pass-2 block {rb} must divide n1={n1}")
-    la2, lb2, consts2 = PF.leg_consts(n2, inverse)
+    kind2, la2, lb2, consts2 = _leg(n2, inverse)
     row_block = pl.BlockSpec((rb, n2), lambda i: (i, 0),
                              memory_space=pltpu.VMEM)
-    out_block = pl.BlockSpec((rb, la2, lb2), lambda i: (i, 0, 0),
-                             memory_space=pltpu.VMEM)
-    k2 = functools.partial(_pass2_kernel, n2=n2, rb=rb, la=la2, lb=lb2)
-    out_shape = jax.ShapeDtypeStruct((n1, la2, lb2), jnp.float32)
+    if kind2 == "two":
+        out_block = pl.BlockSpec((rb, la2, lb2), lambda i: (i, 0, 0),
+                                 memory_space=pltpu.VMEM)
+        out_shape = jax.ShapeDtypeStruct((n1, la2, lb2), jnp.float32)
+    else:  # small-leg: the row block is already the natural-flat form
+        out_block = row_block
+        out_shape = jax.ShapeDtypeStruct((n1, n2), jnp.float32)
+    k2 = functools.partial(_pass2_kernel, n2=n2, rb=rb, la=la2, lb=lb2,
+                           kind=kind2)
     kwargs = {}
     if not interpret:
         kwargs["compiler_params"] = PF.tpu_compiler_params(
@@ -359,7 +571,7 @@ def pass2_2d(br, bi, inverse: bool = False, interpret: bool = False):
     yr3, yi3 = pl.pallas_call(
         k2,
         grid=(n1 // rb,),
-        in_specs=[row_block, row_block] + PF.leg_const_specs(la2, lb2),
+        in_specs=[row_block, row_block] + _leg_specs(kind2, la2, lb2),
         out_specs=[out_block, out_block],
         out_shape=[out_shape, out_shape],
         interpret=interpret,
@@ -454,3 +666,390 @@ def unblock(y: jnp.ndarray, m: int) -> jnp.ndarray:
     n1, n2 = _factor(m)
     y2 = y.reshape(*y.shape[:-1], n1, n2)
     return jnp.swapaxes(y2, -1, -2).reshape(*y.shape[:-1], m)
+
+
+# ==================================================================
+# front fusion: unpack -> window -> even/odd pack -> pass 1 in ONE
+# kernel (raw bytes in, blocked intermediate out), and the whole
+# spectrum tail (Hermitian + RFI s1 + chirp) as pass 2's epilogue.
+# ==================================================================
+
+# Pending on-chip Mosaic validation (tools_tpu_r9_queue.sh "ffuse
+# probe" legs, then flip to True): the front kernels use the sub-byte
+# lane interleave ops/pallas_kernels.UNPACK_MOSAIC_OK documents as
+# unlowerable today, plus strided lane de-interleaves, an in-kernel
+# minor-lb flatten (_row_fft_block) and a lane flip/roll — every one
+# fine under interpret (CPU CI), each a real-Mosaic question.
+# SRTB_PALLAS_FFUSE=1 opts in before the probe; front_fuse="on"
+# (Config) forces regardless — the hardware A/B spelling.
+FFUSE_MOSAIC_OK = False
+
+# unpack variants the front kernel can spell in-register, and the
+# sample widths each supports (ops/unpack.py semantics: positive =
+# unsigned, negative = signed int8)
+FFUSE_VARIANT_BITS = {
+    "simple": (1, 2, 4, 8, -8),
+    "interleaved_samples_2": (8, -8),
+}
+
+
+def ffuse_enabled() -> bool:
+    """Whether front_fuse="auto" may resolve ON: the Mosaic probe flag
+    or the env opt-in.  Deliberately NOT true merely under interpret —
+    "auto" flipping every existing pallas2-staged config (and its
+    pinned plan card) onto the new megakernel the moment the code
+    landed would be a silent plan change; the staged_ffuse family,
+    tests and ci force front_fuse="on" instead."""
+    return FFUSE_MOSAIC_OK or \
+        os.environ.get("SRTB_PALLAS_FFUSE", "") == "1"
+
+
+def _front_unpack(b32, variant: str, nbits: int):
+    """int32 byte block [n1, BB] -> per-stream (re, im) f32 sample
+    blocks [n1, bb] in even/odd-packed order — the in-kernel mirror of
+    ops.unpack + ops.fft.pack_even_odd.  Every value is a small exact
+    integer, so any op order is value-identical to the XLA path; the
+    lane interleave/de-interleave spellings are what FFUSE_MOSAIC_OK
+    gates on real chips."""
+    if nbits in (8, -8):
+        vals = b32
+        if nbits == -8:
+            vals = vals - 2 * (vals & 0x80)  # u8 bits -> s8 value
+        vals = vals.astype(jnp.float32)
+    else:
+        count = 8 // nbits
+        mask = (1 << nbits) - 1
+        # MSB-first fields (ref: unpack.hpp:43-140), interleaved back
+        # to sample order along the lane axis
+        fields = [((b32 >> (8 - nbits * (j + 1))) & mask)
+                  .astype(jnp.float32) for j in range(count)]
+        vals = jnp.stack(fields, axis=-1).reshape(
+            b32.shape[0], b32.shape[1] * count)
+    if variant == "interleaved_samples_2":
+        # "1212" byte interleave: z_s[j] = x[4j+s] + i*x[4j+2+s]
+        return [(vals[:, s::4], vals[:, 2 + s::4]) for s in range(2)]
+    return [(vals[:, 0::2], vals[:, 1::2])]
+
+
+def _pass1_front_kernel(byte_ref, *rest, n1, bb, la, lb, m, sign, kind,
+                        variant, nbits, streams, windowed):
+    from jax.experimental import pallas as pl
+
+    idx = 0
+    win = None
+    if windowed:
+        win = (rest[0], rest[1])
+        idx = 2
+    ncon = 6 if kind == "two" else 2
+    cref = rest[idx:idx + ncon]
+    outs = rest[idx + ncon:]
+    step = pl.program_id(0)
+    j2_0 = step * bb
+    b32 = byte_ref[:].astype(jnp.int32)
+    pairs = _front_unpack(b32, variant, nbits)
+    wr4, wi4 = _fourstep_twiddle_t(n1, bb, m, sign, j2_0)
+    for s, (re, im) in enumerate(pairs):
+        if windowed:
+            re = re * win[0][:]
+            im = im * win[1][:]
+        yr, yi = _col_fft_block(re, im, cref, kind=kind, n1=n1, bb=bb,
+                                la=la, lb=lb)
+        br = yr * wr4 - yi * wi4
+        bi = yr * wi4 + yi * wr4
+        outs[2 * s][:] = br
+        outs[2 * s + 1][:] = bi
+        # RFI-s1 mean-power pieces, accumulated while the block is in
+        # VMEM (TPU grids are sequential): sum |B|^2 over the whole
+        # intermediate plus the DC-bin partials F0 = sum_j2 B[0, j2],
+        # as 128-lane partial vectors (finished in front_mean_power)
+        s2_ref, f0r_ref, f0i_ref = outs[2 * streams + 3 * s:
+                                        2 * streams + 3 * s + 3]
+
+        @pl.when(step == 0)
+        def _init(s2_ref=s2_ref, f0r_ref=f0r_ref, f0i_ref=f0i_ref):
+            s2_ref[:] = jnp.zeros_like(s2_ref)
+            f0r_ref[:] = jnp.zeros_like(f0r_ref)
+            f0i_ref[:] = jnp.zeros_like(f0i_ref)
+
+        p = br * br + bi * bi
+        s2_ref[:] += p.sum(axis=0).reshape(bb // 128, 128).sum(axis=0,
+                                                               keepdims=True)
+        f0r_ref[:] += br[0:1, :].reshape(bb // 128, 128).sum(
+            axis=0, keepdims=True)
+        f0i_ref[:] += bi[0:1, :].reshape(bb // 128, 128).sum(
+            axis=0, keepdims=True)
+
+
+def pass1_front(raw: jnp.ndarray, *, m: int, streams: int, variant: str,
+                nbits: int, window_eo=None, inverse: bool = False,
+                interpret: bool = False):
+    """Front-fused pass 1: the RAW uint8 segment is the kernel operand.
+
+    Each grid step DMAs its column block of packed bytes, unpacks
+    (``FFUSE_VARIANT_BITS``), multiplies the window, performs the
+    even/odd half-size pack and the pass-1 column FFT + four-step
+    twiddle in VMEM, and writes the blocked intermediate exactly once:
+    HBM pass 1 = one raw-byte read + one blocked write.  The Parseval
+    pieces of the RFI stage-1 mean power ride along as per-stream
+    128-lane accumulators so stage (b) never re-reads anything
+    spectrum-sized to evaluate the zap threshold.
+
+    ``raw``: uint8 [streams * 2m * |nbits| / 8] (all streams
+    interleaved, as read from file/UDP).  ``window_eo``: optional
+    (w_even, w_odd) f32 [n1, n2] pair — the per-stream sample window
+    split even/odd and viewed blocked (SegmentProcessor precomputes
+    it).  Returns ``(br, bi, aux)``: [S, n1, n2] intermediate pair +
+    [S, 3, 128] accumulators.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from srtb_tpu.ops import pallas_kernels as pk
+
+    if nbits not in FFUSE_VARIANT_BITS.get(variant, ()):
+        raise ValueError(
+            f"front fuse unsupported for variant {variant!r} at "
+            f"{nbits}-bit")
+    fac = ffuse_factor(m)
+    if fac is None:
+        raise ValueError(f"front fuse unsupported length {m}")
+    n1, n2 = fac
+    sign = 1.0 if inverse else -1.0
+    bb = _block_cols_front(n1, n2, streams, nbits,
+                           window_eo is not None)
+    if n2 % bb:
+        raise ValueError(f"pass-1 block {bb} must divide n2={n2}")
+    if bb % 128:
+        # the accumulator reduction reshapes each block to
+        # [bb // 128, 128] lanes
+        raise ValueError(f"pass-1 front block {bb} must be a multiple "
+                         "of 128")
+    bits_per_col = 2 * streams * abs(nbits)  # one packed column = 2S samples
+    if (n2 * bits_per_col) % 8 or (bb * bits_per_col) % 8:
+        raise ValueError(f"byte-misaligned ffuse block {bb}x{bits_per_col}b")
+    row_bytes = n2 * bits_per_col // 8
+    blk_bytes = bb * bits_per_col // 8
+    if raw.shape != (n1 * row_bytes,):
+        raise ValueError(
+            f"raw must be {n1 * row_bytes} bytes, got {raw.shape}")
+    raw2 = raw.reshape(n1, row_bytes)
+    kind, la, lb, consts = _leg(n1, inverse)
+
+    byte_block = pl.BlockSpec((n1, blk_bytes), lambda i: (0, i),
+                              memory_space=pltpu.VMEM)
+    col_block = pl.BlockSpec((n1, bb), lambda i: (0, i),
+                             memory_space=pltpu.VMEM)
+    acc_block = pl.BlockSpec((1, 128), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM)
+    in_specs = [byte_block]
+    operands = [raw2]
+    windowed = window_eo is not None
+    if windowed:
+        in_specs += [col_block, col_block]
+        operands += [window_eo[0], window_eo[1]]
+    in_specs += _leg_specs(kind, la, lb)
+    operands += list(consts)
+    mid = jax.ShapeDtypeStruct((n1, n2), jnp.float32)
+    acc = jax.ShapeDtypeStruct((1, 128), jnp.float32)
+    out_specs = [col_block] * (2 * streams) + [acc_block] * (3 * streams)
+    out_shape = [mid] * (2 * streams) + [acc] * (3 * streams)
+    kernel = functools.partial(
+        _pass1_front_kernel, n1=n1, bb=bb, la=la, lb=lb, m=m, sign=sign,
+        kind=kind, variant=variant, nbits=nbits, streams=streams,
+        windowed=windowed)
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = PF.tpu_compiler_params(
+            vmem_limit_bytes=_vmem_budget())
+    with pk._ob_mode(interpret):
+        outs = pl.pallas_call(
+            kernel,
+            grid=(n2 // bb,),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=interpret,
+            **kwargs,
+        )(*operands)
+    br = jnp.stack([outs[2 * s] for s in range(streams)])
+    bi = jnp.stack([outs[2 * s + 1] for s in range(streams)])
+    aux = jnp.stack([
+        jnp.concatenate(outs[2 * streams + 3 * s:
+                             2 * streams + 3 * s + 3], axis=0)
+        for s in range(streams)])
+    return br, bi, aux
+
+
+def front_mean_power(aux: jnp.ndarray, n2: int, m: int) -> jnp.ndarray:
+    """Per-stream RFI-s1 mean |X_k|^2 from the pass-1 accumulators
+    ``aux [S, 3, 128]`` — rfi.mean_power_packed with the reduction
+    moved one FFT level earlier: Parseval along the row transform
+    gives sum|F|^2 = n2 * sum|B|^2, and F0 = sum_j2 B[0, j2].  Agrees
+    with the packed form to f32 rounding (same ~1-ulp decision-flip
+    caveat rfi.mean_power_packed documents)."""
+    s2 = aux[:, 0, :].sum(axis=-1)
+    f0r = aux[:, 1, :].sum(axis=-1)
+    f0i = aux[:, 2, :].sum(axis=-1)
+    return (n2 * s2 + 2.0 * f0r * f0i) / m
+
+
+def _pass2_spec_kernel(*refs, n1, n2, rb, la, lb, m, kind, norm,
+                       has_mask, has_premul, chirp):
+    from jax.experimental import pallas as pl
+    from srtb_tpu.ops import pallas_kernels as pk
+
+    i = pl.program_id(0)
+    a_re, a_im, b_re, b_im, c_re, c_im = refs[:6]
+    pos = 6
+    ncon = 6 if kind == "two" else 2
+    cref = refs[pos:pos + ncon]
+    pos += ncon
+    thr_ref = refs[pos]
+    mask_ref = refs[pos + 1]
+    pos += 2
+    pm = refs[pos:pos + 4] if has_premul else None
+    out_re_ref, out_im_ref = refs[-2:]
+
+    # row FFT of this step's k1 block
+    zar, zai = _row_fft_block(a_re[:], a_im[:], cref, kind=kind,
+                              n2=n2, rb=rb, la=la, lb=lb)
+    # ... and of the MIRROR rows {n1-k1}: rows B[1:] of the reflected
+    # block plus the first row of the next one ((G-i) mod G, which for
+    # i == 0 wraps to this block's own row 0 — exactly the k1 = 0
+    # self-mirror), reversed so Zm[t] is row n1-a-t
+    mr = jnp.flip(jnp.concatenate([b_re[1:, :], c_re[0:1, :]], axis=0),
+                  axis=0)
+    mi = jnp.flip(jnp.concatenate([b_im[1:, :], c_im[0:1, :]], axis=0),
+                  axis=0)
+    zmr, zmi = _row_fft_block(mr, mi, cref, kind=kind, n2=n2, rb=rb,
+                              la=la, lb=lb)
+    # Hermitian mirror F[(m-k) mod m], k = k2*n1 + k1 blocked: a lane
+    # flip (k2 -> n2-1-k2) for every k1 >= 1 row; the one global
+    # k1 == 0 row additionally rolls by one (its mirror column is
+    # (n2-k2) mod n2) — the blocked spelling of hermitian_rfft_post's
+    # roll(flip(zf), 1)
+    fmr = jnp.flip(zmr, axis=-1)
+    fmi = jnp.flip(zmi, axis=-1)
+    row0 = (jax.lax.broadcasted_iota(jnp.int32, (rb, 1), 0) == 0) \
+        & (i == 0)
+    fmr = jnp.where(row0, jnp.roll(fmr, 1, axis=-1), fmr)
+    fmi = jnp.where(row0, jnp.roll(fmi, 1, axis=-1), fmi)
+    fmi = -fmi  # conj
+    even_re = 0.5 * (zar + fmr)
+    even_im = 0.5 * (zai + fmi)
+    odd_re = 0.5 * (zai - fmi)
+    odd_im = -0.5 * (zar - fmr)
+    if pm is not None:
+        cr_, ci_, cwr, cwi = [r[:] for r in pm]
+        xr = (cr_ * even_re - ci_ * even_im) \
+            + (cwr * odd_re - cwi * odd_im)
+        xi = (cr_ * even_im + ci_ * even_re) \
+            + (cwr * odd_im + cwi * odd_re)
+        k_int = None
+    else:
+        # true bin index of each blocked element (int32-exact, m <= 2^29)
+        k_int = (i * rb
+                 + jax.lax.broadcasted_iota(jnp.int32, (rb, n2), 0)) \
+            + jax.lax.broadcasted_iota(jnp.int32, (rb, n2), 1) * n1
+        wtr, wti = _phase_cos_sin(k_int, 2 * m, -1.0)
+        xr = even_re + (wtr * odd_re - wti * odd_im)
+        xi = even_im + (wtr * odd_im + wti * odd_re)
+    # RFI stage 1 (rfi.mitigate_rfi_s1_given_mean): zap bins whose
+    # power exceeds threshold*mean (thr holds the product), scale
+    # survivors by the normalization coefficient, manual mask
+    power = xr * xr + xi * xi
+    scale = jnp.where(power <= thr_ref[0], jnp.float32(norm), 0.0)
+    if has_mask:
+        scale = scale * mask_ref[:]
+    xr = xr * scale
+    xi = xi * scale
+    if chirp is not None and pm is None:
+        # bankless: exact per-element df64 chirp phase in-register —
+        # the blocked lanes stride k by n1, so the anchored-Taylor
+        # fast path's contiguous-span premise doesn't hold here
+        i_hi = (k_int & ~0xFFF).astype(jnp.float32)
+        i_lo = (k_int & 0xFFF).astype(jnp.float32)
+        ph = pk._chirp_phase_block(i_hi, i_lo, chirp["f_min"],
+                                   chirp["df"], chirp["f_c"],
+                                   chirp["dm"])
+        c = jnp.cos(ph)
+        s = jnp.sin(ph)
+        xr, xi = xr * c - xi * s, xr * s + xi * c
+    out_re_ref[:] = xr
+    out_im_ref[:] = xi
+
+
+def pass2_spectrum(br: jnp.ndarray, bi: jnp.ndarray, *, thr, norm: float,
+                   mask_blocked=None, premul_blocked=None, chirp=None,
+                   interpret: bool = False):
+    """Pass 2 with the whole spectrum tail as its epilogue: row FFT
+    over the [n1, n2] intermediate, the Hermitian R2C post-process
+    assembled in-kernel (each grid step also transforms its mirror
+    rows — ~2x the pass-2 FLOPs, which the dispatch-bound pipeline has
+    headroom for, in exchange for never materializing the packed C2C
+    spectrum), RFI-s1 zap/normalize/manual-mask against ``thr`` =
+    threshold·mean (from :func:`front_mean_power`), and the
+    dedispersion chirp — ``premul_blocked`` = (c_re, c_im, cw_re,
+    cw_im) blocked [n1, n2] banks (the SegmentProcessor._premul_bank
+    precombination), or ``chirp`` = dict(f_min, df, f_c, dm) for the
+    bankless in-register df64 phase.  Emits the dedispersed spectrum
+    directly, in k1-major blocked order (the consumer unblocks with a
+    metadata-view transpose fused into its first read).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from srtb_tpu.ops import pallas_kernels as pk
+
+    n1, n2 = br.shape
+    m = n1 * n2
+    has_mask = mask_blocked is not None
+    has_premul = premul_blocked is not None
+    rb = _block_rows_spec(n2, n1, has_mask, has_premul)
+    if n1 % rb:
+        raise ValueError(f"pass-2 block {rb} must divide n1={n1}")
+    grid_n = n1 // rb
+    kind, la, lb, consts = _leg(n2, inverse=False)
+    row_block = pl.BlockSpec((rb, n2), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    mirror_block = pl.BlockSpec((rb, n2), lambda i: (grid_n - 1 - i, 0),
+                                memory_space=pltpu.VMEM)
+    next_block = pl.BlockSpec((rb, n2),
+                              lambda i: ((grid_n - i) % grid_n, 0),
+                              memory_space=pltpu.VMEM)
+    in_specs = [row_block, row_block, mirror_block, mirror_block,
+                next_block, next_block]
+    operands = [br, bi, br, bi, br, bi]
+    in_specs += _leg_specs(kind, la, lb)
+    operands += list(consts)
+    in_specs += [pl.BlockSpec(memory_space=pltpu.SMEM)]
+    operands += [jnp.asarray(thr, jnp.float32).reshape(1)]
+    if has_mask:
+        in_specs += [row_block]
+        operands += [mask_blocked]
+    else:  # placeholder tile, never read by the kernel
+        in_specs += [pl.BlockSpec((1, n2), lambda i: (0, 0),
+                                  memory_space=pltpu.VMEM)]
+        operands += [jnp.zeros((1, n2), jnp.float32)]
+    if has_premul:
+        in_specs += [row_block] * 4
+        operands += list(premul_blocked)
+    kernel = functools.partial(
+        _pass2_spec_kernel, n1=n1, n2=n2, rb=rb, la=la, lb=lb, m=m,
+        kind=kind, norm=np.float32(norm), has_mask=has_mask,
+        has_premul=has_premul,
+        chirp=None if chirp is None else dict(chirp))
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = PF.tpu_compiler_params(
+            vmem_limit_bytes=_vmem_budget())
+    out = jax.ShapeDtypeStruct((n1, n2), jnp.float32)
+    with pk._ob_mode(interpret):
+        sr, si = pl.pallas_call(
+            kernel,
+            grid=(grid_n,),
+            in_specs=in_specs,
+            out_specs=[row_block, row_block],
+            out_shape=[out, out],
+            interpret=interpret,
+            **kwargs,
+        )(*operands)
+    return sr, si

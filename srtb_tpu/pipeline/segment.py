@@ -134,6 +134,66 @@ def fused_tail_resolves(cfg, staged: bool) -> bool:
     return not (bankless and n // 2 > FUSED_TAIL_DF64_MAX_SPECTRUM)
 
 
+def _front_fuse_structural(cfg, staged: bool) -> bool:
+    """Whether the front-fused staged megakernel (``staged_ffuse``,
+    ops/pallas_fft2 pass1_front/pass2_spectrum) is structurally
+    possible for this config: the staged plan with pallas2 rows, a
+    fusable tail, an unpack variant the kernel spells in-register, and
+    a factorizable transform length.  Platform/probe gating lives in
+    :func:`front_fuse_resolves`."""
+    if not staged:
+        return False
+    impl = os.environ.get("SRTB_STAGED_ROWS_IMPL", "xla")
+    if impl not in ("pallas2", "pallas2_interpret"):
+        return False
+    if int(os.environ.get("SRTB_STAGED_BLOCKED", "0")):
+        # the blocked-plane staged pack is a different front entirely
+        return False
+    from srtb_tpu.io import formats as _formats
+    from srtb_tpu.ops import pallas_fft2 as pf2
+    fmt = _formats.resolve(cfg.baseband_format_type)
+    bits = int(cfg.baseband_input_bits)
+    if bits not in pf2.FFUSE_VARIANT_BITS.get(fmt.unpack_variant, ()):
+        return False
+    if not fused_tail_resolves(cfg, staged):
+        # the pass-2 epilogue IS the fused tail; without it there is
+        # nothing to emit the dedispersed spectrum from
+        return False
+    return pf2.ffuse_factor(int(cfg.baseband_input_count) // 2) \
+        is not None
+
+
+def front_fuse_resolves(cfg, staged: bool) -> bool:
+    """Resolution of ``Config.front_fuse`` ("auto"/"on"/"off") for a
+    plan with the given resolved ``staged`` flag — the single home
+    shared by the SegmentProcessor resolver and the demotion ladder's
+    front_fuse rung (pipeline/registry.py).  "auto" additionally gates
+    on the kernels being trusted (the FFUSE_MOSAIC_OK probe flag or
+    SRTB_PALLAS_FFUSE=1 — never implicitly, so existing pallas2
+    configs keep their plan); "on" forces past that gate (the
+    ffuse family / hardware-probe spelling) but raises when the
+    fusion is structurally impossible."""
+    mode = str(getattr(cfg, "front_fuse", "auto")).lower()
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"front_fuse must be auto/on/off, got {mode!r}")
+    if mode == "off":
+        return False
+    ok = _front_fuse_structural(cfg, staged)
+    if mode == "on":
+        if not ok:
+            raise ValueError(
+                "front_fuse=on requires the staged plan with "
+                "SRTB_STAGED_ROWS_IMPL=pallas2, a fusable tail "
+                "(fused_tail != off, non-monolithic), a simple "
+                "1/2/4/8-bit or 2-pol byte-interleaved format, and a "
+                "pallas2-factorizable length")
+        return True
+    if not ok:
+        return False
+    from srtb_tpu.ops import pallas_fft2 as pf2
+    return pf2.ffuse_enabled()
+
+
 class SegmentProcessor:
     """Builds and owns the jitted per-segment device function plus its
     precomputed constants (chirp, window, RFI mask, normalization).
@@ -210,6 +270,12 @@ class SegmentProcessor:
         # into the forward FFT's final pass; resolved once so the plan,
         # its signature, and the hbm_passes model can never disagree
         self.fused_tail = self._resolve_fused_tail()
+        # front-fused staged megakernel (Config.front_fuse, the
+        # staged_ffuse family): unpack + window + even/odd pack +
+        # FFT pass 1 fold into the pallas2 pass-1 kernel (raw bytes
+        # in, blocked intermediate out) and the Hermitian + RFI-s1 +
+        # chirp tail into pass 2's epilogue
+        self.front_fuse = front_fuse_resolves(cfg, self.staged)
         # the chirp crosses the host->device boundary as stacked (re, im)
         # float32 [2, n]: some TPU runtimes can't transfer complex buffers,
         # and split re/im is the natural VPU layout anyway; complex exists
@@ -298,6 +364,19 @@ class SegmentProcessor:
         # fusions above lower the floor itself.
         self.hbm_passes = (2 + (0 if self.fused_tail else 2) + 2
                            + (0 if self._skzap else 1))
+        if self.front_fuse:
+            # Front-fused floor (the ISSUE-15 model): the two megakernel
+            # sweeps a segment's front half cannot avoid — pass 1's
+            # blocked-intermediate write (its raw-byte + window reads
+            # are sub-spectrum-sized) and pass 2's intermediate re-read,
+            # whose dedispersed-spectrum emission hands straight to the
+            # waterfall tail.  Deliberately the most conservative floor
+            # on the board: the waterfall tail's traffic rides ABOVE it
+            # (like every kernel-choice cost does for the other plans),
+            # so achieved_gbps / roofline_frac stay honest lower
+            # bounds, and the audited per-program counts in
+            # plan_cards.json pin the true structural traffic.
+            self.hbm_passes = 2
         # XLA FFT row-length cap override (Config.fft_len_cap; None =
         # the ops/fft default), threaded through every FFT entry point
         self._len_cap = cfg.fft_len_cap or None
@@ -317,7 +396,7 @@ class SegmentProcessor:
         in_donate = (0,) if self._donate_input else ()
         self._jit_process = jax.jit(self._process, donate_argnums=in_donate)
         self._jit_process_batch = None  # built lazily (micro-batch mode)
-        if self.staged:
+        if self.staged and not self.front_fuse:
             # natural (pre-canonicalization) shape of the stage (a)
             # intermediate, recovered inside stage (b) by a fused
             # metadata reshape (abstract trace only — no compile, no run)
@@ -325,6 +404,8 @@ class SegmentProcessor:
             self._a_nat_shape = jax.eval_shape(
                 self._stage_a_nat,
                 jax.ShapeDtypeStruct((expected,), jnp.uint8)).shape
+        if self.front_fuse:
+            self._init_front_fuse()
         self._jit_stage_a = jax.jit(self._stage_a, donate_argnums=in_donate)
         # the staged intermediates are consumed exactly once, so stages
         # donate their inputs — and because every boundary shares the
@@ -460,12 +541,19 @@ class SegmentProcessor:
         return (self._process(raw, chirp_ri, chirp_w_ri),
                 raw[self.stride_bytes:])
 
-    def _stage_a_ring(self, carry: jnp.ndarray, new: jnp.ndarray):
-        raw = jnp.concatenate([carry, new])
+    def _stage_a_with_carry(self, raw: jnp.ndarray):
+        """Shared body of the staged ring variants: stage (a) — in
+        whichever spelling the plan resolved, classic or front-fused —
+        plus the next carry sliced from the same assembled raw view.
+        One home, so the warm/cold twins (and any future variant)
+        cannot drift apart."""
         return self._stage_a(raw), raw[self.stride_bytes:]
 
+    def _stage_a_ring(self, carry: jnp.ndarray, new: jnp.ndarray):
+        return self._stage_a_with_carry(jnp.concatenate([carry, new]))
+
     def _stage_a_cold(self, raw: jnp.ndarray):
-        return self._stage_a(raw), raw[self.stride_bytes:]
+        return self._stage_a_with_carry(raw)
 
     def _process_batch_ring(self, carry: jnp.ndarray, new_b: jnp.ndarray,
                             chirp_ri: jnp.ndarray, chirp_w_ri=None):
@@ -497,6 +585,8 @@ class SegmentProcessor:
         name = ("staged" if self.staged else "fused") + f":{strategy}"
         if self.fused_tail:
             name += "+ftail"
+        if self.front_fuse:
+            name += "+ffuse"
         if self._skzap:
             name += "+skzap"
         if self.ring:
@@ -690,15 +780,113 @@ class SegmentProcessor:
         return x.reshape(2, -1, self.n_spectrum)
 
     def _stage_a(self, raw: jnp.ndarray):
+        if self.front_fuse:
+            return self._stage_a_front(raw)
         return self._boundary_canon(self._stage_a_nat(raw))
 
-    def _stage_b(self, a_ri: jnp.ndarray):
+    def _stage_b(self, a_ri, aux=None):
+        if self.front_fuse:
+            return self._stage_b_front(a_ri, aux)
         return self._boundary_canon(
             self._stage_b_nat(a_ri.reshape(self._a_nat_shape)))
 
+    def _run_stage_b(self, a):
+        """Dispatch the stage-(a) boundary into the jitted stage (b).
+        The front-fused boundary is (canonical, accumulators) passed
+        as TWO program arguments so only the canonical leaf is donated
+        — donating the [S, 3, 128] aux (which has no output aval to
+        alias) would be a dropped-donation warning on every compile."""
+        if self.front_fuse:
+            return self._jit_stage_b(*a)
+        return self._jit_stage_b(a)
+
     def _stage_c(self, spec_ri: jnp.ndarray):
-        return self._stage_c_nat(
-            spec_ri.reshape(2, spec_ri.shape[1], -1))
+        x = spec_ri.reshape(2, spec_ri.shape[1], -1)
+        if self.front_fuse:
+            # the front-fused stage (b) emits the dedispersed spectrum
+            # in pass-2's k1-major blocked order; unblock here so the
+            # XLA transpose fuses into this program's first read (the
+            # waterfall row view / complex assembly)
+            n1, n2 = self._ffuse_fac
+            x = jnp.swapaxes(x.reshape(2, x.shape[1], n1, n2),
+                             -1, -2).reshape(2, x.shape[1], -1)
+        return self._stage_c_nat(x)
+
+    # ---- front-fused staged stages (the staged_ffuse plan family) ----
+
+    def _init_front_fuse(self) -> None:
+        """Precompute the front-fuse plan constants: the factorization,
+        the even/odd-split blocked window view, the blocked RFI keep
+        mask, and the chirp parameters of pass 2's epilogue."""
+        from srtb_tpu.ops import pallas_fft2 as pf2
+        self._ffuse_fac = pf2.ffuse_factor(self.n_spectrum)
+        n1, n2 = self._ffuse_fac
+        self._ffuse_window = None
+        if self.window is not None:
+            w = np.asarray(self.window)
+            self._ffuse_window = (
+                jnp.asarray(np.ascontiguousarray(
+                    w[0::2].reshape(n1, n2))),
+                jnp.asarray(np.ascontiguousarray(
+                    w[1::2].reshape(n1, n2))))
+        self._ffuse_mask = None
+        if self.rfi_mask is not None:
+            # natural [m] zap mask -> blocked [n1, n2] KEEP multiplier
+            # (bin k = k2*n1 + k1 lives at [k1, k2])
+            keep = 1.0 - np.asarray(self.rfi_mask, np.float32)
+            self._ffuse_mask = jnp.asarray(np.ascontiguousarray(
+                keep.reshape(n2, n1).T))
+        self._ffuse_chirp = dict(
+            f_min=float(self.f_min), df=float(self.df),
+            f_c=float(self.f_c), dm=float(self.cfg.dm))
+
+    def _stage_a_front(self, raw: jnp.ndarray):
+        """Front-fused stage (a): the raw uint8 segment goes straight
+        into the pass-1 megakernel (in-kernel unpack + window +
+        even/odd pack + column FFT + four-step twiddle) — HBM pass 1
+        is one raw-byte read + one blocked-intermediate write.  The
+        boundary is (canonical intermediate, [S, 3, 128] RFI-s1
+        mean-power accumulators)."""
+        from srtb_tpu.ops import pallas_fft2 as pf2
+        br, bi, aux = pf2.pass1_front(
+            raw, m=self.n_spectrum, streams=self.fmt.data_stream_count,
+            variant=self.fmt.unpack_variant,
+            nbits=int(self.cfg.baseband_input_bits),
+            window_eo=self._ffuse_window,
+            interpret=self._pallas_interpret)
+        return self._boundary_canon(jnp.stack([br, bi])), aux
+
+    def _stage_b_front(self, a_ri, aux):
+        """Front-fused stage (b): pass 2 emits the dedispersed
+        spectrum directly — row FFT + in-kernel Hermitian post +
+        RFI-s1 zap/normalize/mask (threshold from the pass-1
+        accumulators, no spectrum-sized re-read) + the in-register
+        df64 chirp, all in pass 2's epilogue.  The chirp is always the
+        bankless spelling here because staged plans never materialize
+        a chirp bank (see __init__: at 2^30 it would hold 4 GB of HBM
+        for the segment's lifetime) and front fusion requires the
+        staged plan — pass2_spectrum's premul operands exist for the
+        kernel's own generality (tests, future non-staged callers).
+        Output is the canonical boundary holding the blocked spectrum
+        (stage (c) unblocks with a fused metadata transpose)."""
+        from srtb_tpu.ops import pallas_fft2 as pf2
+        n1, n2 = self._ffuse_fac
+        b = a_ri.reshape(2, -1, n1, n2)
+        thr = jnp.float32(
+            self.cfg.mitigate_rfi_average_method_threshold) \
+            * pf2.front_mean_power(aux, n2, self.n_spectrum)
+        outs = []
+        for s in range(b.shape[1]):
+            sr, si = pf2.pass2_spectrum(
+                b[0, s], b[1, s], thr=thr[s], norm=self.norm_coeff,
+                mask_blocked=self._ffuse_mask,
+                chirp=self._ffuse_chirp,
+                interpret=self._pallas_interpret)
+            outs.append((sr, si))
+        spec_ri = jnp.stack([
+            jnp.stack([o[0] for o in outs]),
+            jnp.stack([o[1] for o in outs])])  # [2, S, n1, n2] blocked
+        return self._boundary_canon(spec_ri)
 
     def _stage_a_nat(self, raw: jnp.ndarray):
         """unpack + even/odd pack + segment-FFT first half."""
@@ -935,7 +1123,7 @@ class SegmentProcessor:
         "mitigate_rfi_spectral_kurtosis_threshold",
         "mitigate_rfi_freq_list", "baseband_reserve_sample",
         "fft_strategy", "fft_len_cap", "use_pallas", "use_pallas_sk",
-        "use_emulated_fp64", "fused_tail", "chirp_exact",
+        "use_emulated_fp64", "fused_tail", "front_fuse", "chirp_exact",
         # overlap-engine trace shapers: micro_batch_segments changes the
         # traced program (vmapped batch plan) outright;
         # inflight_segments shapes the runtime's donation/aliasing
@@ -1014,6 +1202,11 @@ class SegmentProcessor:
              # strategy flips monolithic <-> four_step across the
              # threshold) must miss the AOT cache cleanly
              "fused_tail": self.fused_tail,
+             # resolved front fusion: the staged_ffuse programs have
+             # different boundary pytrees (canonical + accumulators)
+             # and a blocked stage-(b) spectrum — an AOT cache written
+             # by either spelling must miss cleanly for the other
+             "front_fuse": self.front_fuse,
              "skzap": self._skzap,
              "hbm_passes": self.hbm_passes,
              # resolved ingest plan: the ring's two-input assemble
@@ -1054,7 +1247,11 @@ class SegmentProcessor:
         # wrapper would defeat the AOT independence above.
         if self.staged:
             a_out = jax.eval_shape(self._stage_a, raw_s)
-            b_out = jax.eval_shape(self._stage_b, a_out)
+            # the front-fused stage-(a) boundary is (canonical, aux)
+            # passed as two program args so only the canonical leaf is
+            # donated (see _run_stage_b)
+            b_args = tuple(a_out) if self.front_fuse else (a_out,)
+            b_out = jax.eval_shape(self._stage_b, *b_args)
             progs = [
                 ("stage_a",
                  # srtb-lint: disable=recompile-hazard
@@ -1062,7 +1259,7 @@ class SegmentProcessor:
                  (raw_s,), in_donate),
                 # srtb-lint: disable=recompile-hazard
                 ("stage_b", jax.jit(self._stage_b, donate_argnums=(0,)),
-                 (a_out,), (0,)),
+                 b_args, (0,)),
                 # srtb-lint: disable=recompile-hazard
                 ("stage_c", jax.jit(self._stage_c, donate_argnums=(0,)),
                  (b_out,), (0,)),
@@ -1158,11 +1355,12 @@ class SegmentProcessor:
             # chain the boundary avals by abstract evaluation (free:
             # trace only, no compile)
             a_out = jax.eval_shape(self._stage_a, raw_s)
-            b_out = jax.eval_shape(self._stage_b, a_out)
+            b_args = tuple(a_out) if self.front_fuse else (a_out,)
+            b_out = jax.eval_shape(self._stage_b, *b_args)
             self._jit_stage_a = cache.get_or_compile(
                 "stage_a", sig, self._jit_stage_a, raw_s)
             self._jit_stage_b = cache.get_or_compile(
-                "stage_b", sig, self._jit_stage_b, a_out)
+                "stage_b", sig, self._jit_stage_b, *b_args)
             self._jit_stage_c = cache.get_or_compile(
                 "stage_c", sig, self._jit_stage_c, b_out)
             if self.ring:
@@ -1406,7 +1604,7 @@ class SegmentProcessor:
                 # can never reach this chain's read
                 a = self._jit_stage_a(
                     raw)  # srtb-lint: disable=use-after-donate
-                return self._jit_stage_c(self._jit_stage_b(a))
+                return self._jit_stage_c(self._run_stage_b(a))
 
             return self._timed_first("staged", _run_staged)
 
@@ -1433,7 +1631,11 @@ class SegmentProcessor:
         donated regardless of the raw-input policy, so its expiry must
         not be gated on ``self._donate_input``."""
         from srtb_tpu.analysis import sanitizer as S
-        S.check_contract("stage_a boundary", a, lead=2,
+        # the front-fused boundary is (canonical, accumulators); the
+        # contract applies to the canonical leaf, the NaN tripwire to
+        # the whole pytree
+        canon = a[0] if isinstance(a, tuple) else a
+        S.check_contract("stage_a boundary", canon, lead=2,
                          dtype=jnp.float32)
         S.check_finite("stage_a boundary", a)
         if self._donate_input if donated is None else donated:
@@ -1446,7 +1648,7 @@ class SegmentProcessor:
         """Stages (b) + (c) under the sanitizer (the shared back half
         of run_device and the ring variants)."""
         from srtb_tpu.analysis import sanitizer as S
-        b = self._jit_stage_b(a)  # donates a (checked above, by value)
+        b = self._run_stage_b(a)  # donates a (checked above, by value)
         S.check_contract("stage_b boundary", b, lead=2,
                          dtype=jnp.float32)
         S.check_finite("stage_b boundary", b)
@@ -1472,7 +1674,7 @@ class SegmentProcessor:
                 # b/c stages compile on first dispatch too
                 a, nc = self._jit_stage_a_ring(carry, new)
                 if not self._sanitize:
-                    return self._jit_stage_c(self._jit_stage_b(a)), nc
+                    return self._jit_stage_c(self._run_stage_b(a)), nc
                 # sanctioned holder: _staged_a_checks expires the
                 # carry, which is donated UNCONDITIONALLY (unlike the
                 # raw input)
@@ -1509,7 +1711,7 @@ class SegmentProcessor:
                 # whole chain under one timer (see run_device)
                 a, nc = self._jit_stage_a_cold(raw)
                 if not self._sanitize:
-                    return self._jit_stage_c(self._jit_stage_b(a)), nc
+                    return self._jit_stage_c(self._run_stage_b(a)), nc
                 # sanctioned holder: _staged_a_checks expires the
                 # donated input
                 return self._staged_tail(self._staged_a_checks(

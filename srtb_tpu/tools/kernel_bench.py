@@ -160,6 +160,43 @@ def main(argv=None) -> int:
     record("unpack 2-bit + window (blocked order)", dt,
            f"[{n // 4}]u8->[{n}]f32", n)
 
+    # ---- front-fused pass 1 (staged_ffuse tentpole): raw bytes ->
+    # blocked intermediate in ONE kernel (in-kernel unpack + even/odd
+    # pack + column FFT + four-step twiddle) vs the separate
+    # unpack-then-pass1 chain it replaces (XLA unpack + pack_even_odd
+    # materializing the spectrum-sized z, then the packed pass-1
+    # kernel).  Interpret-mode on CPU (functional smoke); real Mosaic
+    # on accelerators — THE ffuse probe rows the FFUSE_MOSAIC_OK flag
+    # in ops/pallas_fft2 waits on (tools_tpu_r9_queue.sh).
+    from srtb_tpu.ops import fft as F
+    from srtb_tpu.ops import pallas_fft2 as pf2
+    m_half = n // 2
+    if pf2.ffuse_factor(m_half) is not None:
+        interp = jax.default_backend() in ("cpu",)
+        ffuse_raw = jax.device_put(
+            rng.integers(0, 256, n // 4, dtype=np.uint8))
+        fused_front = jax.jit(lambda b: pf2.pass1_front(
+            b, m=m_half, streams=1, variant="simple", nbits=2,
+            interpret=interp)[0])
+        try:
+            dt = _time(fused_front, ffuse_raw, reps=reps)
+            record("unpack + even/odd + FFT pass 1 (ffuse, 1 kernel)",
+                   dt, f"[{n // 4}]u8->[{m_half}]c64-blocked", n)
+
+            fn1, fn2 = pf2.ffuse_factor(m_half)
+
+            def separate(b):
+                z = F.pack_even_odd(U.unpack(b, 2, None))
+                return pf2.pass1_2d(jnp.real(z).reshape(fn1, fn2),
+                                    jnp.imag(z).reshape(fn1, fn2),
+                                    interpret=interp)[0]
+            dt = _time(jax.jit(separate), ffuse_raw, reps=reps)
+            record("unpack -> pack -> FFT pass 1 (separate, z "
+                   "materialized)", dt,
+                   f"[{n // 4}]u8->[{m_half}]c64-blocked", n)
+        except Exception as e:  # pragma: no cover
+            print(json.dumps({"kernel": "ffuse pass1", "error": str(e)}))
+
     # complex arrays are built on device from real transfers: some TPU
     # runtimes (axon tunnel) cannot transfer complex64 host<->device, and
     # one failed complex transfer poisons all later transfers

@@ -1,0 +1,97 @@
+#!/bin/bash
+# Round-9 TPU hardware backlog: the front-fused staged megakernel
+# (staged_ffuse, ISSUE 15) — fold unpack -> forward-FFT pass 1 into
+# the pallas2 row-FFT kernel and the Hermitian + RFI-s1 + chirp tail
+# into pass 2's epilogue, staged hbm_passes 4 -> 2.  These legs are
+# BOTH the A/B measurement and the Mosaic acceptance probe the
+# FFUSE_MOSAIC_OK flag (ops/pallas_fft2.py) waits on: front_fuse=on
+# forces the kernels, and a Mosaic rejection demotes down the audited
+# ladder onto today's staged plan (the run still lands a row — check
+# the row's "front_fuse"/"plan" fields to see which plan actually
+# measured; plan=...+ffuse means Mosaic ACCEPTED, flip the flag).
+# On top of the still-undrained r8 backlog.  Safe to re-run; each
+# block is independent.  Run from the repo root with the TPU visible
+# (tools_tpu_watcher.sh fires it automatically).
+#
+#   bash tools_tpu_r9_queue.sh [quick]
+#
+# "quick" drains only the new r9 rows (skips the r8 backlog and the
+# long 2^30 blocks).
+set -u
+OUT=${SRTB_PERF_OUT:-PERF_TPU.jsonl}
+stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
+note() { echo "{\"ts\": \"$(stamp)\", \"variant\": \"note\", \"note\": \"$1\"}" >> "$OUT"; }
+run() {
+  local tag="$1"; shift
+  echo "== $tag =="
+  local line
+  line=$("$@" 2>/dev/null | grep '^{' | tail -1)
+  if [ -n "$line" ]; then
+    echo "{\"ts\": \"$(stamp)\", \"variant\": \"$tag\", \"result\": $line}" >> "$OUT"
+    echo "$line"
+  else
+    echo "{\"ts\": \"$(stamp)\", \"variant\": \"$tag\", \"error\": true}" >> "$OUT"
+  fi
+}
+
+QUICK=${1:-}
+
+# ---- 0. the r8 backlog first (archive + periodicity legs) ----
+if [ "$QUICK" != "quick" ] && [ -f tools_tpu_r8_queue.sh ]; then
+  note "r9 queue: draining r8 backlog first"
+  bash tools_tpu_r8_queue.sh quick
+fi
+
+note "r9 queue start: front-fused staged megakernel (staged_ffuse) A/B + Mosaic probe"
+
+# ---- 1. kernel-level probe rows: fused unpack+pass1 vs the separate
+#          unpack-then-pass1 chain (real Mosaic — the FFUSE_MOSAIC_OK
+#          acceptance evidence), plus the rest of the kernel zoo for
+#          context.  An error row here = Mosaic balked; keep the flag
+#          False and file the rejection text.
+run ffuse_kernels_27 env SRTB_BENCH_DEADLINE=900 \
+    python -m srtb_tpu.tools.kernel_bench --log2n 27 --reps 5
+
+# ---- 2. staged_ffuse A/B at 2^27 (forced staged so both legs run the
+#          three-program chain; pallas2 rows are the ffuse
+#          prerequisite and the off-leg's measured baseline).
+run staged_ffuse_off_27 env SRTB_BENCH_LOG2N=27 SRTB_BENCH_STAGED=1 \
+    SRTB_BENCH_FFT_STRATEGY=four_step SRTB_STAGED_ROWS_IMPL=pallas2 \
+    SRTB_BENCH_FRONT_FUSE=off SRTB_BENCH_DEADLINE=1200 python bench.py
+run staged_ffuse_on_27 env SRTB_BENCH_LOG2N=27 SRTB_BENCH_STAGED=1 \
+    SRTB_BENCH_FFT_STRATEGY=four_step SRTB_STAGED_ROWS_IMPL=pallas2 \
+    SRTB_BENCH_FRONT_FUSE=on SRTB_BENCH_DEADLINE=1200 python bench.py
+
+# ---- 3. ffuse + ring at 2^27 (the carry alias surviving the fusion,
+#          measured: warm stride uploads + the 2-sweep front together)
+run staged_ffuse_ring_27 env SRTB_BENCH_LOG2N=27 SRTB_BENCH_STAGED=1 \
+    SRTB_BENCH_FFT_STRATEGY=four_step SRTB_STAGED_ROWS_IMPL=pallas2 \
+    SRTB_BENCH_FRONT_FUSE=on SRTB_BENCH_RING=on \
+    SRTB_BENCH_DEADLINE=1200 python bench.py
+
+if [ "$QUICK" = "quick" ]; then exit 0; fi
+
+# ---- 4. the production staged shape, 2^30: the target this fusion
+#          exists for (393 Msamp/s at round 2 — the front half's
+#          un-fused passes are the largest single-plan traffic block
+#          left on the board).
+#          (fused_tail forced on: the ffuse epilogue IS the fused
+#          tail, and "auto" gates bankless df64 fusion above 2^27 —
+#          the r6 staged_fused_on_30 override, same reasoning)
+run staged_ffuse_off_30 env SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 \
+    SRTB_BENCH_STAGED=1 SRTB_BENCH_FFT_STRATEGY=four_step \
+    SRTB_STAGED_ROWS_IMPL=pallas2 SRTB_BENCH_FUSED_TAIL=on \
+    SRTB_BENCH_FRONT_FUSE=off \
+    SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=2700 python bench.py
+#          (SRTB_PALLAS2_VMEM_MB=112: the fused footprint models say
+#          the 2^30 floor blocks need ~82-94 MiB — over the default
+#          80 MiB budget but inside v5e's 128 MiB physical; give the
+#          probe the headroom rather than measuring a guaranteed
+#          vmem_limit rejection)
+run staged_ffuse_on_30 env SRTB_BENCH_LOG2N=30 SRTB_BENCH_LOG2CHAN=15 \
+    SRTB_BENCH_STAGED=1 SRTB_BENCH_FFT_STRATEGY=four_step \
+    SRTB_STAGED_ROWS_IMPL=pallas2 SRTB_BENCH_FUSED_TAIL=on \
+    SRTB_BENCH_FRONT_FUSE=on SRTB_PALLAS2_VMEM_MB=112 \
+    SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=2700 python bench.py
+
+note "r9 queue done"
