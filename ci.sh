@@ -24,13 +24,13 @@ if [ "${1:-}" = "--hardware" ]; then
   exit 0
 fi
 
-echo "== [1/18] native build =="
+echo "== [1/19] native build =="
 make -C srtb_tpu/native
 
-echo "== [2/18] native sanitizer harness (ASan/UBSan) =="
+echo "== [2/19] native sanitizer harness (ASan/UBSan) =="
 make -C srtb_tpu/native check
 
-echo "== [3/18] static checks (compile + import) =="
+echo "== [3/19] static checks (compile + import) =="
 python -m compileall -q srtb_tpu tests bench.py __graft_entry__.py
 python - <<'EOF'
 import importlib, pkgutil
@@ -45,7 +45,7 @@ assert not bad, bad
 print(f"all srtb_tpu modules import cleanly")
 EOF
 
-echo "== [4/18] srtb-lint (static analysis vs baseline) =="
+echo "== [4/19] srtb-lint (static analysis vs baseline) =="
 # fails on findings not in srtb_tpu/analysis/baseline.json; accept an
 # intentional finding with --write-baseline + a note, or a pragma.
 # The machine-readable run lands next to the other CI artifacts.
@@ -54,7 +54,7 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.lint srtb_tpu/ \
   --format json > artifacts/lint.json \
   || { cat artifacts/lint.json; exit 1; }
 
-echo "== [5/18] plan audit (compile-time HLO cards vs baseline) =="
+echo "== [5/19] plan audit (compile-time HLO cards vs baseline) =="
 # AOT-lowers every plan family and audits the compiled artifacts:
 # spectrum-sized HBM sweeps vs the declared hbm_passes floor, donation
 # proven aliased (not silently dropped), no f64/host-callback/
@@ -66,7 +66,7 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.plan_audit \
   --out artifacts/plan_cards_audit.json
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.plan_audit --selftest
 
-echo "== [6/18] pytest (8-device CPU mesh) =="
+echo "== [6/19] pytest (8-device CPU mesh) =="
 FAST_ARGS=()
 if [ "${1:-}" = "--fast" ]; then
   # one source of truth for what "slow" means: the pytest marker
@@ -75,11 +75,11 @@ if [ "${1:-}" = "--fast" ]; then
 fi
 python -m pytest tests/ -q "${FAST_ARGS[@]}"
 
-echo "== [7/18] bench smoke (with the roofline/audit cross-check) =="
+echo "== [7/19] bench smoke (with the roofline/audit cross-check) =="
 JAX_PLATFORMS=cpu SRTB_BENCH_LOG2N=16 SRTB_BENCH_AUDIT=1 \
   python bench.py | tail -1
 
-echo "== [8/18] fused-plan parity (spectrum-pass fusion, Pallas interpret on CPU) =="
+echo "== [8/19] fused-plan parity (spectrum-pass fusion, Pallas interpret on CPU) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import numpy as np
 
@@ -155,13 +155,13 @@ print(f"ffuse parity OK: plan {ffuse.plan_name} (hbm_passes "
       f"{staged.hbm_passes}), decisions bit-identical")
 EOF
 
-echo "== [9/18] ring parity smoke (incremental H2D ring on vs off, Pallas interpret) =="
+echo "== [9/19] ring parity smoke (incremental H2D ring on vs off, Pallas interpret) =="
 # The ISSUE-8 acceptance gate: ring-on output is bit-identical to
 # ring-off on a Pallas-kernel plan (interpret mode on CPU), and the
 # per-segment h2d_bytes counter equals the stride model exactly — the
 # full segment on the one cold dispatch, stride_bytes (segment minus
 # the reserved overlap tail) on every warm dispatch.  The plan-audit
-# stage [5/18] already proved the carry donation is a real alias for
+# stage [5/19] already proved the carry donation is a real alias for
 # every ring-v1 family; this proves the runtime keeps its half of the
 # contract.
 JAX_PLATFORMS=cpu python - <<'EOF'
@@ -224,7 +224,7 @@ print(f"ring parity OK: plan {proc.plan_name}, {s_on.segments} segments "
       f"{proc.reserved_bytes / seg_b:.1%} per warm segment)")
 EOF
 
-echo "== [10/18] telemetry + sanitizer smoke (journal + report + /metrics + /healthz + Config.sanitize) =="
+echo "== [10/19] telemetry + sanitizer smoke (journal + report + /metrics + /healthz + Config.sanitize) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import json, os, tempfile, urllib.request
 
@@ -260,7 +260,7 @@ assert recs, "telemetry journal is empty"
 # every record: device-time accounting + live roofline + compile/cache
 # books must ride every span, not just /metrics
 for rec in recs:
-    assert rec["v"] == 8, rec
+    assert rec["v"] == 9, rec
     assert "overlap_hidden_ms" in rec and rec["inflight_depth"] >= 1, rec
     for key in ("degrade_level", "retries", "requeues", "restarts",
                 "device_ms", "achieved_msamps", "roofline_frac",
@@ -316,7 +316,7 @@ print(f"sanitizer smoke OK: {stats_s.segments} segments with "
       "Config.sanitize on, tripwire restored")
 EOF
 
-echo "== [11/18] fault-injection smoke (one transient fault at every site -> recovery + v8 telemetry) =="
+echo "== [11/19] fault-injection smoke (one transient fault at every site -> recovery + v8 telemetry) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import json, os, tempfile
 
@@ -383,7 +383,7 @@ assert "srtb_retries_total 6" in prom, prom[:400]
 assert "srtb_faults_injected 6" in prom
 # v3 journal fields + report resilience section
 recs = TR.load(journal)
-assert recs and all(r["v"] == 8 for r in recs)
+assert recs and all(r["v"] == 9 for r in recs)
 # the checkpoint-site retry of the last segment lands after that
 # segment's journal write: the final record carries 5 of the 6
 assert recs[-1]["retries"] == 5 and recs[-1]["requeues"] == 0
@@ -394,7 +394,7 @@ print(f"fault-injection smoke OK: {st1.segments} segments recovered "
       "/metrics + v8 journal")
 EOF
 
-echo "== [12/18] chaos smoke (self-healing compute: oom + compile_fail + device_halt in one run) =="
+echo "== [12/19] chaos smoke (self-healing compute: oom + compile_fail + device_halt in one run) =="
 # The ISSUE-9 acceptance gate: a deterministic fault plan injecting all
 # three device-fault classes completes with accounted-only loss,
 # detection decisions identical to the clean run, and the
@@ -408,7 +408,7 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.chaos_soak --segments 6 \
   | tail -1
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.chaos_soak --selftest
 
-echo "== [13/18] crash-soak smoke (SIGKILL exactly-once: manifest recovery + fsck + bit-identical union) =="
+echo "== [13/19] crash-soak smoke (SIGKILL exactly-once: manifest recovery + fsck + bit-identical union) =="
 # The ISSUE-10 acceptance gate, CI-sized: a deterministic two-kill plan
 # — one SIGKILL mid-checkpoint-flush (between sink commit and the
 # checkpoint update, the duplicate-on-resume window) and one mid-
@@ -423,11 +423,11 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.crash_soak --segments 5 \
   --kills 2 --kill-plan "ckpt_stall@1,rename@1" --log2n 13 | tail -1
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.fsck --selftest
 
-echo "== [14/18] multichip dryrun (8 virtual devices) =="
+echo "== [14/19] multichip dryrun (8 virtual devices) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== [15/18] fleet smoke (multi-tenant bulkheads: 3 streams, 1 victim, shared plan cache) =="
+echo "== [15/19] fleet smoke (multi-tenant bulkheads: 3 streams, 1 victim, shared plan cache) =="
 # The ISSUE-11 acceptance gate, CI-sized: 3 seeded streams on one
 # device, a stream-selector fault plan injected into stream0 (oom ->
 # victim-only demotion, plus a transient sink fault and a fetch
@@ -442,7 +442,7 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.fleet_soak --streams 3 \
   --segments 4 --log2n 12 | tail -1
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.fleet_soak --selftest
 
-echo "== [16/18] archive-replay smoke (full-throughput replay: SIGTERM resume + bit-identical union + micro-batch tolerance) =="
+echo "== [16/19] archive-replay smoke (full-throughput replay: SIGTERM resume + bit-identical union + micro-batch tolerance) =="
 # The ISSUE-12 acceptance gate, CI-sized: a 2-file fleet-fanned replay
 # (deterministic timestamps, per-file checkpoint + manifest namespaces)
 # killed by a SIGTERM steered into one lane's sink-write window, then
@@ -454,7 +454,7 @@ echo "== [16/18] archive-replay smoke (full-throughput replay: SIGTERM resume + 
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.archive_replay --selftest \
   --segments 4 --log2n 13 | tail -1
 
-echo "== [17/18] trace/incident smoke (causal tracing + flight recorder + bundle + Chrome-trace export) =="
+echo "== [17/19] trace/incident smoke (causal tracing + flight recorder + bundle + Chrome-trace export) =="
 # The ISSUE-13 acceptance gate, CI-sized: a clean traced run proves
 # every segment leaves a complete ingest->dispatch->fetch->sink causal
 # chain whose export is valid Chrome-trace JSON (schema-checked, flow
@@ -541,7 +541,95 @@ print(f"trace/incident smoke OK: {stats.segments} traced segments "
       f"{meta['trace_id']}")
 EOF
 
-echo "== [18/18] perf-gate smoke (noise-aware regression gate + ledger trajectory) =="
+echo "== [18/19] canary + quality smoke (pulse-injection sensitivity gate + quality report artifact) =="
+# The ISSUE-16 acceptance gate, CI-sized.  Leg 1 (clean): a file-mode
+# run with the canary on and the quality epilogue enabled must inject,
+# recover, and PASS every sensitivity check (auto-calibrated expected
+# S/N), journal v9 quality + canary extras, and keep the science
+# outputs silent (canary segments quarantined).  Leg 2 (degraded): the
+# same run with 61/64 channels zapped and the clean run's measured S/N
+# pinned as the expectation must FAIL the sensitivity check, degrade
+# detection health, and drop an incident bundle carrying the canary
+# verdict + quality timeline.  The quality report renders both runs
+# into the CI artifact set.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json, os, tempfile
+
+import numpy as np
+
+from srtb_tpu.config import Config
+from srtb_tpu.pipeline.runtime import Pipeline
+from srtb_tpu.utils import telemetry
+from srtb_tpu.utils.metrics import metrics
+
+tmp = tempfile.mkdtemp(prefix="srtb_ci_canary_")
+n, segments = 1 << 14, 4
+rng = np.random.default_rng(7)
+rng.normal(128, 8, n * segments).clip(0, 255).astype("uint8").tofile(
+    os.path.join(tmp, "noise.bin"))
+
+def cfg(tag, **kw):
+    return Config(baseband_input_count=n, baseband_input_bits=8,
+                  baseband_freq_low=1405.0, baseband_bandwidth=64.0,
+                  baseband_sample_rate=128e6, dm=0.0,
+                  input_file_path=os.path.join(tmp, "noise.bin"),
+                  baseband_output_file_prefix=os.path.join(tmp, tag),
+                  spectrum_channel_count=1 << 6,
+                  mitigate_rfi_average_method_threshold=100.0,
+                  mitigate_rfi_spectral_kurtosis_threshold=2.0,
+                  baseband_reserve_sample=False, writer_thread_count=0,
+                  retry_backoff_base_s=0.001, inflight_segments=3,
+                  quality_stats=True, canary_every_segments=2,
+                  stream_name="ci",
+                  telemetry_journal_path=os.path.join(
+                      tmp, f"{tag}.jsonl"), **kw)
+
+# leg 1: clean run -> every canary recovered, science outputs silent
+with Pipeline(cfg("clean"), sinks=[]) as pipe:
+    stats = pipe.run()
+assert stats.segments == segments and stats.signals == 0
+checked = metrics.get("canary_checked")
+failed = metrics.get("canary_failed")
+expected = metrics.get("canary_last_snr")
+assert checked == 2 and failed == 0, (checked, failed)
+assert expected > 5.0, expected
+spans = [json.loads(ln) for ln in open(os.path.join(tmp, "clean.jsonl"))
+         if ln.strip().startswith("{")]
+spans = [r for r in spans if r.get("type") == "segment_span"]
+assert all(r["v"] == 9 and "quality" in r for r in spans)
+assert sum(1 for r in spans if "canary" in r) == 2
+metrics.reset()
+
+# leg 2: zap 61/64 channels out from under the pulse -> gate FAILS
+inc = os.path.join(tmp, "incidents")
+with Pipeline(cfg("deg", mitigate_rfi_freq_list="1405-1466",
+                  canary_expected_snr=expected, incident_dir=inc,
+                  incident_min_interval_s=0.0), sinks=[]) as pipe:
+    pipe.run()
+assert metrics.get("canary_failed") >= 1
+assert metrics.get("detection_health_state") == 1
+health = telemetry.health()
+assert health["detection"]["state"] == "degraded"
+bundles = [d for d in os.listdir(inc) if "canary_sensitivity" in d]
+assert bundles, os.listdir(inc)
+extra = json.load(open(os.path.join(inc, bundles[0], "extra.json")))
+assert extra["canary"]["ok"] is False and extra["quality_timeline"]
+with open("artifacts/canary_journal_path.txt", "w") as fh:
+    fh.write(os.path.join(tmp, "clean.jsonl"))
+print(f"canary smoke OK: clean run recovered S/N {expected:.2f} "
+      f"({checked} checks, quarantined); degraded run failed the "
+      f"sensitivity gate and produced {bundles[0]}")
+EOF
+# the science-observatory artifact: render the clean leg's journal
+CANARY_JOURNAL=$(cat artifacts/canary_journal_path.txt)
+python -m srtb_tpu.tools.quality_report "$CANARY_JOURNAL" \
+  --format json > artifacts/quality_report.json
+python -m srtb_tpu.tools.quality_report "$CANARY_JOURNAL" \
+  > artifacts/quality_report.md
+grep -q '"canary"' artifacts/quality_report.json
+grep -q '## Canary' artifacts/quality_report.md
+
+echo "== [19/19] perf-gate smoke (noise-aware regression gate + ledger trajectory) =="
 # The ISSUE-14 acceptance gate: (a) the gate's selftest proves an
 # injected dispatch-path slowdown (Config.fault_plan stall) FAILS the
 # statistical gate while a clean rerun passes within the COMPUTED

@@ -441,6 +441,65 @@ class Config:
     slo_fast_window_s: float = 300.0
     slo_slow_window_s: float = 3600.0
     slo_burn_threshold: float = 1.0
+    # sensitivity objective (pulse-injection canary feed): allowed
+    # fraction of FAILED canary checks before the burn rate reads 1.0
+    # (> 0 arms; needs canary_every_segments > 0 to get observations)
+    slo_sensitivity_budget: float = 0.0
+    # ---- science observatory (srtb_tpu/quality/) ----
+    # on-device per-segment data-quality statistics as a cheap
+    # epilogue side-output of the segment plans: zapped-bin fraction,
+    # coarse RFI occupancy map, spectral-kurtosis summary, bandpass
+    # mean/variance + EWMA drift detector, dead/hot channel flags —
+    # exported as quality_* gauges, journaled on segment spans
+    # (telemetry v9) and rendered by tools/quality_report.py.  Enters
+    # the traced program (trace-relevant: plans with/without the
+    # epilogue are different programs and miss the AOT cache cleanly).
+    quality_stats: bool = False
+    # coarse bins of the occupancy/bandpass maps (trace-relevant:
+    # static output shape)
+    quality_coarse_bins: int = 64
+    # a channel is DEAD below this multiple of the median channel
+    # power, HOT above the hot multiple (trace-relevant constants)
+    quality_dead_threshold: float = 0.1
+    quality_hot_threshold: float = 10.0
+    # read every k-th spectrum bin / waterfall sample for the quality
+    # statistics (trace-relevant).  Telemetry does not need every bin:
+    # subsampling scales the epilogue's read volume — and the producer
+    # recompute XLA sometimes chooses for a second consumer — down by
+    # k, which is what keeps the epilogue under the perf gate's noise
+    # floor on the CPU path.  1 = exact statistics.
+    quality_subsample: int = 8
+    # host-side EWMA drift detector on the bandpass mean: alert when
+    # an observation sits more than quality_drift_threshold EWMA
+    # sigmas from the running mean (alpha = smoothing weight)
+    quality_drift_threshold: float = 4.0
+    quality_drift_alpha: float = 0.05
+    # ---- pulse-injection canary (srtb_tpu/quality/canary.py) ----
+    # inject a deterministic synthetic dispersed pulse into the RAW
+    # uint8 stream every N segments (0 = off) and check the recovered
+    # S/N at the detection stage.  Canary segments are quarantined
+    # from science outputs (signals gate + candidate sinks) and
+    # flagged in journal + run manifest; non-canary artifacts stay
+    # bit-identical to a canary-off run.  8-bit 'simple' format only.
+    canary_every_segments: int = 0
+    # per-sample pulse amplitude in digitizer counts (the 8-bit
+    # digitizer model keeps ~3 sigma full-scale, i.e. noise sigma
+    # ~42.5 counts — 25 is a comfortably-detectable burst)
+    canary_amp: float = 25.0
+    # burst width in raw samples
+    canary_width: int = 32
+    # dispersion measure of the injected pulse (< 0 = use `dm`, so
+    # the search recovers it coherently by default)
+    canary_dm: float = -1.0
+    # pulse start as a fraction of the segment's non-overlapped span
+    canary_position: float = 0.5
+    # expected recovered S/N; 0 = auto-calibrate from the first
+    # checked canary of the run (the calibration is journaled)
+    canary_expected_snr: float = 0.0
+    # a canary FAILS when recovered/expected drops below this ratio
+    # — drives detection_health_state, /healthz detection section,
+    # the SLO sensitivity objective and an incident bundle
+    canary_min_ratio: float = 0.5
     # ---- performance observatory ----
     # HBM peak (GB/s) the live roofline_frac gauge divides by (v5e
     # public number by default; set per accelerator generation).  The
@@ -514,6 +573,8 @@ class Config:
         "periodicity_candidates", "periodicity_fold_bins",
         "periodicity_min_bin", "events_ring_size",
         "incident_max_bundles", "profile_capture_segments",
+        "quality_coarse_bins", "quality_subsample",
+        "canary_every_segments", "canary_width",
     })
     _FLOAT_FIELDS = frozenset({
         "baseband_freq_low", "baseband_bandwidth", "baseband_sample_rate",
@@ -530,13 +591,17 @@ class Config:
         "slo_latency_budget", "slo_loss_budget", "slo_staleness_s",
         "slo_staleness_budget", "slo_fast_window_s",
         "slo_slow_window_s", "slo_burn_threshold", "hbm_peak_gbps",
+        "slo_sensitivity_budget", "quality_dead_threshold",
+        "quality_hot_threshold", "quality_drift_threshold",
+        "quality_drift_alpha", "canary_amp", "canary_dm",
+        "canary_position", "canary_expected_snr", "canary_min_ratio",
     })
     _BOOL_FIELDS = frozenset({
         "baseband_reserve_sample", "baseband_write_all", "gui_enable",
         "use_emulated_fp64", "use_pallas", "use_pallas_sk", "sanitize",
         "degrade_enable", "chirp_exact", "manifest_fsync",
         "manifest_hash", "deterministic_timestamps", "events_enable",
-        "telemetry_journal_compress",
+        "telemetry_journal_compress", "quality_stats",
     })
     _LIST_FIELDS = frozenset({
         "udp_receiver_address", "udp_receiver_port",

@@ -620,6 +620,19 @@ class RunManifest:
         events.emit("manifest.done", seg=int(key[1]),
                     info=str(key[2]))
 
+    def canary(self, stream: int, seg: int, abs_index: int,
+               ok: bool = True) -> None:
+        """Flag a pulse-injection canary segment (quality/canary.py):
+        offline consumers can prove the quarantine — which drain
+        indices carried a synthetic pulse, and whether each passed
+        the sensitivity gate.  Carries no recovery state; the scanner
+        (and fsck) tolerate it like the "run" stamp."""
+        self._append({"t": "canary", "stream": int(stream),
+                      "seg": int(seg), "abs": int(abs_index),
+                      "ok": bool(ok)})
+        events.emit("manifest.canary", seg=int(seg),
+                    info=f"abs={int(abs_index)} ok={bool(ok)}")
+
     def checkpoint(self, segments_done: int,
                    file_offset_bytes: int) -> None:
         # the consistency point is always durable: it seals every

@@ -559,6 +559,13 @@ class WriteAllSink:
 
     sheddable = True  # degradation ladder: baseband dumps shed at L2
     last_push_wrote = True  # every push appends: always seal done
+    # canary quarantine (pipeline/runtime._push_sinks): this sink
+    # appends the PRISTINE seg.data — the injected pulse never reaches
+    # it — and its output is a contiguous byte stream, so skipping a
+    # canary segment would corrupt the append continuity, not protect
+    # anything.  Science-product sinks (waterfall writers) stay
+    # non-exempt and are skipped for canary segments.
+    canary_exempt = True
 
     def __init__(self, cfg: Config, reserved_bytes: int,
                  data_stream_id: int = 0, writer_pool=None):

@@ -84,6 +84,11 @@ class DetectResult(NamedTuple):
     signal_counts: jnp.ndarray       # [n_boxcars] int32: samples over threshold
     boxcar_series: jnp.ndarray       # [n_boxcars, T] f32 (rows zero-padded at tail)
     snr_peaks: jnp.ndarray           # [n_boxcars] f32: max SNR per boxcar
+    # data-quality epilogue side-output (srtb_tpu/quality/stats.py
+    # packed [S, N_SCALARS + 2*B] vector; None unless
+    # Config.quality_stats armed the epilogue — None is an empty
+    # pytree subtree, so every existing consumer is unaffected)
+    quality: jnp.ndarray | None = None
 
 
 def time_series_error_gates(k_ch: int, t_len: int, ts_raw_max: float,

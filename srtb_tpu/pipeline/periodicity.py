@@ -62,6 +62,10 @@ class PeriodicityResult(NamedTuple):
     # ln(M*L), NOT near 0, so an uncorrected sigma threshold fires
     # on pure noise at any realistic series length)
     candidate_trials: tuple = (1, 1)
+    # data-quality epilogue side-output (same contract as
+    # DetectResult.quality; kept LAST so positional construction of
+    # the periodicity fields above stays stable)
+    quality: jnp.ndarray | None = None
 
     # ---- mode hooks consumed by MODE-BLIND shared code: the engine
     # (runtime.has_signal), the candidate writer and the journal all
@@ -181,9 +185,13 @@ class PeriodicitySegmentProcessor(SegmentProcessor):
         m = det.time_series.shape[-1] // 2 + 1
         levels = P.harmonic_levels(harmonics)
         return wf_ri, PeriodicityResult(
-            *det,
+            # single-pulse fields by position, epilogue fields by name
+            # (DetectResult grew an optional quality tail — a bare
+            # *det splat would land it on candidate_bins)
+            *det[:6],
             candidate_bins=cands.bins,
             candidate_snr=cands.snr,
             candidate_harmonics=cands.harmonics,
             folded_profiles=cands.profiles,
-            candidate_trials=(max(m - min_bin, 1), len(levels)))
+            candidate_trials=(max(m - min_bin, 1), len(levels)),
+            quality=det.quality)

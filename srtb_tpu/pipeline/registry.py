@@ -250,6 +250,12 @@ def _resolved_staged(cfg, staged):
     return staged_resolves(cfg, staged)
 
 
+def _apply_quality(cfg, staged):
+    if not getattr(cfg, "quality_stats", False):
+        return None
+    return cfg.replace(quality_stats=False), staged
+
+
 def _apply_search_mode(cfg, staged):
     if str(getattr(cfg, "search_mode", "single_pulse")
            or "single_pulse").lower() == "single_pulse":
@@ -339,6 +345,10 @@ def _apply_monolithic(cfg, staged):
         fft_strategy="monolithic"), False
 
 
+register_step(LadderStep(
+    "quality", "drop the data-quality epilogue (telemetry, not "
+    "science) — the very cheapest thing to shed",
+    _apply_quality))
 register_step(LadderStep(
     "search_mode", "drop the extra search mode (periodicity folding) "
     "back to single-pulse — the cheapest science to shed",
@@ -501,6 +511,17 @@ for _fam in (
                donate=True, staged=True,
                env={"SRTB_STAGED_ROWS_IMPL": "pallas2"},
                hbm_passes=2),
+    # ---- data-quality epilogue (srtb_tpu/quality/): cheap jnp
+    # reductions over the spectrum + waterfall ride the detect tail
+    # as a side output.  The extra traffic is coarse-bin-sized, so
+    # the spectrum-sized hbm_passes floor stays the base plan's;
+    # ladder=False because the quality rung (FIRST in the order)
+    # sheds the epilogue and must never demote INTO it.
+    PlanFamily("four_step_ftail_quality", "fused-tail four-step plan "
+               "with the data-quality epilogue side output",
+               {"fft_strategy": "four_step", "fused_tail": "on",
+                "quality_stats": True},
+               donate=True, hbm_passes=5, ladder=False),
     # ---- periodicity search mode: the single-pulse chain PLUS the
     # harmonic-summed power spectrum + phase folding over the
     # dedispersed time series (pipeline/periodicity.py).  The extra

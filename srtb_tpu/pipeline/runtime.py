@@ -428,6 +428,23 @@ class Pipeline:
                 compress=bool(getattr(cfg,
                                       "telemetry_journal_compress",
                                       True)))
+        # ---- science observatory (srtb_tpu/quality/) ----
+        # data-quality monitor (gauges + drift detector + journal
+        # payload for the plans' quality epilogue) and the pulse-
+        # injection canary; both are the zero-cost-off None hook
+        from srtb_tpu.quality import QualityMonitor
+        self.quality = QualityMonitor.from_config(cfg)
+        self.canary = None
+        if int(getattr(cfg, "canary_every_segments", 0) or 0) > 0:
+            from srtb_tpu.ops import dedisperse as dd
+            from srtb_tpu.quality import CanaryController
+            self.canary = CanaryController.from_config(
+                cfg, n_samples=cfg.baseband_input_count,
+                reserved_samples=dd.nsamps_reserved(cfg))
+        # canary schedule base: the engines set this to the
+        # checkpoint's resume-continuous drain count at run start, so
+        # "every N-th segment" means the same segments across resumes
+        self._canary_base = 0
 
     @contextlib.contextmanager
     def _stage(self, name: str):
@@ -571,6 +588,17 @@ class Pipeline:
         counts = getattr(det_res, "signal_counts", None)
         if counts is not None:
             det_count = int(np.asarray(counts).sum())
+        # quality epilogue -> gauges + drift detector (journal or not:
+        # /metrics must carry the quality state of a journal-less run)
+        quality_extra = None
+        if self.quality is not None:
+            qvec = getattr(det_res, "quality", None)
+            if qvec is not None:
+                # drain-side on a fetched result: the blocking fetch
+                # already materialized every det_res leaf
+                host_q = np.asarray(qvec)  # srtb-lint: disable=sync-hot-path
+                quality_extra = self.quality.observe(
+                    host_q, segment=index)
         if self.journal is not None:
             # registered-mode hook: a result type with its own span
             # payload (e.g. the periodicity candidate table) journals
@@ -578,6 +606,18 @@ class Pipeline:
             # the positive gate withholds the file dumps
             span_extra = getattr(det_res, "span_extra", None)
             extra = span_extra() if span_extra is not None else None
+            if quality_extra is not None:
+                extra = dict(extra or {}, quality=quality_extra)
+            # canary flag: the full verdict when the drain scored one
+            # this life; the bare injection mark on a replayed drain
+            # (exactly-once check already done by a previous life)
+            verdict = getattr(seg, "canary_verdict", None)
+            if verdict is None and getattr(seg, "canary",
+                                           None) is not None:
+                verdict = {"injected": True,
+                           "segment": seg.canary["segment"]}
+            if verdict is not None:
+                extra = dict(extra or {}, canary=verdict)
             self.journal.write(telemetry.segment_span(
                 index, span, queue_depth, det_count, positive, n_samples,
                 timestamp_ns=getattr(seg, "timestamp", 0),
@@ -704,15 +744,19 @@ class Pipeline:
         return slo.tracker if self._slo_armed else None
 
     def _incident(self, kind: str, reason: str = "",
-                  trace: int | None = None) -> None:
+                  trace: int | None = None,
+                  extra: dict | None = None) -> None:
         """Dump an incident bundle (None-hook off; best-effort,
-        rate-limited and bounded by the recorder)."""
+        rate-limited and bounded by the recorder).  ``extra`` is an
+        arbitrary JSON-able payload landing as ``extra.json`` in the
+        bundle — e.g. the canary verdict + quality timeline."""
         if self.incidents is not None:
             self.incidents.dump(
                 kind, reason=reason, trace=trace, stream=self.stream,
                 cfg=self.cfg, processor=self.processor,
                 journal_path=getattr(self.cfg,
-                                     "telemetry_journal_path", ""))
+                                     "telemetry_journal_path", ""),
+                extra=extra)
 
     # ------------------------------------------------- ingest ring state
 
@@ -766,12 +810,16 @@ class Pipeline:
         and the requeued segment's own carry is already history."""
         proc = self.processor
         stage_in = proc.stage_input
+        # canary-injected copy when attached (the delta is zero over
+        # the head/tail reserved spans, so the warm stride slice and
+        # the adopted carry stay consistent with a cold dispatch)
+        data = self._device_bytes(seg)
         carry = None if requeue or not self._ring_adjacent(seg) \
             else self._ring_carry
         if carry is not None:
             self._ring_carry = None  # consumed below (donated)
             staged = self._op("h2d", index,
-                              lambda: stage_in(seg.data,
+                              lambda: stage_in(data,
                                                stride_only=True))
             attempt = [0]
 
@@ -780,7 +828,7 @@ class Pipeline:
                 if attempt[0] == 1:
                     return proc.run_device_ring(carry, staged)
                 # the failed warm attempt consumed the carry: go cold
-                return proc.run_device_cold(stage_in(seg.data))
+                return proc.run_device_cold(stage_in(data))
 
             out, next_carry = self._op("dispatch", index, run_it)
         else:
@@ -789,14 +837,14 @@ class Pipeline:
                                  trace=getattr(seg, "trace_id", 0),
                                  stream=self.stream, seg=index,
                                  info="requeue" if requeue else "")
-            staged = self._op("h2d", index, lambda: stage_in(seg.data))
+            staged = self._op("h2d", index, lambda: stage_in(data))
             first = [True]
 
             def run_it():
                 if first[0]:
                     first[0] = False
                     return proc.run_device_cold(staged)
-                return proc.run_device_cold(stage_in(seg.data))
+                return proc.run_device_cold(stage_in(data))
 
             out, next_carry = self._op("dispatch", index, run_it)
         if not requeue:
@@ -809,6 +857,89 @@ class Pipeline:
             self._ring_prev = ((getattr(seg, "data_stream_id", 0), seq)
                                if seq >= 0 else None)
         return out
+
+    # ------------------------------------------- pulse-injection canary
+
+    def _canary_prepare(self, seg, index: int) -> None:
+        """Dispatch-side canary hook: on a scheduled segment, attach
+        the injected COPY (``seg.canary_data``) and the injection mark
+        (``seg.canary``).  Device staging reads the copy through
+        :meth:`_device_bytes`; every sink keeps seeing the pristine
+        ``seg.data``, so science outputs stay bit-identical to a
+        canary-off run.  Idempotent: a watchdog requeue or healed
+        re-dispatch reuses the already-attached copy (same bytes —
+        the delta is deterministic — and the injected counter stays
+        exactly-once)."""
+        c = self.canary
+        if c is None or getattr(seg, "canary", None) is not None:
+            return
+        data, mark = c.prepare(self._canary_base + index, seg.data)
+        if mark is None:
+            return
+        try:
+            seg.canary = mark
+            seg.canary_data = data
+        except AttributeError:  # read-only stub segments: no canary
+            log.warning("[canary] segment cannot carry the injection "
+                        "mark; skipping")
+
+    def _device_bytes(self, seg):
+        """The host bytes the DEVICE stages: the canary-injected copy
+        when one is attached, else the segment's pristine buffer.
+        Also the staging-release key — the staging registry keys on
+        ``id()`` of whatever buffer was staged."""
+        d = getattr(seg, "canary_data", None)
+        return seg.data if d is None else d
+
+    def _canary_drain(self, seg, mark: dict, det_res,
+                      sinks_done: set, drain_index: int) -> bool:
+        """Drain-side canary handling: score the recovered S/N
+        against the expected reference, flag the segment in the run
+        manifest, and escalate a sensitivity regression as an
+        incident bundle with the recent quality timeline attached.
+        Exactly-once under sink retry / supervisor replay via the
+        "canary" marker in ``sinks_done`` (sink entries are ints, no
+        collision).  Returns the QUARANTINED positive verdict —
+        always False: a synthetic pulse must never count as science
+        (no ``signals`` bump, no candidate dumps)."""
+        if "canary" in sinks_done:
+            return False
+        sinks_done.add("canary")
+        verdict = None
+        if self.canary is not None:
+            # drain-side on a fetched result (same sanction as the
+            # quality observe in _record_segment)
+            peaks = np.asarray(  # srtb-lint: disable=sync-hot-path
+                getattr(det_res, "snr_peaks", 0.0))
+            verdict = self.canary.check(mark["segment"], peaks)
+        try:
+            seg.canary_verdict = verdict  # journaled by _record_segment
+        except AttributeError:
+            pass
+        if self.manifest is not None:
+            self.manifest.canary(
+                getattr(seg, "data_stream_id", 0), drain_index,
+                mark["segment"],
+                ok=bool(verdict.get("ok", True)) if verdict else True)
+        if verdict is not None and not verdict.get("ok", True):
+            if self.events is not None:
+                self.events.emit(
+                    "canary.regression",
+                    trace=getattr(seg, "trace_id", 0),
+                    stream=self.stream, seg=mark["segment"],
+                    info=f"ratio={verdict.get('ratio')}")
+            self._incident(
+                "canary_sensitivity",
+                reason=(f"canary segment {mark['segment']}: recovered "
+                        f"S/N {verdict.get('snr')} is "
+                        f"{verdict.get('ratio')}x the expected "
+                        f"{verdict.get('expected')}"),
+                trace=getattr(seg, "trace_id", 0),
+                extra={"canary": dict(mark, **verdict),
+                       "quality_timeline":
+                           (self.quality.timeline()
+                            if self.quality is not None else [])})
+        return False
 
     def _dispatch_segment(self, seg, ingest_s: float,
                           offset_after: int, index: int = 0,
@@ -825,13 +956,15 @@ class Pipeline:
         tid = getattr(seg, "trace_id", 0)
         if self.events is not None:
             events.set_current(tid, self.stream)
+        self._canary_prepare(seg, index)
+        data = self._device_bytes(seg)
         with self._stage("dispatch"):
             stage_in = getattr(self.processor, "stage_input", None)
             if self._ring_live:
                 wf, det_res = self._dispatch_ring(seg, index, requeue)
             elif stage_in is not None:
                 staged = self._op("h2d", index,
-                                  lambda: stage_in(seg.data))
+                                  lambda: stage_in(data))
                 first = [True]
 
                 def run_it():
@@ -843,13 +976,13 @@ class Pipeline:
                         first[0] = False
                         return self.processor.run_device(staged)
                     return self.processor.run_device(
-                        stage_in(seg.data))
+                        stage_in(data))
 
                 wf, det_res = self._op("dispatch", index, run_it)
             else:  # duck-typed stub processors (tests)
                 wf, det_res = self._op(
                     "dispatch", index,
-                    lambda: self.processor.process(seg.data))
+                    lambda: self.processor.process(data))
         span = {"ingest": ingest_s,
                 "dispatch": self.stage_timer.last["dispatch"]}
         if self.events is not None:
@@ -872,6 +1005,8 @@ class Pipeline:
         whole batch dispatch runs under the first segment's "dispatch"
         fault site (one jit call = one failure domain)."""
         t0 = time.perf_counter()
+        for i, s in enumerate(segs):
+            self._canary_prepare(s, first_index + i)
         with trace_annotation("srtb:dispatch"):
             if self._ring_live:
                 wf_b, det_b = self._dispatch_batch_ring(segs, first_index)
@@ -879,10 +1014,11 @@ class Pipeline:
                 stack = getattr(self.processor, "stack_batch", None)
                 # host byte buffers, never device arrays: the
                 # contiguous wrap is a no-op for the sources' ndarrays
-                stacked = (stack([s.data for s in segs])
+                datas = [self._device_bytes(s) for s in segs]
+                stacked = (stack(datas)
                            if stack is not None else
-                           np.stack([np.ascontiguousarray(s.data)
-                                     for s in segs]))
+                           np.stack([np.ascontiguousarray(d)
+                                     for d in datas]))
                 wf_b, det_b = self._op(
                     "dispatch", first_index,
                     lambda: self.processor.process_batch(stacked))
@@ -918,7 +1054,7 @@ class Pipeline:
             == getattr(a, "data_stream_id", 0)
             for a, b in zip(segs, segs[1:]))
         carry = self._ring_carry if chain_ok else None
-        datas = [s.data for s in segs]
+        datas = [self._device_bytes(s) for s in segs]
         if carry is not None:
             self._ring_carry = None  # consumed below (donated)
             attempt = [0]
@@ -995,6 +1131,13 @@ class Pipeline:
             cfg, det_res,
             frequency_bin_count=(wf.shape[-2] if wf is not None
                                  else None))
+        cmark = getattr(seg, "canary", None)
+        if cmark is not None:
+            # quarantine: the canary's recovered S/N is scored and
+            # journaled, then the segment is forced NEGATIVE — the
+            # synthetic pulse never counts as science
+            positive = self._canary_drain(seg, cmark, det_res,
+                                          sinks_done, drained[0])
         # the "stats" marker rides in sinks_done (sink entries are
         # ints, no collision): a supervisor replay of a crashed drain
         # re-enters this body, and the first attempt may already have
@@ -1045,7 +1188,9 @@ class Pipeline:
         # returning a staging buffer whose transfer is still in flight
         rel = getattr(self.processor, "release_staging", None)
         if rel is not None:
-            rel(seg.data)
+            # the staging registry keys on id() of the STAGED buffer
+            # — the canary-injected copy when one was attached
+            rel(self._device_bytes(seg))
         # file mode: sinks never retain segments (no piggybank deque),
         # so the host buffer can go back to the pool for the reader
         pool = getattr(self.source, "pool", None)
@@ -1120,6 +1265,9 @@ class Pipeline:
             self.profile_capture.start()
         n_samples_per_seg = cfg.baseband_input_count
         drained = [self.checkpoint.segments_done if self.checkpoint else 0]
+        # resume-continuous canary schedule: dispatch indices restart
+        # at 0 every run, so the absolute index is base + index
+        self._canary_base = drained[0]
         # ring carry starts cold every run: a checkpoint-resumed (or
         # simply restarted) process has no device-resident tail, so the
         # first dispatch is a full upload that re-arms the ring
@@ -1262,7 +1410,7 @@ class Pipeline:
             # staged transfer has provably completed.
             rel = getattr(self.processor, "release_staging", None)
             if rel is not None:
-                rel(seg.data)
+                rel(self._device_bytes(seg))
             pool = getattr(self.source, "pool", None)
             if pool is not None and cfg.input_file_path:
                 pool.release(seg.data)
@@ -1904,8 +2052,22 @@ class Pipeline:
         light = full if self.keep_waterfall else SegmentResultWork(
             segment=seg, waterfall=None, detect=det_res)
         m = self.manifest
+        canary = getattr(seg, "canary", None) is not None
         for i, sink in enumerate(self.sinks):
             if done is not None and i in done:
+                continue
+            if canary and not getattr(sink, "canary_exempt", False):
+                # quarantine: results derived from the injected bytes
+                # (the waterfall, the detect series) must never become
+                # science artifacts — not even through the candidate
+                # writer's negative piggybank.  Only sinks declaring
+                # ``canary_exempt`` still receive the segment: the
+                # contiguous baseband appender (WriteAllSink) sees the
+                # PRISTINE seg.data and must keep its byte-stream
+                # continuity (skipping it would corrupt the output,
+                # not protect it).
+                if done is not None:
+                    done.add(i)
                 continue
             key = None
             if m is not None and seg_key is not None:
@@ -2166,6 +2328,8 @@ class ThreadedPipeline(Pipeline):
         it = iter(self.source)
         count = [0]
         drained = [self.checkpoint.segments_done if self.checkpoint else 0]
+        # same resume-continuous canary schedule as the async engine
+        self._canary_base = drained[0]
 
         def source_f(stop_token, _):
             if max_segments is not None and count[0] >= max_segments:
@@ -2194,12 +2358,14 @@ class ThreadedPipeline(Pipeline):
             if self.events is not None:
                 events.set_current(getattr(seg, "trace_id", 0),
                                    self.stream)
+            self._canary_prepare(seg, index)
+            data = self._device_bytes(seg)
             with self._stage("dispatch"):
                 while True:
                     try:
                         wf, det_res = self._op(
                             "dispatch", index,
-                            lambda: self.processor.process(seg.data))
+                            lambda: self.processor.process(data))
                         break
                     except BaseException as e:  # noqa: BLE001
                         # plan demotion works here exactly like the
@@ -2258,13 +2424,18 @@ class ThreadedPipeline(Pipeline):
                 cfg, det_res,
                 frequency_bin_count=(wf.shape[-2] if wf is not None
                                      else None))
+            done = set()  # retries stay exactly-once per sink
+            cmark = getattr(seg, "canary", None)
+            if cmark is not None:
+                # same quarantine as the async engine's _drain_body
+                positive = self._canary_drain(seg, cmark, det_res,
+                                              done, drained[0])
             if positive:
                 self.stats.signals += 1
             # ingest-order index for the fault/retry sites (the drain
             # counter below stays the journal's resume-continuous
             # numbering, same split as the async engine)
             seg_index = index
-            done = set()  # retries stay exactly-once per sink
             mkey = (None if self.manifest is None
                     else (getattr(seg, "data_stream_id", 0),
                           drained[0]))

@@ -940,12 +940,18 @@ class SegmentProcessor:
         tail.  With ``chirp_ri=None`` the df64 chirp is generated inside
         the trace (fuses into the multiply; nothing bank-sized is
         materialized)."""
-        return self._waterfall_detect(self._apply_s1_chirp(spec, chirp_ri))
+        chirped, qtap = self._apply_s1_chirp(spec, chirp_ri)
+        return self._waterfall_detect(chirped, qspec=qtap)
 
     def _apply_s1_chirp(self, spec: jnp.ndarray, chirp_ri):
         """RFI stage 1 + manual mask + chirp multiply as standalone
         spectrum sweeps (the passes the fused tail folds into the FFT's
-        final write)."""
+        final write).  Returns ``(chirped, qtap)`` where ``qtap`` is
+        the spectrum the quality epilogue should read bin powers from:
+        the chirp is unit-modulus, so the PRE-chirp zapped/normalized
+        spectrum has bin-identical power and zeros — and reading it
+        keeps the (expensive, error-free-transform) df64 chirp chain
+        out of the epilogue's fusion producers."""
         cfg = self.cfg
         interp = getattr(self, "_pallas_interpret", False)
         from srtb_tpu.ops import pallas_kernels as pk
@@ -964,11 +970,15 @@ class SegmentProcessor:
                     cfg.dm, mask=self.rfi_mask, interpret=interp,
                     exact=getattr(cfg, "chirp_exact", False))
                 outs.append(jax.lax.complex(out_ri[0], out_ri[1]))
-            return jnp.stack(outs)
+            out = jnp.stack(outs)
+            # the Pallas kernel materializes its output: reading it
+            # again is one cheap pass, no producer duplication
+            return out, out
         spec = rfi.mitigate_rfi_average_and_normalize(
             spec, cfg.mitigate_rfi_average_method_threshold,
             self.norm_coeff)
         spec = rfi.mitigate_rfi_manual(spec, self.rfi_mask)
+        qtap = spec  # pre-chirp: bin powers/zeros identical post-chirp
         if chirp_ri is None:
             # In-step df64 chirp without Pallas (staged plan on the
             # jnp path).  The XLA df64 chirp's optimization_barriers
@@ -985,17 +995,26 @@ class SegmentProcessor:
                     cfg.dm, interpret=interp,
                     exact=getattr(cfg, "chirp_exact", False))
                 outs.append(jax.lax.complex(out_ri[0], out_ri[1]))
-            return jnp.stack(outs)
+            return jnp.stack(outs), qtap
         chirp = jax.lax.complex(chirp_ri[0], chirp_ri[1])
-        return dd.dedisperse(spec, chirp)
+        return dd.dedisperse(spec, chirp), qtap
 
-    def _waterfall_detect(self, spec: jnp.ndarray):
+    def _waterfall_detect(self, spec: jnp.ndarray, qspec=None):
         """Waterfall backward C2C + RFI stage 2 + detection from an
         already-dedispersed spectrum.  With the fully-fused skzap plan
         (fused tail + use_pallas + use_pallas_sk + VMEM-resident rows)
         the whole tail is ONE kernel per stream — the detect stage never
-        re-reads the waterfall from HBM."""
+        re-reads the waterfall from HBM.
+
+        ``qspec`` is the spectrum the quality epilogue reads bin powers
+        from when it differs from ``spec`` (the unfused jnp path hands
+        the PRE-chirp zapped/normalized spectrum — power-identical,
+        and it keeps the df64 chirp chain out of the epilogue's XLA
+        fusion producers, which otherwise duplicates it at ~40%
+        per-segment cost on the CPU path)."""
         cfg = self.cfg
+        if qspec is None:
+            qspec = spec
         use_pallas = cfg.use_pallas
         interp = getattr(self, "_pallas_interpret", False)
         from srtb_tpu.ops import pallas_kernels as pk
@@ -1023,6 +1042,7 @@ class SegmentProcessor:
                 jnp.stack(ts_rows)[:, :t], jnp.stack(zero_counts),
                 cfg.signal_detect_signal_noise_threshold,
                 cfg.signal_detect_max_boxcar_length)
+            result = self._quality_epilogue(qspec, wf, result)
             wf_ri = jnp.stack([jnp.real(wf), jnp.imag(wf)])
             return wf_ri, result
         from srtb_tpu.ops import pallas_fft as pf
@@ -1100,9 +1120,31 @@ class SegmentProcessor:
             result = det.detect(wf, self.time_reserved_count,
                                 cfg.signal_detect_signal_noise_threshold,
                                 cfg.signal_detect_max_boxcar_length)
+        result = self._quality_epilogue(qspec, wf, result)
         # boundary representation: waterfall leaves jit as stacked (re, im)
         wf_ri = jnp.stack([jnp.real(wf), jnp.imag(wf)])  # [2, S, F, T]
         return wf_ri, result
+
+    def _quality_epilogue(self, spec: jnp.ndarray, wf: jnp.ndarray,
+                          result):
+        """Data-quality statistics rider (srtb_tpu/quality/stats.py):
+        with ``Config.quality_stats`` armed, pack the per-stream
+        quality vector from the spectrum and waterfall ALREADY
+        resident in this trace and attach it to the detect result —
+        two cheap extra reads inside every plan family, no new plan.
+        Off (the default) this is an exact no-op: existing plans trace
+        byte-identically."""
+        cfg = self.cfg
+        if not getattr(cfg, "quality_stats", False):
+            return result
+        from srtb_tpu.quality import stats as Q
+        qvec = Q.quality_stats_device(
+            spec, wf,
+            int(getattr(cfg, "quality_coarse_bins", 64) or 64),
+            float(getattr(cfg, "quality_dead_threshold", 0.1)),
+            float(getattr(cfg, "quality_hot_threshold", 10.0)),
+            subsample=int(getattr(cfg, "quality_subsample", 1) or 1))
+        return result._replace(quality=qvec)
 
     # ------------------------------------------------------------------
     # AOT warm restart (utils/aot_cache.py): replace the jit wrappers
@@ -1134,6 +1176,15 @@ class SegmentProcessor:
         # the ingest ring adds the two-input assemble programs and
         # changes which program the engine dispatches per segment
         "ingest_ring",
+        # quality epilogue: armed/off changes the traced program (the
+        # detect result grows the packed stats output), and the bin
+        # count / channel thresholds are trace-time constants shaping
+        # it — host-side quality knobs (drift detector) and the
+        # canary (raw-byte injection upstream of the trace) are
+        # deliberately NOT here
+        "quality_stats", "quality_coarse_bins",
+        "quality_dead_threshold", "quality_hot_threshold",
+        "quality_subsample",
     )
 
     @classmethod

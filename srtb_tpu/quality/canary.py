@@ -1,0 +1,223 @@
+"""End-to-end pulse-injection canary.
+
+Quality statistics (stats.py) say the *data* looks healthy; only a
+known signal proves the *search* still finds signals.  Every
+``Config.canary_every_segments``-th segment, a deterministic synthetic
+dispersed pulse of known DM / amplitude / t0 is added to the raw uint8
+stream right before device staging — upstream of unpack, FFT, RFI
+mitigation, dedispersion and detection, so the recovered S/N exercises
+the whole science chain.  At drain the recovered S/N is checked
+against the expected value; the sensitivity ratio drives the
+``detection_health_state`` gauge, the /healthz detection section, the
+SLO sensitivity objective, and (on a regression) an incident bundle
+with the recent quality timeline attached.
+
+Injection is quarantined by construction:
+
+- the pulse is added to a **copy** of the segment's bytes; the pristine
+  buffer is what every sink sees, so ``baseband_write_all`` output is
+  bit-identical to a canary-off run;
+- the delta is zeroed over the first and last ``reserved`` samples of
+  the segment: the head is the overlap region (device-resident carry
+  in ring mode), the tail becomes the NEXT segment's head/carry — a
+  canary must never leak one byte into a neighboring science segment;
+- canary segments are excluded from the ``signals`` gate and the
+  science sinks by the engine (pipeline/runtime.py), and flagged in
+  the journal span + run manifest so offline consumers can prove the
+  quarantine.
+
+Expected S/N is **auto-calibrated** by default
+(``canary_expected_snr = 0``): the first checked canary of a run sets
+the reference (journaled as ``calibrated``), and later canaries must
+recover at least ``canary_min_ratio`` of it — robust across
+geometries without an analytic radiometer model.  CI's smoke stage
+instead measures a clean run's recovered S/N and passes it explicitly
+to a degraded run to prove the gate has teeth.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from srtb_tpu.utils.logging import log
+from srtb_tpu.utils.metrics import metrics
+
+# deterministic pulse-shape seed: the SAME broadband noise burst in
+# every run, every resume, every process — canary recovery must be
+# bit-identical across checkpoint resume
+PULSE_SEED = 1644
+
+HEALTH_OK = 0
+HEALTH_DEGRADED = 1
+
+
+class CanaryController:
+    """Deterministic injection schedule + sensitivity gate.  ``None``
+    (from_config) when ``canary_every_segments`` is 0 — the zero-cost-
+    off None-hook pattern shared with the sanitizer and faults."""
+
+    def __init__(self, cfg, n_samples: int, reserved_samples: int = 0,
+                 stream: str = ""):
+        self.every = int(cfg.canary_every_segments)
+        self.amp = float(getattr(cfg, "canary_amp", 25.0))
+        self.width = int(getattr(cfg, "canary_width", 32))
+        dm = float(getattr(cfg, "canary_dm", -1.0))
+        self.dm = dm if dm >= 0 else float(cfg.dm)
+        self.position = float(getattr(cfg, "canary_position", 0.5))
+        self.expected = float(getattr(cfg, "canary_expected_snr", 0.0))
+        self.min_ratio = float(getattr(cfg, "canary_min_ratio", 0.5))
+        self.calibrated = self.expected > 0
+        self.n = int(n_samples)
+        self.reserved = int(reserved_samples)
+        self.stream = str(stream or "")
+        self._f_min = float(cfg.baseband_freq_low)
+        self._bw = float(cfg.baseband_bandwidth)
+        self._delta: np.ndarray | None = None
+        self.t0 = 0
+        metrics.set("detection_health_state", HEALTH_OK)
+        if self.stream:
+            metrics.set("detection_health_state", HEALTH_OK,
+                        labels={"stream": self.stream})
+
+    @classmethod
+    def from_config(cls, cfg, n_samples: int | None = None,
+                    reserved_samples: int = 0) -> "CanaryController | None":
+        if int(getattr(cfg, "canary_every_segments", 0) or 0) <= 0:
+            return None
+        # injection edits raw bytes, so it must know the byte<->sample
+        # map: gated to the 8-bit single-stream "simple" layout (the
+        # flagship geometry); other formats get a loud skip, never a
+        # silently wrong pulse
+        if (cfg.baseband_input_bits != 8
+                or cfg.baseband_format_type not in ("", "simple")):
+            log.warning(
+                "[canary] injection supports 8-bit 'simple' baseband "
+                f"only (got {cfg.baseband_input_bits}-bit "
+                f"{cfg.baseband_format_type!r}); canary disabled")
+            return None
+        return cls(cfg,
+                   n_samples=int(n_samples
+                                 if n_samples is not None
+                                 else cfg.baseband_input_count),
+                   reserved_samples=int(reserved_samples),
+                   stream=str(getattr(cfg, "stream_name", "") or ""))
+
+    # ---------------------------------------------------- injection
+
+    def is_canary(self, abs_index: int) -> bool:
+        """Absolute (resume-continuous) segment index -> scheduled?
+        The first canary lands on segment ``every - 1``, never on the
+        cold first segment."""
+        return (int(abs_index) + 1) % self.every == 0
+
+    def _build_delta(self) -> np.ndarray:
+        """The additive int16 byte-delta of ONE canary injection:
+        a width-``canary_width`` broadband noise burst of per-sample
+        amplitude ``canary_amp`` digitizer counts at t0, dispersed by
+        the same medium model as io/synth.make_dispersed_baseband
+        (inverse of the dedispersion chirp), rounded to counts — then
+        explicitly zeroed over the head and tail ``reserved`` spans
+        (overlap/ring-carry quarantine, see module docstring)."""
+        from srtb_tpu.ops import dedisperse as dd
+
+        n = self.n
+        rng = np.random.default_rng(PULSE_SEED)
+        usable = max(n - 2 * self.reserved - self.width, 1)
+        self.t0 = self.reserved + int(self.position * usable)
+        pulse = np.zeros(n)
+        w = min(self.width, n - self.t0)
+        pulse[self.t0:self.t0 + w] = self.amp * rng.standard_normal(w)
+        n_spec = n // 2
+        df = self._bw / n_spec
+        f_c = self._f_min + self._bw
+        chirp = dd.chirp_factor_host(n_spec, self._f_min, df, f_c,
+                                     self.dm)
+        spec = np.fft.rfft(pulse)
+        spec[:n_spec] *= np.conj(chirp)  # disperse (medium model)
+        sig = np.fft.irfft(spec, n)
+        delta = np.round(sig).astype(np.int16)
+        if self.reserved:
+            delta[:self.reserved] = 0
+            delta[-self.reserved:] = 0
+        return delta
+
+    def prepare(self, abs_index: int,
+                data: np.ndarray) -> tuple[np.ndarray, dict | None]:
+        """Dispatch-side hook: returns ``(device_bytes, mark)``.
+        Non-canary segments pass ``data`` through untouched (no copy);
+        a canary segment gets the pulse added to a COPY (clipped to
+        the uint8 range) — the caller keeps pushing the pristine
+        ``data`` to sinks."""
+        if not self.is_canary(abs_index):
+            return data, None
+        if self._delta is None or len(self._delta) != len(data):
+            if len(data) != self.n:
+                # a partial tail segment (file end) has a different
+                # byte<->time map than the built delta: skip, loudly
+                log.warning(f"[canary] segment {abs_index}: "
+                            f"unexpected size {len(data)} != {self.n}; "
+                            "skipping injection")
+                return data, None
+            self._delta = self._build_delta()
+        out = np.clip(data.astype(np.int16) + self._delta, 0,
+                      255).astype(np.uint8)
+        metrics.add("canary_injected")
+        if self.stream:
+            metrics.add("canary_injected",
+                        labels={"stream": self.stream})
+        mark = {"segment": int(abs_index), "t0": int(self.t0),
+                "dm": self.dm, "amp": self.amp, "width": self.width}
+        return out, mark
+
+    # --------------------------------------------------------- check
+
+    def check(self, abs_index: int, snr_peaks) -> dict:
+        """Drain-side hook for a canary segment: recovered S/N (max
+        over boxcars, host values) against the expected reference.
+        Returns the verdict dict the span journals; updates the
+        canary gauges, the detection-health state and the SLO
+        sensitivity objective."""
+        recovered = float(np.max(np.asarray(snr_peaks)))
+        verdict = {"injected": True, "segment": int(abs_index),
+                   "snr": round(recovered, 3)}
+        if not self.calibrated:
+            # first checked canary of the run sets the reference —
+            # journaled, so the baseline every later ratio is judged
+            # against is on the record
+            self.expected = max(recovered, 1e-9)
+            self.calibrated = True
+            verdict.update(calibrated=True, expected=round(
+                self.expected, 3), ratio=1.0, ok=True)
+            ratio, ok = 1.0, True
+        else:
+            ratio = recovered / self.expected
+            ok = ratio >= self.min_ratio
+            verdict.update(expected=round(self.expected, 3),
+                           ratio=round(ratio, 4), ok=ok)
+        lbl = {"stream": self.stream} if self.stream else None
+        metrics.add("canary_checked")
+        metrics.set("canary_last_snr", recovered)
+        metrics.set("canary_expected_snr", self.expected)
+        metrics.set("canary_sensitivity_ratio", ratio)
+        state = HEALTH_OK if ok else HEALTH_DEGRADED
+        metrics.set("detection_health_state", state)
+        if not ok:
+            metrics.add("canary_failed")
+        if lbl:
+            metrics.add("canary_checked", labels=lbl)
+            metrics.set("canary_last_snr", recovered, labels=lbl)
+            metrics.set("canary_expected_snr", self.expected,
+                        labels=lbl)
+            metrics.set("canary_sensitivity_ratio", ratio, labels=lbl)
+            metrics.set("detection_health_state", state, labels=lbl)
+            if not ok:
+                metrics.add("canary_failed", labels=lbl)
+        from srtb_tpu.utils import slo
+        slo.note_canary(self.stream, ok)
+        if not ok:
+            log.warning(
+                f"[canary] segment {abs_index}: sensitivity regression "
+                f"— recovered S/N {recovered:.2f} is "
+                f"{ratio:.2f}x the expected {self.expected:.2f} "
+                f"(min ratio {self.min_ratio:g})")
+        return verdict

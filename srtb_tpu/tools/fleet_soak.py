@@ -252,7 +252,7 @@ def run_soak(streams: int = 3, segments: int = 5, log2n: int = 13,
     for name in names:
         recs = [json.loads(line) for line in open(jpaths[name])
                 if line.strip().startswith("{")]
-        check(recs and all(r.get("stream") == name and r["v"] == 8
+        check(recs and all(r.get("stream") == name and r["v"] == 9
                            for r in recs),
               f"stream {name}: v8 journal records not stream-stamped")
         total_demote = int(recs[-1].get("plan_demotions", 0))

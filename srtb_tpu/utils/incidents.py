@@ -18,6 +18,8 @@ Layout (one directory per incident)::
         plan.json         plan_name, plan_signature, ladder level
         config.json       full Config snapshot
         metrics.json      metrics registry snapshot
+        extra.json        caller-provided evidence (only when given —
+                          e.g. canary verdict + quality timeline)
         spans_tail.jsonl  last spans of the telemetry journal
 
 Bundles are published ATOMICALLY with the repo's temp+rename
@@ -122,19 +124,23 @@ class IncidentRecorder:
     def dump(self, kind: str, reason: str = "",
              trace: int | None = None, stream: str = "",
              cfg=None, processor=None,
-             journal_path: str = "") -> str | None:
+             journal_path: str = "",
+             extra=None) -> str | None:
         """Write one bundle; returns its directory, or None when
-        rate-limited / bounded / failed.  Never raises."""
+        rate-limited / bounded / failed.  Never raises.  ``extra`` is
+        an optional JSON-able payload landing as ``extra.json`` — the
+        escalation site's own evidence (e.g. the canary verdict plus
+        the recent quality timeline)."""
         try:
             return self._dump(kind, reason, trace, stream, cfg,
-                              processor, journal_path)
+                              processor, journal_path, extra)
         except Exception as e:  # noqa: BLE001 - best-effort contract
             metrics.add("incident_dump_failures")
             log.error(f"[incident] bundle dump failed ({kind}): {e!r}")
             return None
 
     def _dump(self, kind, reason, trace, stream, cfg, processor,
-              journal_path) -> str | None:
+              journal_path, extra=None) -> str | None:
         now = time.monotonic()
         with self._rate_lock:
             last = self._last_dump_by_dir.get(self.directory, 0.0)
@@ -228,6 +234,8 @@ class IncidentRecorder:
                         if not k.startswith("_")}
             put("config.json", snap)
         put("metrics.json", metrics.snapshot())
+        if extra is not None:
+            put("extra.json", extra)
         jp = journal_path or (getattr(cfg, "telemetry_journal_path", "")
                               if cfg is not None else "")
         if jp and os.path.exists(jp):

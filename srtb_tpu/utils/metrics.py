@@ -370,6 +370,33 @@ class Metrics:
         "aot_cache_misses": "AOT executable cache misses (compiled + "
                             "persisted)",
         "profile_captures": "On-demand jax.profiler captures written",
+        "quality_zap_fraction": "Fraction of spectrum bins zapped by "
+                                "RFI mitigation (last segment)",
+        "quality_bandpass_mean": "Mean coarse-bandpass power "
+                                 "(last segment)",
+        "quality_bandpass_var": "Coarse-bandpass power variance "
+                                "(last segment)",
+        "quality_sk_mean": "Mean spectral-kurtosis estimate over "
+                           "channels (last segment)",
+        "quality_sk_max": "Max spectral-kurtosis estimate over "
+                          "channels (last segment)",
+        "quality_dead_frac": "Fraction of channels below the dead "
+                             "threshold (last segment)",
+        "quality_hot_frac": "Fraction of channels above the hot "
+                            "threshold (last segment)",
+        "quality_drift_score": "Bandpass EWMA drift score in sigmas "
+                               "(last segment)",
+        "quality_drift_alerts": "Bandpass drift-detector alerts",
+        "canary_injected": "Pulse-injection canaries injected",
+        "canary_checked": "Canary recoveries checked at drain",
+        "canary_failed": "Canary sensitivity-gate failures",
+        "canary_last_snr": "Recovered S/N of the last checked canary",
+        "canary_expected_snr": "Expected canary S/N reference "
+                               "(configured or auto-calibrated)",
+        "canary_sensitivity_ratio": "Last recovered/expected canary "
+                                    "S/N ratio",
+        "detection_health_state": "End-to-end detection health "
+                                  "(0 ok / 1 degraded)",
         "last_segment_monotonic": "Monotonic stamp of the last "
                                   "drained segment",
         "last_segment_unix": "Wall-clock stamp of the last drained "

@@ -20,6 +20,12 @@ process-wide series doubles as the solo pipeline's stream):
   stream has gone beyond the allowed gap since its last segment; the
   budget is ``slo_staleness_budget`` (allowed stale fraction of the
   window).
+- **sensitivity** (``slo_sensitivity_budget`` > 0 arms): a checked
+  pulse-injection canary (srtb_tpu/quality/canary.py) is *bad* when
+  its recovered S/N falls below ``canary_min_ratio`` of the expected
+  reference; the budget is the allowed bad fraction of checks.
+  Canaries are sparse (one per ``canary_every_segments``), so size
+  the windows to hold several checks or the fast burn quantizes.
 
 Each objective is evaluated over TWO windows — ``slo_fast_window_s``
 (default 5 min) and ``slo_slow_window_s`` (default 1 h) — the standard
@@ -54,7 +60,7 @@ from srtb_tpu.utils import events
 from srtb_tpu.utils.logging import log
 from srtb_tpu.utils.metrics import metrics
 
-OBJECTIVES = ("latency", "loss", "staleness")
+OBJECTIVES = ("latency", "loss", "staleness", "sensitivity")
 STATE_OK = "ok"
 STATE_DEGRADED = "degraded"
 STATE_BURNING = "burning"
@@ -116,6 +122,7 @@ class _StreamState:
     def __init__(self, fast_s: float, slow_s: float, clock):
         self.lat = (_Ratio(fast_s, clock), _Ratio(slow_s, clock))
         self.loss = (_Ratio(fast_s, clock), _Ratio(slow_s, clock))
+        self.sens = (_Ratio(fast_s, clock), _Ratio(slow_s, clock))
         self.last_segment: float | None = None
         self.states: dict[str, str] = {}
 
@@ -130,6 +137,7 @@ class SloTracker:
                  loss_budget: float = 0.0,
                  staleness_s: float = 0.0,
                  staleness_budget: float = 0.05,
+                 sensitivity_budget: float = 0.0,
                  fast_window_s: float = 300.0,
                  slow_window_s: float = 3600.0,
                  burn_threshold: float = 1.0,
@@ -139,6 +147,7 @@ class SloTracker:
         self.loss_budget = float(loss_budget)
         self.staleness_s = float(staleness_s)
         self.staleness_budget = max(1e-9, float(staleness_budget))
+        self.sensitivity_budget = float(sensitivity_budget)
         self.fast_s = float(fast_window_s)
         self.slow_s = float(slow_window_s)
         self.threshold = float(burn_threshold)
@@ -155,6 +164,8 @@ class SloTracker:
             out.append("loss")
         if self.staleness_s > 0:
             out.append("staleness")
+        if self.sensitivity_budget > 0:
+            out.append("sensitivity")
         return tuple(out)
 
     @classmethod
@@ -170,6 +181,8 @@ class SloTracker:
                               or 0),
             staleness_budget=float(getattr(cfg, "slo_staleness_budget",
                                            0.05)),
+            sensitivity_budget=float(getattr(
+                cfg, "slo_sensitivity_budget", 0.0) or 0),
             fast_window_s=float(getattr(cfg, "slo_fast_window_s",
                                         300.0)),
             slow_window_s=float(getattr(cfg, "slo_slow_window_s",
@@ -211,6 +224,15 @@ class SloTracker:
             for r in st.loss:
                 r.add(float(n), float(n))
 
+    def note_canary(self, stream: str, ok: bool) -> None:
+        """One checked pulse-injection canary: bad when the recovered
+        S/N failed the sensitivity gate."""
+        st = self._state(stream or "")
+        bad = 0.0 if ok else 1.0
+        with self._lock:
+            for r in st.sens:
+                r.add(1.0, bad)
+
     # ---------------------------------------------------- evaluation
 
     def _burns(self, st: _StreamState, objective: str,
@@ -225,6 +247,11 @@ class SloTracker:
             (ff, _), (fs, bs) = (st.loss[0].fraction(),
                                  st.loss[1].fraction())
             return ff / self.loss_budget, fs / self.loss_budget, bs
+        if objective == "sensitivity":
+            (ff, _), (fs, bs) = (st.sens[0].fraction(),
+                                 st.sens[1].fraction())
+            return (ff / self.sensitivity_budget,
+                    fs / self.sensitivity_budget, bs)
         # staleness: time beyond the allowed gap, as a window fraction
         if st.last_segment is None:
             return 0.0, 0.0, 0.0  # startup: no budget spent yet
@@ -312,10 +339,12 @@ def configure(cfg) -> "SloTracker | None":
     cur = tracker
     if cur is not None and (
             cur.latency_ms, cur.latency_budget, cur.loss_budget,
-            cur.staleness_s, cur.staleness_budget, cur.fast_s,
+            cur.staleness_s, cur.staleness_budget,
+            cur.sensitivity_budget, cur.fast_s,
             cur.slow_s, cur.threshold) == (
             new.latency_ms, new.latency_budget, new.loss_budget,
-            new.staleness_s, new.staleness_budget, new.fast_s,
+            new.staleness_s, new.staleness_budget,
+            new.sensitivity_budget, new.fast_s,
             new.slow_s, new.threshold):
         return cur
     tracker = new
@@ -338,6 +367,12 @@ def note_dropped(stream: str, n: int = 1) -> None:
     t = tracker
     if t is not None:
         t.note_dropped(stream, n)
+
+
+def note_canary(stream: str, ok: bool) -> None:
+    t = tracker
+    if t is not None:
+        t.note_canary(stream, ok)
 
 
 def evaluate() -> dict | None:

@@ -66,10 +66,18 @@ from srtb_tpu.utils.metrics import metrics
 # upper bound) — plus the cumulative compile/cache accounting
 # ``compile_ms`` (first-dispatch trace+compile wall, plus AOT-miss
 # compiles), ``plan_compiles``, ``aot_cache_hits`` /
-# ``aot_cache_misses``.  Readers must tolerate mixed v1-v8 journals:
-# rotation can leave an older-schema tail in the previous generation
-# after an upgrade.
-SPAN_SCHEMA_VERSION = 8
+# ``aot_cache_misses``.
+# v9 (science observatory): adds two optional ``extra`` sections —
+# ``quality`` (the per-segment data-quality dict QualityMonitor
+# journals: zap_frac, bandpass mean/var, SK mean/max, dead/hot
+# fractions, drift score/alert, and the coarse occupancy + bandpass
+# maps) and ``canary`` (pulse-injection verdict: injected, segment,
+# recovered/expected S/N, sensitivity ratio, ok — or just the
+# injection flag on a replayed drain).  Both ride the existing
+# ``extra`` envelope, so pre-v9 readers skip them.  Readers must
+# tolerate mixed v1-v9 journals: rotation can leave an older-schema
+# tail in the previous generation after an upgrade.
+SPAN_SCHEMA_VERSION = 9
 
 # gauge names shared between the pipeline (writer) and health() (reader)
 LAST_SEGMENT_MONOTONIC = "last_segment_monotonic"
@@ -484,4 +492,25 @@ def health(stale_after_s: float = 30.0) -> dict:
         out["slo"] = slo_report
         out["slo_ok"] = all(v.get("ok", True)
                             for v in slo_report.values())
+    # detection health (quality/canary.py): present only once a
+    # pulse-injection canary has been CHECKED this process — a
+    # canary-off run (or one whose first canary hasn't drained)
+    # reports no detection section rather than a fake "ok".  Same
+    # rule as the SLO embed: NOT folded into liveness ``ok`` — a
+    # sensitivity regression is an alerting/escalation concern (the
+    # incident bundle + detection_health_state gauge), and restarting
+    # a pipeline that still drains segments would not fix the RFI
+    # environment or the broken subband that caused it.
+    if metrics.get("canary_checked"):
+        state = int(metrics.get("detection_health_state"))
+        out["detection"] = {
+            "state": "ok" if state == 0 else "degraded",
+            "canary_checked": int(metrics.get("canary_checked")),
+            "canary_failed": int(metrics.get("canary_failed")),
+            "last_snr": round(metrics.get("canary_last_snr"), 3),
+            "expected_snr": round(metrics.get("canary_expected_snr"),
+                                  3),
+            "sensitivity_ratio": round(
+                metrics.get("canary_sensitivity_ratio"), 4),
+        }
     return out

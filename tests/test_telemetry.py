@@ -270,7 +270,7 @@ def test_span_journal_roundtrip_and_rotation(tmp_path):
     recs = TR.load(path)
     assert len(recs) == 3
     r = recs[-1]
-    assert r["type"] == "segment_span" and r["v"] == 8
+    assert r["type"] == "segment_span" and r["v"] == 9
     assert r["segment"] == 2 and r["detections"] == 2 and r["dump"]
     assert r["samples"] == 1 << 16 and r["timestamp_ns"] == 123
     assert r["queue_depth"] == 1
@@ -369,9 +369,12 @@ def test_telemetry_report_stats_and_timeline(tmp_path):
     md = TR._md(rep)
     assert "| dispatch |" in md and "Msamples/s" in md
     assert TR.main([str(path)]) == 0
+    # an empty (freshly-rotated) journal is a NOTE, not an error: CI
+    # artifact stages must not fail a healthy run that simply has not
+    # drained a segment yet
     empty = tmp_path / "empty.jsonl"
     empty.write_text("")
-    assert TR.main([str(empty)]) == 1
+    assert TR.main([str(empty)]) == 0
 
 
 def test_report_json_matches_md_sections(tmp_path, capsys):
