@@ -382,6 +382,19 @@ class Config:
     # queued-stream slots behind the admission gate (0 = reject
     # immediately when over capacity)
     fleet_queue_limit: int = 0
+    # cross-tenant continuous batching: max segments from DIFFERENT
+    # lanes sharing a plan_cache_key folded into one vmapped device
+    # dispatch (pipeline/fleet._BatchFormer).  0 or 1 = off (every
+    # lane dispatches solo, bit-identical to the pre-batching fleet).
+    # Read from the FLEET config (the first spec's cfg), not per
+    # stream.  Batched lanes trade bit-exactness of float artifacts
+    # for dispatch amortization: .bin candidates stay bitwise equal,
+    # .tim/.npy match solo within the documented vmap tolerance.
+    fleet_batch_max: int = 0
+    # how long a partially formed batch may wait for co-tenants
+    # before it is flushed anyway (milliseconds) — a lone tenant
+    # never waits longer than this for neighbors that may not come
+    fleet_batch_linger_ms: float = 2.0
     # segment-span telemetry journal: one JSONL record per processed
     # segment (per-stage wall clock, queue depth, loss counters,
     # detection count, dump decision — utils/telemetry.py); "" disables.

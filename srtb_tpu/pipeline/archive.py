@@ -18,6 +18,13 @@ mechanism the repo has, composed:
   (``fleet_max_streams`` lanes live at once, the rest queued behind
   admission control), sharing ONE compiled plan through the fleet's
   registry-keyed :class:`SharedPlanCache` — N files, one compile;
+- **many SMALL files in one dispatch**: with ``fleet_batch`` set (and
+  ``micro_batch=1`` — per-lane micro-batch and cross-stream batching
+  are mutually exclusive per lane), the fleet's cross-tenant batch
+  former folds ready segments from DIFFERENT files into one shared
+  vmapped dispatch, so a directory of short captures — each too small
+  to fill a per-lane micro-batch — still amortizes dispatch overhead
+  across lanes;
 - **exactly-once outputs + deterministic resume**: every file gets
   its own checkpoint + run-manifest namespace under the output
   directory, and timestamps are stamped from stream offsets
@@ -76,6 +83,8 @@ class ArchiveReport:
     elapsed_s: float = 0.0
     failed: int = 0
     plan_compiles: int = 0
+    batched_dispatches: int = 0
+    batched_segments: int = 0
 
     @property
     def segments_per_sec(self) -> float:
@@ -88,6 +97,8 @@ class ArchiveReport:
             "elapsed_s": self.elapsed_s,
             "segments_per_sec": self.segments_per_sec,
             "plan_compiles": self.plan_compiles,
+            "batched_dispatches": self.batched_dispatches,
+            "batched_segments": self.batched_segments,
             "ok": self.failed == 0,
         }
 
@@ -110,7 +121,8 @@ class ArchiveReplay:
                  inflight: int = DEFAULT_INFLIGHT,
                  keep_waterfall: bool = True,
                  max_segments_per_file: int | None = None,
-                 manifest: bool = True):
+                 manifest: bool = True,
+                 fleet_batch: int = 0):
         if not files:
             raise ValueError("archive replay needs at least one file")
         for f in files:
@@ -144,10 +156,21 @@ class ArchiveReplay:
             )
         # the fleet-level config: lane capacity + a queue deep enough
         # that every file is admitted eventually, priorities equal
-        # (FIFO by spec order)
+        # (FIFO by spec order).  fleet_batch arms the cross-tenant
+        # batch former — the many-small-files case where per-lane
+        # micro-batching has nothing to stack (its eligibility rule
+        # keeps micro-batched lanes out, so the two modes never fight
+        # over the same segment)
+        fb = max(0, int(fleet_batch))
+        if fb >= 2 and mb > 1:
+            log.warning(
+                f"[archive] fleet_batch={fb} with micro_batch={mb}: "
+                "micro-batched lanes are ineligible for cross-stream "
+                "batching; set --micro-batch 1 to use --fleet-batch")
         self.fleet_cfg = base_cfg.replace(
             fleet_max_streams=max(1, int(lanes)),
-            fleet_queue_limit=len(files))
+            fleet_queue_limit=len(files),
+            fleet_batch_max=fb)
 
     def run(self) -> ArchiveReport:
         from srtb_tpu.pipeline.fleet import StreamFleet, StreamSpec
@@ -158,11 +181,17 @@ class ArchiveReplay:
                  for n in self.names]
         t0 = time.perf_counter()
         compiles0 = int(metrics.get("fleet_plan_compiles"))
+        bdisp0 = int(metrics.get("batched_dispatches"))
+        bsegs0 = int(metrics.get("batched_segments"))
         report = ArchiveReport()
         with StreamFleet(specs, fleet_cfg=self.fleet_cfg) as fleet:
             results = fleet.run()
             report.plan_compiles = \
                 int(metrics.get("fleet_plan_compiles")) - compiles0
+            report.batched_dispatches = \
+                int(metrics.get("batched_dispatches")) - bdisp0
+            report.batched_segments = \
+                int(metrics.get("batched_segments")) - bsegs0
         report.elapsed_s = time.perf_counter() - t0
         for name in self.names:
             res = results.get(name)

@@ -22,6 +22,23 @@ This module makes that multi-tenancy SAFE before it is fast:
   ``mark_shared()``-ed so a single lane's plan demotion can never
   retire the programs its neighbors are dispatching through.
 
+- **Cross-tenant continuous batching** (:class:`_BatchFormer`, armed
+  by ``Config.fleet_batch_max >= 2`` on the fleet config): ready
+  segments from lanes sharing a plan family are folded into ONE
+  vmapped device dispatch (``SegmentProcessor.process_batch`` /
+  ``process_batch_cold``), with per-tenant results scattered back to
+  each lane's in-flight window — the unit of dispatch inverts from "a
+  lane's segment" to "a formed batch".  Batch size follows load up to
+  ``fleet_batch_max``; a partial batch flushes after
+  ``fleet_batch_linger_ms`` (a lone tenant never waits) or when the
+  scheduler goes idle; fill is priority-ordered.  A ragged tail of
+  one rides the lane's plain solo dispatch (the already-compiled
+  program — never a fresh B=1 vmap trace).  Off by default: solo
+  lanes stay bit-identical to the pre-batching fleet; batched lanes
+  trade float bit-exactness for dispatch amortization (``.bin``
+  candidates stay bitwise equal, float artifacts match within the
+  documented vmap tolerance).
+
 - **Per-stream bulkheads**: every lane owns its OWN Pipeline instance
   and with it its own ComputeHealer ladder position, degradation
   ladder, retry policy, fault injector (stream-selector scoped),
@@ -62,6 +79,7 @@ thread-ownership guards assume one engine per process).
 from __future__ import annotations
 
 import collections
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any
@@ -162,6 +180,344 @@ class SharedPlanCache:
         for proc in self._by_key.values():
             proc.retire(force=True)
         self._by_key.clear()
+
+
+class _BatchSlot:
+    """One lane's reservation in a forming cross-stream batch.  The
+    slot sits in the lane's ``pending`` deque at its dispatch-order
+    position, holding the ingested segment host-side until the former
+    dispatches it, then the standard 7-tuple in-flight record
+    (``item``) — so drain order, checkpoint offsets and journal order
+    are exactly what a solo dispatch would have produced.  A dispatch
+    failure lands on ``error`` and raises inside the OWNING lane's
+    step (the bulkhead: the lane that happened to trigger a flush
+    never observes a neighbor's exception)."""
+
+    __slots__ = ("lane", "seg", "ingest_s", "offset_after", "index",
+                 "t_offer", "item", "error", "cancelled")
+
+    def __init__(self, lane: "_StreamLane", seg, ingest_s: float,
+                 offset_after: int, index: int):
+        self.lane = lane
+        self.seg = seg
+        self.ingest_s = ingest_s
+        self.offset_after = offset_after
+        self.index = index
+        self.t_offer = time.perf_counter()
+        self.item: tuple | None = None
+        self.error: BaseException | None = None
+        # lane withdrew the offer (fleet reinit, lane teardown): the
+        # former must skip it at flush
+        self.cancelled = False
+
+
+class _BatchFormer:
+    """Cross-tenant continuous batching: collect ready segments from
+    lanes sharing a plan family (the SAME :class:`SharedPlanCache`
+    processor — equal ``plan_cache_key`` by construction, so one
+    compiled program serves every member) and dispatch them as ONE
+    vmapped device call, scattering per-tenant results back to each
+    lane's in-flight window.
+
+    Formation policy: a family flushes the moment it holds
+    ``fleet_batch_max`` live offers; a partial family flushes when its
+    oldest offer has lingered past ``fleet_batch_linger_ms`` (the
+    lone-tenant latency bound, pumped by the fleet scheduler) or when
+    the scheduler goes idle (nothing else can progress — dispatch
+    now).  When one flush holds more offers than a batch takes, fill
+    is priority-ordered (``stream_priority`` desc, offer age asc): the
+    important tenants ride the first dispatch.  A batch must span at
+    least TWO distinct lanes (cross-tenant, the name of the game): a
+    ragged tail of one, or a chunk drawn entirely from a lone
+    tenant's own in-flight window, goes through the lane's plain
+    solo-dispatch path instead — the lone tenant keeps its warm ring
+    carry and pays no batching overhead.
+
+    Bulkheads: eligibility is re-checked per offer against the lane's
+    CURRENT processor, so a healed/demoted lane (whose swap installed
+    an unshared processor) drops out of its batch group automatically
+    and its neighbors' shared program is never retired; a member whose
+    own scheduled dispatch fault fires during formation heals with
+    lane-local blast radius and falls back to its solo dispatch."""
+
+    def __init__(self, fleet: "StreamFleet", batch_max: int,
+                 linger_s: float):
+        self.fleet = fleet
+        self.batch_max = max(2, int(batch_max))
+        self.linger_s = max(0.0, float(linger_s))
+        # plan family -> (shared processor, pending offers); keyed on
+        # the shared processor's identity (one object per family) with
+        # the processor ref alongside so id() can never be recycled
+        # under a live group
+        self._groups: dict[int, tuple] = {}
+
+    # ------------------------------------------------------ membership
+
+    def eligible(self, lane: "_StreamLane") -> bool:
+        """May this lane's next segment join a cross-stream batch?
+        Demotion swaps in an unshared processor, so a victim exits its
+        group here — the bulkhead's membership rule.  Staged plans
+        reject ``process_batch`` (their dispatch is already
+        amortized), and lanes micro-batching internally (archive
+        replay units > 1) already fill the device."""
+        proc = lane.pipe.processor
+        return (getattr(proc, "_fleet_shared", False)
+                and not getattr(proc, "staged", False)
+                and lane._unit() == 1)
+
+    def offer(self, lane: "_StreamLane", one: tuple,
+              index: int) -> _BatchSlot:
+        """Park one ingested segment in its plan family's forming
+        batch; returns the slot the lane must append to ``pending``.
+        Reaching ``batch_max`` flushes the family immediately (the
+        slot comes back already filled)."""
+        seg, ingest_s, offset_after = one
+        slot = _BatchSlot(lane, seg, ingest_s, offset_after, index)
+        proc = lane.pipe.processor
+        key = id(proc)
+        group = self._groups.get(key)
+        if group is None:
+            group = self._groups[key] = (proc, [])
+        group[1].append(slot)
+        if sum(1 for s in group[1] if not s.cancelled) \
+                >= self.batch_max:
+            self._flush(key)
+        return slot
+
+    def pump(self) -> bool:
+        """Scheduler-paced linger check: flush every family whose
+        oldest live offer has waited past the deadline.  True when
+        anything dispatched."""
+        now = time.perf_counter()
+        flushed = False
+        for key in list(self._groups):
+            slots = [s for s in self._groups[key][1]
+                     if not s.cancelled]
+            if not slots:
+                del self._groups[key]
+                continue
+            if now - min(s.t_offer for s in slots) >= self.linger_s:
+                self._flush(key)
+                flushed = True
+        return flushed
+
+    def flush_all(self) -> bool:
+        """Idle-scheduler flush: nothing else can make progress, so
+        every pending offer dispatches now (partial batches included —
+        waiting out the linger would only add latency)."""
+        flushed = False
+        for key in list(self._groups):
+            if any(not s.cancelled for s in self._groups[key][1]):
+                self._flush(key)
+                flushed = True
+            else:
+                del self._groups[key]
+        return flushed
+
+    def flush_lane(self, lane: "_StreamLane") -> None:
+        """Flush the family holding this lane's offers (the blocking
+        drain granted to a lane whose head still sits in the former)."""
+        for key, (_proc, slots) in list(self._groups.items()):
+            if any(s.lane is lane and not s.cancelled for s in slots):
+                self._flush(key)
+
+    def drop_lane(self, lane: "_StreamLane") -> None:
+        """Withdraw a failing lane's offers (its teardown accounts the
+        parked segments as per-stream loss)."""
+        for key in list(self._groups):
+            _proc, slots = self._groups[key]
+            for s in slots:
+                if s.lane is lane:
+                    s.cancelled = True
+            if all(s.cancelled for s in slots):
+                del self._groups[key]
+
+    def reset(self) -> None:
+        """Fleet-wide device reinit: every unfilled offer was
+        re-dispatched cold by its lane's ``reinit_cold`` (and
+        cancelled), so the forming state is garbage — forget it."""
+        self._groups.clear()
+
+    # -------------------------------------------------------- dispatch
+
+    def _flush(self, key: int) -> None:
+        proc, slots = self._groups.pop(key)
+        live = [s for s in slots if not s.cancelled]
+        # priority fill: higher-priority streams ride the first
+        # (immediately dispatched) batch, oldest offer first within a
+        # band — deterministic under the scheduler's round-robin
+        live.sort(key=lambda s: (-s.lane.priority, s.t_offer,
+                                 s.lane.name))
+        while live:
+            take, live = live[:self.batch_max], live[self.batch_max:]
+            if len({id(s.lane) for s in take}) >= 2:
+                self._dispatch_shared(proc, take)
+            else:
+                # CROSS-tenant batching only: a chunk drawn from one
+                # lane (a lone tenant's own in-flight window, or a
+                # ragged tail of one) goes through the lane's plain
+                # solo path — its ring carry stays warm and no B=1
+                # vmap is ever traced.  Slots a mid-flush reinit
+                # already re-dispatched (cancelled) are skipped.
+                for s in take:
+                    if not s.cancelled and s.item is None \
+                            and s.error is None:
+                        self._single_fallback(s)
+
+    @staticmethod
+    def _single_fallback(slot: _BatchSlot,
+                         requeue: bool = False) -> None:
+        """Dispatch one member through its lane's own solo path (full
+        fault/retry/heal machinery); a post-heal failure lands on the
+        slot for the owning lane's step to raise."""
+        try:
+            slot.item = slot.lane._dispatch(
+                slot.seg, slot.ingest_s, slot.offset_after,
+                slot.index, requeue=requeue)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:  # noqa: BLE001 — member-contained
+            slot.error = e
+
+    def _member_fault(self, slot: _BatchSlot,
+                      exc: BaseException) -> None:
+        """A member's own scheduled dispatch fault fired during
+        formation: heal with the lane's blast-radius rules (a device
+        fault demotes THIS lane — the processor swap drops it out of
+        the batch group), then dispatch its segment solo.  Heal
+        failures (ladder exhausted, reinit budget spent) land on the
+        slot for the owning lane to raise."""
+        try:
+            healed = slot.lane._heal(exc)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e2:  # noqa: BLE001 — member-contained
+            slot.error = e2
+            return
+        if slot.cancelled:
+            # the heal went through the fleet-wide reinit, which
+            # already re-dispatched this slot's segment cold
+            return
+        if healed:
+            self._single_fallback(slot, requeue=True)
+            return
+        # not a device fault: transient/data-loss classes get the
+        # solo path's retry semantics — the one-shot injected fault is
+        # consumed, so the solo re-dispatch IS the retry; anything
+        # else fails the owning lane exactly like a solo dispatch
+        from srtb_tpu.resilience.errors import (DATA_LOSS, TRANSIENT,
+                                                classify)
+        if slot.lane.pipe.retry is not None and \
+                classify(exc) in (TRANSIENT, DATA_LOSS):
+            self._single_fallback(slot)
+        else:
+            slot.error = exc
+
+    def _dispatch_shared(self, proc, slots: list) -> None:
+        """One vmapped device call for B members from (possibly) B
+        different lanes, per-tenant results scattered back as lazy
+        batch-output slices — the cross-stream twin of the solo
+        engine's ``_dispatch_micro_batch``, with per-member fault
+        fidelity and member-contained failure."""
+        t0 = time.perf_counter()
+        live = []
+        for slot in slots:
+            lane = slot.lane
+            lane.pipe._canary_prepare(slot.seg, slot.index)
+            faults = lane.pipe.faults
+            if faults is not None and faults.armed("dispatch"):
+                # per-member fault fidelity: the member's scheduled
+                # "dispatch" fault fires against ITS index before the
+                # shared call, and its consequences stay on that
+                # member — neighbors keep batching
+                try:
+                    faults.fire("dispatch", slot.index)
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except BaseException as e:  # noqa: BLE001 — classified
+                    self._member_fault(slot, e)
+                    continue
+            live.append(slot)
+        # a mid-formation heal may have re-dispatched members (solo
+        # fallback) or cancelled them (fleet reinit); only untouched
+        # members still on the shared program proceed
+        live = [s for s in live
+                if not s.cancelled and s.item is None
+                and s.error is None and s.lane.pipe.processor is proc]
+        if not live:
+            return
+        if len({id(s.lane) for s in live}) < 2:
+            # member faults thinned the chunk below two tenants: the
+            # cross-tenant contract no longer holds, dispatch solo
+            for s in live:
+                self._single_fallback(s)
+            return
+        datas = [s.lane.pipe._device_bytes(s.seg) for s in live]
+        try:
+            if any(s.lane.pipe._ring_live for s in live):
+                # a ring carry belongs to ONE lane's consecutive-seq
+                # chain, which a cross-stream batch never is: the
+                # carry-emitting cold batch plan uploads full
+                # segments, and members' live carries are invalidated
+                # so their next solo dispatch goes (correctly) cold
+                for s in live:
+                    s.lane.pipe._ring_invalidate()
+                (wf_b, det_b), _carry = proc.process_batch_cold(
+                    proc.stack_batch(datas))
+            else:
+                stack = getattr(proc, "stack_batch", None)
+                if stack is not None:
+                    stacked = stack(datas)
+                else:  # duck-typed stub processors (tests)
+                    import numpy as np
+                    stacked = np.stack(
+                        [np.ascontiguousarray(d) for d in datas])
+                wf_b, det_b = proc.process_batch(stacked)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:  # noqa: BLE001 — classified per lane
+            # whole-batch failure: every member falls back to its own
+            # solo path, where its own healer/retry classifies the
+            # fault with lane-local blast radius
+            log.warning(f"[fleet] batched dispatch of {len(live)} "
+                        f"segments failed ({type(e).__name__}); "
+                        f"falling back to solo dispatches: {e!r}")
+            for s in live:
+                if not s.cancelled and s.item is None:
+                    self._single_fallback(s, requeue=True)
+            return
+        import jax
+        b = len(live)
+        per_seg = (time.perf_counter() - t0) / b
+        now = time.perf_counter()
+        metrics.add("batched_dispatches")
+        metrics.histogram("batch_size",
+                          buckets=(1.0, 2.0, 4.0, 8.0, 16.0)).observe(b)
+        for i, slot in enumerate(live):
+            lane = slot.lane
+            seg = slot.seg
+            det_i = jax.tree_util.tree_map(lambda x, j=i: x[j], det_b)
+            wf_i = wf_b[i] if wf_b is not None else None
+            span = {"ingest": slot.ingest_s, "dispatch": per_seg}
+            lane.pipe.stage_timer.record("dispatch", per_seg)
+            metrics.add("batched_segments")
+            metrics.add("batched_segments",
+                        labels={"stream": lane.name})
+            try:
+                # journaled by _record_segment (span schema v10);
+                # omitted — never faked — on solo dispatches
+                seg.batch_size = b
+                seg.batch_wait_s = max(0.0, t0 - slot.t_offer)
+            except AttributeError:  # read-only stub segments
+                pass
+            if lane.pipe.events is not None:
+                lane.pipe.events.emit(
+                    "stage.dispatch",
+                    trace=getattr(seg, "trace_id", 0),
+                    stream=lane.name, seg=slot.index, dur=per_seg,
+                    info=f"fleet_batch={b}")
+            slot.item = (seg, wf_i, det_i, slot.offset_after, span,
+                         now, slot.index)
 
 
 class _StreamLane:
@@ -276,7 +632,7 @@ class _StreamLane:
                 window_s=getattr(cfg, "supervisor_window_s", 60.0))
         self._sink_pipe = fw.start_pipe(
             self._sink_f, self._q_sink, None, self._stop,
-            f"sink_drain:{self.name}")
+            f"sink_drain:{self.name}", on_done=fleet._notify)
         telemetry.register_stream(self.name)
 
     # ------------------------------------------------------ accounting
@@ -301,6 +657,9 @@ class _StreamLane:
         finally:
             if "abandoned" not in item[-1]:
                 self._live_add(-1)
+            # the drain freed window/queue space the scheduler may be
+            # idle-waiting on (event-driven wakeup, no 2 ms poll)
+            self.fleet._notify()
         self._current[0] = None
 
     def _sink_alive(self) -> bool:
@@ -324,7 +683,7 @@ class _StreamLane:
                     "segment was accounted; skipping replay")
         self._sink_pipe = fw.start_pipe(
             self._sink_f, self._q_sink, None, self._stop,
-            f"sink_drain:{self.name}")
+            f"sink_drain:{self.name}", on_done=self.fleet._notify)
         return True
 
     # ------------------------------------------------------ heal hooks
@@ -416,8 +775,19 @@ class _StreamLane:
                 self.pipe.cfg, donate_input=on_accelerator())
         self.pipe._swap_processor(newp)
         for i in range(len(self.pending)):
-            seg, _wf, _det, offset_after, span, _t0, idx = \
-                self.pending[i]
+            item = self.pending[i]
+            if isinstance(item, _BatchSlot):
+                if item.item is None:
+                    # still parked in the batch former: withdraw the
+                    # offer and dispatch cold directly — the retained
+                    # host buffer is the recovery source either way
+                    item.cancelled = True
+                    self.pending[i] = self.pipe._dispatch_segment(
+                        item.seg, item.ingest_s, item.offset_after,
+                        item.index, requeue=True)
+                    continue
+                item = item.item
+            seg, _wf, _det, offset_after, span, _t0, idx = item
             self.pending[i] = self.pipe._dispatch_segment(
                 seg, span["ingest"], offset_after, idx, requeue=True)
 
@@ -512,6 +882,27 @@ class _StreamLane:
         """Fetch the oldest in-flight segment (device-fault healed)
         and stage it for emit.  ``block`` allows a blocking fetch;
         otherwise only a device-ready head is fetched."""
+        head = self.pending[0]
+        if isinstance(head, _BatchSlot):
+            if head.error is not None:
+                # a batched-formation dispatch failed for THIS member:
+                # raise inside the owning lane's own step (the
+                # bulkhead boundary; _fail accounts the parked slot)
+                raise head.error
+            if head.item is None:
+                if not block:
+                    return False
+                # a blocking drain granted to a lane whose head still
+                # sits in the former: flush its family now (the
+                # lone-tenant path when the linger pump has not fired)
+                former = self.fleet._former
+                if former is not None:
+                    former.flush_lane(self)
+                if head.error is not None:
+                    raise head.error
+                if head.item is None:
+                    return False
+            self.pending[0] = head.item
         if not block and not Pipeline._result_ready(self.pending[0][2]):
             return False
         depth = len(self.pending)
@@ -606,7 +997,20 @@ class _StreamLane:
                         break
                     got.append(one)
                 if got:
-                    self.pending.extend(self._dispatch_batch(got, b))
+                    former = self.fleet._former
+                    if former is not None and len(got) == 1 \
+                            and former.eligible(self):
+                        # cross-stream continuous batching: park the
+                        # segment in the fleet's batch former (a
+                        # window reservation in dispatch order); the
+                        # former fills the slot when its plan family
+                        # flushes — at fleet_batch_max, at the linger
+                        # deadline, or on an idle scheduler
+                        self.pending.append(former.offer(
+                            self, got[0], self.dispatched))
+                    else:
+                        self.pending.extend(
+                            self._dispatch_batch(got, b))
                     self._live_add(len(got))
                     self.dispatched += len(got)
                     self.pipe.stats.segments += len(got)
@@ -771,8 +1175,20 @@ class _StreamLane:
         if self._staged_emit is not None:
             self._shed_item(self._staged_emit)
             self._staged_emit = None
+        if self.fleet._former is not None:
+            self.fleet._former.drop_lane(self)
         while self.pending:
             item = self.pending.popleft()
+            if isinstance(item, _BatchSlot):
+                item.cancelled = True
+                if item.item is None:
+                    # never dispatched — the parked segment is still
+                    # host-side, nothing staged to release
+                    self.pipe._account_dropped(
+                        trace=getattr(item.seg, "trace_id", 0))
+                    self._live_add(-1)
+                    continue
+                item = item.item
             self.pipe._account_dropped(
                 trace=getattr(item[0], "trace_id", 0))
             self._live_add(-1)
@@ -825,6 +1241,21 @@ class StreamFleet:
         self.plans = SharedPlanCache()
         self.admission = AdmissionController.from_config(cfg0)
         self.fairness = FleetShedPolicy.from_config(cfg0)
+        # cross-tenant continuous batching (fleet-config knob, like
+        # admission): 0/1 = off — every lane dispatches solo,
+        # bit-identical to the pre-batching fleet
+        batch_max = int(getattr(cfg0, "fleet_batch_max", 0) or 0)
+        self._former = None
+        if batch_max >= 2:
+            self._former = _BatchFormer(
+                self, batch_max,
+                max(0.0, float(getattr(cfg0, "fleet_batch_linger_ms",
+                                       2.0) or 0.0)) / 1e3)
+        # event-driven scheduler wakeup: sink threads notify when a
+        # drain frees window/queue space, so an idle scheduler round
+        # waits on the condition instead of polling on a fixed sleep
+        self._wake = threading.Condition()
+        self._wake_seq = 0
         # the SHARED device-halt reinit budget (one device, one
         # budget): per-lane healers keep demotion only
         self._reinit_sup = None
@@ -840,6 +1271,16 @@ class StreamFleet:
         self._waitlist: dict[str, StreamSpec] = {}
 
     # ---------------------------------------------------- lane control
+
+    def _notify(self) -> None:
+        """Wake an idle scheduler (called from lane sink threads after
+        each drained item and at sink-pipe exit).  The sequence number
+        closes the race between the scheduler's progress check and its
+        wait: a notify landing in between bumps the sequence, and the
+        scheduler skips the wait instead of missing the wakeup."""
+        with self._wake:
+            self._wake_seq += 1
+            self._wake.notify_all()
 
     def _start(self, name: str) -> bool:
         spec = self.specs[name]
@@ -904,6 +1345,10 @@ class StreamFleet:
         for lane in self.lanes.values():
             if not lane.done:
                 lane.reinit_cold()
+        if self._former is not None:
+            # every parked offer was re-dispatched cold (and
+            # cancelled) by its lane's reinit_cold above
+            self._former.reset()
         return True
 
     def _on_lane_done(self, lane: _StreamLane) -> None:
@@ -936,17 +1381,36 @@ class StreamFleet:
         loss = metrics.window("segments_dropped").sum() > 0
         shed = self.fairness.observe(
             pressure, loss,
-            [(ln.name, ln.priority, ln.real_time) for ln in running])
+            [(ln.name, ln.priority, ln.real_time,
+              self._former is not None and self._former.eligible(ln))
+             for ln in running])
         for ln in running:
             ln.forced_shed = ln.name in shed
             ln._emitted_since_obs = 0
 
     # ------------------------------------------------------------ run
 
+    @staticmethod
+    def _plan_key(spec: StreamSpec) -> str | None:
+        """The spec's plan-family key for batch-aware admission (None
+        when the config cannot project one — duck-typed test configs):
+        the gate prefers evicting streams with no co-tenant family,
+        keeping formed batches dense."""
+        try:
+            from srtb_tpu.pipeline import registry
+            from srtb_tpu.utils.platform import on_accelerator
+            return registry.plan_cache_key(
+                spec.cfg, donate_input=on_accelerator())
+        except Exception as e:  # noqa: BLE001 — admission must never fail
+            log.debug(f"[fleet] no plan key for {spec.name}: {e!r}")
+            return None
+
     def run(self) -> dict[str, StreamResult]:
         metrics.set("fleet_streams_total", len(self.specs))
         for spec in self.specs.values():
-            decision = self.admission.request(spec.name, spec.priority)
+            decision = self.admission.request(
+                spec.name, spec.priority,
+                plan_key=self._plan_key(spec))
             if decision == ADMIT:
                 self._start(spec.name)
             elif decision == QUEUE:
@@ -984,12 +1448,17 @@ class StreamFleet:
                                     "startable")))
                         break
                     continue
+                wake_seq = self._wake_seq
                 progressed = False
                 for lane in active:
                     if lane.step():
                         progressed = True
                     if lane.done:
                         self._on_lane_done(lane)
+                if self._former is not None and self._former.pump():
+                    # a linger deadline flushed a partial batch: the
+                    # filled slots drain next round
+                    progressed = True
                 self._observe_fairness()
                 for name in self.admission.rejected:
                     if name in self._waitlist:
@@ -997,6 +1466,12 @@ class StreamFleet:
                         self.results.setdefault(
                             name, StreamResult(name, "rejected"))
                 if not progressed:
+                    if self._former is not None \
+                            and self._former.flush_all():
+                        # idle scheduler: dispatch every pending
+                        # offer now rather than waiting out a linger
+                        # nothing else will fill
+                        continue
                     blocker = next(
                         (ln for ln in self.lanes.values()
                          if not ln.done and ln.pending), None)
@@ -1005,7 +1480,17 @@ class StreamFleet:
                         if blocker.done:
                             self._on_lane_done(blocker)
                     else:
-                        time.sleep(0.002)
+                        # event-driven idle: every lane is waiting on
+                        # its sink side, so wait for a sink thread's
+                        # notify instead of burning a fixed 2 ms poll
+                        # (the round-15 toy-shape pitfall); the
+                        # timeout bounds a lost wakeup, and the
+                        # sequence check skips the wait when a drain
+                        # landed since this round observed the lanes
+                        metrics.add("fleet_idle_waits")
+                        with self._wake:
+                            if self._wake_seq == wake_seq:
+                                self._wake.wait(0.05)
         finally:
             metrics.set("fleet_running", 0)
         return self.results

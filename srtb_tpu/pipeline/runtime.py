@@ -629,7 +629,14 @@ class Pipeline:
                 trace_id=getattr(seg, "trace_id", 0) or None,
                 device_s=device_s,
                 achieved_msamps=msamps,
-                roofline_frac=frac))
+                roofline_frac=frac,
+                # v10: stamped by the fleet's cross-stream batch
+                # former (pipeline/fleet._BatchFormer); absent on
+                # every solo dispatch — the span omits them
+                batch_size=getattr(seg, "batch_size", None),
+                batch_wait_ms=(
+                    None if getattr(seg, "batch_wait_s", None) is None
+                    else seg.batch_wait_s * 1e3)))
 
     # ---------------------------------------------- async segment engine
 

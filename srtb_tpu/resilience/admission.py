@@ -52,6 +52,12 @@ class AdmissionController:
         self._seq = itertools.count()
         self._queue: list[tuple[int, int, str]] = []
         self.rejected: list[str] = []
+        # batch-aware admission (cross-tenant continuous batching):
+        # each stream's plan-family key, when the caller knows it.
+        # Eviction prefers streams with no co-tenant family — kicking
+        # a batch-group member also costs its neighbors the formed
+        # batch density, kicking a loner costs one tenant.
+        self._plan_keys: dict[str, str] = {}
 
     @classmethod
     def from_config(cls, cfg) -> "AdmissionController":
@@ -72,10 +78,16 @@ class AdmissionController:
         metrics.set("fleet_queued_depth", len(self._queue))
         events.emit("admission", trace=0, stream=name, info=decision)
 
-    def request(self, name: str, priority: int = 0) -> str:
+    def request(self, name: str, priority: int = 0,
+                plan_key: str | None = None) -> str:
         """One stream asking to run; returns ADMIT / QUEUE / REJECT.
         A queued stream surfaces later via :meth:`pop_ready` once
-        capacity frees up (the fleet starts its lane then)."""
+        capacity frees up (the fleet starts its lane then).
+        ``plan_key`` (optional) is the stream's plan-family key; the
+        eviction tie-break prefers keeping families with co-tenants
+        together (batch-aware admission)."""
+        if plan_key is not None:
+            self._plan_keys[name] = plan_key
         if self.max_streams <= 0 or len(self.running) < self.max_streams:
             self.running.add(name)
             self._mark("admit", name)
@@ -93,7 +105,8 @@ class AdmissionController:
             # the new request outranks the worst queued entry: the
             # queue keeps the highest-priority waiters, the evictee
             # is rejected in the newcomer's place
-            evicted = self._queue.pop()[-1]
+            evicted = self._queue.pop(self._evict_index())[-1]
+            self._plan_keys.pop(evicted, None)
             self.rejected.append(evicted)
             self._mark("reject", evicted)
             log.warning(f"[admission] queued stream {evicted!r} "
@@ -102,11 +115,33 @@ class AdmissionController:
             self._queue.sort()
             self._mark("queue", name)
             return QUEUE
+        self._plan_keys.pop(name, None)
         self.rejected.append(name)
         self._mark("reject", name)
         log.warning(f"[admission] fleet over capacity: rejected "
                     f"stream {name!r} (priority {priority})")
         return REJECT
+
+    def _evict_index(self) -> int:
+        """Which queue entry an outranking request displaces: within
+        the lowest-priority band (the only candidates — priority
+        order is never violated), a stream whose plan family has NO
+        co-tenant among running or queued streams goes first, newest
+        arrival first; with no loner, the newest arrival of the band
+        (the pre-batching behavior).  Streams without a known plan
+        key count as loners."""
+        band = self._queue[-1][0]
+        idxs = [i for i, e in enumerate(self._queue) if e[0] == band]
+        counts: dict[str, int] = {}
+        for n in list(self.running) + [e[-1] for e in self._queue]:
+            k = self._plan_keys.get(n)
+            if k is not None:
+                counts[k] = counts.get(k, 0) + 1
+        for i in reversed(idxs):
+            k = self._plan_keys.get(self._queue[i][-1])
+            if k is None or counts.get(k, 0) <= 1:
+                return i
+        return idxs[-1]
 
     def pop_ready(self) -> str | None:
         """Highest-priority queued stream if capacity allows, else
@@ -123,6 +158,7 @@ class AdmissionController:
     def release(self, name: str) -> None:
         """A running stream finished (or failed): frees its slot."""
         self.running.discard(name)
+        self._plan_keys.pop(name, None)
         metrics.set("fleet_running", len(self.running))
 
     @property

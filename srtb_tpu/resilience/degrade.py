@@ -167,20 +167,33 @@ class FleetShedPolicy:
                    hold=int(getattr(cfg, "degrade_hold_segments", 3)))
 
     def observe(self, pressure: float, loss_active: bool,
-                lanes: list[tuple[str, int, bool]]) -> set[str]:
+                lanes: list[tuple]) -> set[str]:
         """One fleet-scheduler observation.  ``pressure`` is the
         fraction of running lanes that waited on their sink since the
         last observation; ``lanes`` is [(name, priority, real_time)]
-        for every RUNNING lane.  Returns the set of stream names
-        currently force-shed (their lanes drop whole segments as
-        accounted per-stream loss until restored)."""
-        live = {name for name, _, _ in lanes}
+        — or [(name, priority, real_time, batched)] when the fleet
+        runs cross-stream batching — for every RUNNING lane.  Returns
+        the set of stream names currently force-shed (their lanes
+        drop whole segments as accounted per-stream loss until
+        restored).
+
+        Batch-aware shed: within a priority band, an UNBATCHED lane
+        sheds first — shedding a batch-group member also degrades its
+        whole family (the formed batches thin out for every
+        co-tenant), while shedding a solo lane costs one tenant.
+        Restore order mirrors it (batched members come back first)."""
+        lanes4 = [(e[0], e[1], e[2],
+                   bool(e[3]) if len(e) > 3 else False)
+                  for e in lanes]
+        live = {name for name, _, _, _ in lanes4}
         self.shed &= live  # finished lanes leave the shed set
         sheddable = sorted(
-            ((prio, name) for name, prio, rt in lanes
+            ((prio, batched, name)
+             for name, prio, rt, batched in lanes4
              if rt and name not in self.shed))
         restorable = sorted(
-            ((prio, name) for name, prio, _ in lanes
+            ((prio, batched, name)
+             for name, prio, _, batched in lanes4
              if name in self.shed), reverse=True)
         if pressure >= self.high or loss_active:
             self._above += 1
@@ -191,7 +204,7 @@ class FleetShedPolicy:
         else:
             self._above = self._below = 0
         if self._above >= self.hold and sheddable:
-            prio, name = sheddable[0]
+            prio, _batched, name = sheddable[0]
             self.shed.add(name)
             self._above = 0
             metrics.add("fleet_sheds")
@@ -203,7 +216,7 @@ class FleetShedPolicy:
                 f"(loss={loss_active}): shedding lowest-priority "
                 f"real-time stream {name!r} (priority {prio})")
         elif self._below >= self.hold and restorable:
-            prio, name = restorable[0]
+            prio, _batched, name = restorable[0]
             self.shed.discard(name)
             self._below = 0
             metrics.add("fleet_restores")

@@ -11,8 +11,8 @@ Usage::
     python -m srtb_tpu.tools.archive_replay \
         --files "obs1.bin,obs2.bin" --out-dir replay_out \
         [--config srtb_config.cfg] [--set key=value ...] \
-        [--lanes 2] [--micro-batch 4] [--inflight 8] \
-        [--max-segments N] [--no-waterfall]
+        [--lanes 2] [--micro-batch 4] [--fleet-batch B] \
+        [--inflight 8] [--max-segments N] [--no-waterfall]
 
 ``--set`` applies config options on top of ``--config`` (same syntax
 as the config file, e.g. ``--set search_mode=periodicity``).
@@ -82,7 +82,8 @@ def run_replay(args) -> int:
         lanes=args.lanes, micro_batch=args.micro_batch,
         inflight=args.inflight,
         keep_waterfall=not args.no_waterfall,
-        max_segments_per_file=args.max_segments or None)
+        max_segments_per_file=args.max_segments or None,
+        fleet_batch=args.fleet_batch)
     report = engine.run().as_dict()
     print(json.dumps(report, sort_keys=True), flush=True)
     return 0 if report["ok"] else 1
@@ -367,6 +368,11 @@ def main(argv=None) -> int:
     ap.add_argument("--lanes", type=int, default=2,
                     help="files replayed concurrently (fleet lanes)")
     ap.add_argument("--micro-batch", type=int, default=4)
+    ap.add_argument("--fleet-batch", type=int, default=0,
+                    help="cross-tenant batch width: fold ready "
+                         "segments from DIFFERENT files into one "
+                         "vmapped dispatch (needs --micro-batch 1; "
+                         "0 = off)")
     ap.add_argument("--inflight", type=int, default=8)
     ap.add_argument("--max-segments", type=int, default=0,
                     help="cap segments per file (0 = whole file)")

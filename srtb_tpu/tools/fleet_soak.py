@@ -22,6 +22,16 @@ the golden reference — and then (2) together through a
   ONE compile for the shared plan family across all streams
   (``hits == N - 1``).
 
+``--batch B`` runs the soak with cross-tenant continuous batching
+armed (``fleet_batch_max=B``): the gate swaps healthy bit-identity
+for the documented vmap contract (``.bin`` baseband still bitwise,
+float artifacts — waterfall ``.npy``, time-series ``.tim`` —
+``np.allclose``, detection DECISIONS still exact) and adds the
+batching-economy checks: journal records carry ``batch_size``, the
+journal-derived device dispatch count is at most half the drained
+segment count, and the victim's faults never retire a neighbor out
+of the shared batch group.
+
 ``--selftest`` proves the gate is sharp: an UNSCOPED fault plan (no
 stream selector — it arms in every lane) must FAIL the healthy-
 journal attribution check, and a scoped single-oom run must pass.
@@ -33,7 +43,8 @@ the PERF.md round-15 measurement.
 Usage::
 
     python -m srtb_tpu.tools.fleet_soak [--streams N] [--segments N]
-        [--log2n N] [--plan PLAN] [--selftest] [--ab [--reps R]]
+        [--log2n N] [--plan PLAN] [--batch B] [--selftest]
+        [--ab [--reps R]]
 
 Exit 0 on a passing gate (or sharp selftest), 1 on any failure.
 """
@@ -128,6 +139,51 @@ class _DecisionTap:
                          bool(positive)))
 
 
+# the documented vmap tolerance (the archive micro-batch precedent,
+# tools/archive_replay.py): batching stacks segments into one vmapped
+# program, which may reassociate float32 reductions — detection
+# decisions and .bin baseband bytes stay exact, float artifacts stay
+# numerically close with an amplitude-relative absolute term
+VMAP_RTOL = 1e-5
+VMAP_ATOL_FRAC = 1e-4
+
+
+def _load_float(path: str):
+    """Float artifact loader for the vmap-tolerance comparison; None
+    for artifact kinds that have no float representation."""
+    if path.endswith(".npy"):
+        return np.load(path)
+    if path.endswith(".tim"):
+        return np.fromfile(path, dtype=np.float32)
+    return None
+
+
+def _artifacts_close(solo_dir: str, fleet_dir: str, solo_map: dict,
+                     fleet_map: dict) -> str | None:
+    """Batched-mode output comparison: identical relative-name sets,
+    ``.bin`` bitwise, float artifacts within the vmap tolerance.
+    Returns a failure description, or None when the gate holds."""
+    if set(fleet_map) != set(solo_map):
+        return (f"output name sets differ (fleet {sorted(fleet_map)} "
+                f"vs solo {sorted(solo_map)})")
+    for rel in sorted(solo_map):
+        if fleet_map[rel] == solo_map[rel]:
+            continue  # bitwise identical — always acceptable
+        if rel.endswith(".bin"):
+            return (f"{rel}: baseband .bin bytes differ (batching "
+                    "must not touch raw capture)")
+        a = _load_float(os.path.join(fleet_dir, rel))
+        b = _load_float(os.path.join(solo_dir, rel))
+        if a is None or b is None:
+            return f"{rel}: differs and is not a float artifact"
+        atol = VMAP_ATOL_FRAC * max(float(np.abs(b).max()), 1.0)
+        if a.shape != b.shape or not np.allclose(
+                a, b, rtol=VMAP_RTOL, atol=atol):
+            return (f"{rel}: float artifact outside the vmap "
+                    f"tolerance (rtol={VMAP_RTOL}, atol={atol:g})")
+    return None
+
+
 def _solo_run(cfg) -> tuple:
     """One golden single-stream run; returns (stats, decisions)."""
     from srtb_tpu.io.writers import WriteSignalSink
@@ -144,10 +200,12 @@ def _solo_run(cfg) -> tuple:
 
 def run_soak(streams: int = 3, segments: int = 5, log2n: int = 13,
              plan: str | None = None, seed: int = 0,
-             tmpdir: str | None = None) -> dict:
+             tmpdir: str | None = None, batch: int = 0) -> dict:
     """One full soak (solo goldens + fleet run + the gate).  Returns
     the report dict; raises :class:`SoakFailure` on any broken
-    invariant."""
+    invariant.  ``batch >= 2`` arms cross-tenant continuous batching
+    (``fleet_batch_max=batch``) and swaps healthy bit-identity for
+    the vmap-tolerance contract plus the batching-economy checks."""
     from srtb_tpu.io.writers import WriteSignalSink
     from srtb_tpu.pipeline.fleet import StreamFleet, StreamSpec
     from srtb_tpu.resilience.faults import parse_plan
@@ -156,6 +214,7 @@ def run_soak(streams: int = 3, segments: int = 5, log2n: int = 13,
 
     tmp = tmpdir or tempfile.mkdtemp(prefix="srtb_fleet_")
     n = 1 << log2n
+    batch = max(0, int(batch))
     names = _stream_names(streams)
     victim = names[0]
     if plan is None:
@@ -197,7 +256,8 @@ def run_soak(streams: int = 3, segments: int = 5, log2n: int = 13,
         os.makedirs(run_dir, exist_ok=True)
         jpaths[name] = os.path.join(tmp, f"journal_{name}.jsonl")
         cfg = _cfg(tmp, name, run_dir, n, fault_plan=plan,
-                   telemetry_journal_path=jpaths[name])
+                   telemetry_journal_path=jpaths[name],
+                   fleet_batch_max=batch)
         taps[name] = _DecisionTap()
         specs.append(StreamSpec(
             name=name, cfg=cfg,
@@ -218,15 +278,28 @@ def run_soak(streams: int = 3, segments: int = 5, log2n: int = 13,
               f"stream {name} did not finish: {results[name].status} "
               f"({results[name].error!r})")
 
-    # (a) healthy streams: output sets bit-identical to solo
+    # (a) healthy streams: outputs equal to solo — bit-identical when
+    # batching is off, the vmap-tolerance contract when it is on
+    # (batching folds several tenants into one vmapped dispatch, so
+    # float artifacts may differ in the last bits; .bin baseband and
+    # detection decisions must not)
     for name in names:
         if name in victims:
             continue
-        fleet_set = snapshot_outputs(os.path.join(tmp, f"fleet_{name}"))
-        check(fleet_set == solo_out[name],
-              f"healthy stream {name}: fleet output set differs from "
-              f"its solo golden run (fleet {sorted(fleet_set)} vs "
-              f"solo {sorted(solo_out[name])})")
+        fleet_dir = os.path.join(tmp, f"fleet_{name}")
+        fleet_set = snapshot_outputs(fleet_dir)
+        if batch >= 2:
+            why = _artifacts_close(os.path.join(tmp, f"solo_{name}"),
+                                   fleet_dir, solo_out[name],
+                                   fleet_set)
+            check(why is None,
+                  f"healthy stream {name} (batched): {why}")
+        else:
+            check(fleet_set == solo_out[name],
+                  f"healthy stream {name}: fleet output set differs "
+                  f"from its solo golden run (fleet "
+                  f"{sorted(fleet_set)} vs solo "
+                  f"{sorted(solo_out[name])})")
         for i, (a, b) in enumerate(zip(taps[name].out,
                                        solo_dec[name])):
             check(np.array_equal(a[0], b[0])
@@ -249,12 +322,14 @@ def run_soak(streams: int = 3, segments: int = 5, log2n: int = 13,
                   and np.array_equal(a[1], b[1]) and a[2] == b[2],
                   f"victim {name}: detection decision differs at "
                   f"segment {i} (recovery changed the science)")
+    recs_by: dict[str, list] = {}
     for name in names:
         recs = [json.loads(line) for line in open(jpaths[name])
                 if line.strip().startswith("{")]
-        check(recs and all(r.get("stream") == name and r["v"] == 9
+        recs_by[name] = recs
+        check(recs and all(r.get("stream") == name and r["v"] == 10
                            for r in recs),
-              f"stream {name}: v8 journal records not stream-stamped")
+              f"stream {name}: journal records not stream-stamped")
         total_demote = int(recs[-1].get("plan_demotions", 0))
         if name in victims:
             check(total_demote == n_demote,
@@ -265,6 +340,38 @@ def run_soak(streams: int = 3, segments: int = 5, log2n: int = 13,
                   f"healthy stream {name}: journal attributes "
                   f"{total_demote} demotions — the victim's fault "
                   "leaked into a neighbor's books")
+
+    # (d) batching economy (batched soak only): every drained segment
+    # is journaled, batched ones carry batch_size, and the implied
+    # device dispatch count — each record contributes 1/batch_size of
+    # a dispatch — shows real cross-tenant amortization
+    batched_dispatches = int(metrics.get("batched_dispatches"))
+    batched_segments = int(metrics.get("batched_segments"))
+    dispatch_est = 0.0
+    total_recs = 0
+    for name in names:
+        for r in recs_by[name]:
+            total_recs += 1
+            b = int(r.get("batch_size", 1) or 1)
+            check(b >= 1, f"stream {name}: journal batch_size {b}")
+            dispatch_est += 1.0 / b
+    dispatch_est = round(dispatch_est)
+    if batch >= 2:
+        check(batched_dispatches >= 1,
+              "batched soak recorded no batched_dispatches — the "
+              "batch former never fired")
+        check(batched_segments >= 2 * batched_dispatches,
+              f"batched_segments {batched_segments} < 2x "
+              f"batched_dispatches {batched_dispatches}")
+        check(dispatch_est * 2 <= total_recs,
+              f"journal-implied device dispatches {dispatch_est} > "
+              f"half of {total_recs} drained segments — batching "
+              "amortized too little")
+    else:
+        check(batched_dispatches == 0 and all(
+                  "batch_size" not in r
+                  for name in names for r in recs_by[name]),
+              "unbatched soak journaled batch_size fields")
 
     # (c) shared plan cache: one compile per family
     check(compiles == 1,
@@ -280,6 +387,11 @@ def run_soak(streams: int = 3, segments: int = 5, log2n: int = 13,
         "drained": {k: results[k].drained for k in names},
         "dropped": {k: int(dropped_by.get(k, 0)) for k in names},
         "plan_compiles": compiles, "plan_cache_hits": hits,
+        "fleet_batch_max": batch,
+        "batched_dispatches": batched_dispatches,
+        "batched_segments": batched_segments,
+        "device_dispatches_est": dispatch_est,
+        "journaled_segments": total_recs,
         "ok": True,
     }
 
@@ -364,6 +476,11 @@ def main(argv=None) -> int:
     ap.add_argument("--plan", default=None,
                     help="explicit fault plan (stream-selector scoped;"
                          " default faults stream0)")
+    ap.add_argument("--batch", type=int, default=0,
+                    help="fleet_batch_max for a batched soak (>= 2 "
+                         "arms cross-tenant continuous batching; the "
+                         "gate switches to the vmap-tolerance "
+                         "contract + batching-economy checks)")
     ap.add_argument("--selftest", action="store_true",
                     help="prove the gate catches cross-stream leakage")
     ap.add_argument("--ab", action="store_true",
@@ -387,7 +504,7 @@ def main(argv=None) -> int:
     try:
         report = run_soak(streams=args.streams, segments=args.segments,
                           log2n=args.log2n, plan=args.plan,
-                          seed=args.seed)
+                          seed=args.seed, batch=args.batch)
     except SoakFailure as e:
         print(json.dumps({"ok": False, "failure": str(e)}))
         print(f"fleet-soak: GATE FAILED — {e}", file=sys.stderr)

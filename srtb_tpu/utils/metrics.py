@@ -348,6 +348,12 @@ class Metrics:
         "fleet_running": "Streams currently running in the fleet",
         "fleet_queued_depth": "Streams waiting in the admission queue",
         "fleet_sheds": "Fleet fairness force-shed transitions",
+        "batched_dispatches": "Cross-stream batched device dispatches",
+        "batched_segments": "Segments dispatched inside a "
+                            "cross-stream batch",
+        "batch_size": "Formed cross-stream batch sizes (histogram)",
+        "fleet_idle_waits": "Idle scheduler rounds parked on the "
+                            "event-driven wakeup",
         "fleet_restores": "Fleet fairness restore transitions",
         "fleet_shed_streams": "Streams currently force-shed",
         "fleet_streams_total": "Streams submitted to the fleet",

@@ -74,10 +74,18 @@ from srtb_tpu.utils.metrics import metrics
 # maps) and ``canary`` (pulse-injection verdict: injected, segment,
 # recovered/expected S/N, sensitivity ratio, ok — or just the
 # injection flag on a replayed drain).  Both ride the existing
-# ``extra`` envelope, so pre-v9 readers skip them.  Readers must
-# tolerate mixed v1-v9 journals: rotation can leave an older-schema
-# tail in the previous generation after an upgrade.
-SPAN_SCHEMA_VERSION = 9
+# ``extra`` envelope, so pre-v9 readers skip them.
+# v10 (cross-tenant continuous batching): adds ``batch_size`` (how
+# many segments — possibly from DIFFERENT streams — shared this
+# segment's device dispatch; pipeline/fleet._BatchFormer) and
+# ``batch_wait_ms`` (wall clock this segment waited in the former
+# between becoming ready and the shared dispatch — the linger cost
+# the fleet_batch_linger_ms deadline bounds).  Both OMITTED on solo
+# dispatches (never a fake 1/0): a journal with no batching armed
+# reads exactly as v9.  Readers must tolerate mixed v1-v10 journals:
+# rotation can leave an older-schema tail in the previous generation
+# after an upgrade.
+SPAN_SCHEMA_VERSION = 10
 
 # gauge names shared between the pipeline (writer) and health() (reader)
 LAST_SEGMENT_MONOTONIC = "last_segment_monotonic"
@@ -264,7 +272,9 @@ def segment_span(segment: int, stages_s: dict, queue_depth: int,
                  trace_id: int | None = None,
                  device_s: float | None = None,
                  achieved_msamps: float | None = None,
-                 roofline_frac: float | None = None) -> dict:
+                 roofline_frac: float | None = None,
+                 batch_size: int | None = None,
+                 batch_wait_ms: float | None = None) -> dict:
     """One journal record.  ``stages_s`` maps stage name -> seconds for
     THIS segment; loss/drop counters are the cumulative registry values
     at drain time (deltas between consecutive records localize a loss
@@ -346,6 +356,13 @@ def segment_span(segment: int, stages_s: dict, queue_depth: int,
         rec["device_ms"] = round(max(device_s, 0.0) * 1e3, 3)
     if achieved_msamps is not None:
         rec["achieved_msamps"] = round(achieved_msamps, 2)
+    if batch_size is not None:
+        # v10: segments sharing this segment's device dispatch (the
+        # cross-stream batch former); omitted on solo dispatches —
+        # never a fake 1
+        rec["batch_size"] = int(batch_size)
+    if batch_wait_ms is not None:
+        rec["batch_wait_ms"] = round(max(batch_wait_ms, 0.0), 3)
     if roofline_frac is not None:
         rec["roofline_frac"] = round(roofline_frac, 4)
     if active_plan is not None:

@@ -1,0 +1,88 @@
+#!/bin/bash
+# Round-10 TPU hardware backlog: cross-tenant continuous batching
+# (fleet_batch, ISSUE 17) — the fleet's batch former folds ready
+# segments from N same-shape streams into ONE vmapped dispatch, so
+# the per-dispatch host + tunnel RTT (~60 ms at 2^27, PERF.md) is
+# paid once per batch instead of once per tenant.  These legs are the
+# on/off A/B: identical N-stream fleets, the only difference is
+# fleet_batch_max (N vs 0).  Read the rows' "batched_dispatches" /
+# "batch_size_mean" / "device_dispatches" fields — the off leg must
+# show device_dispatches == drained, the on leg ~drained/N.
+# On top of the still-undrained r9 backlog.  Safe to re-run; each
+# block is independent.  Run from the repo root with the TPU visible
+# (tools_tpu_watcher.sh fires it automatically).
+#
+#   bash tools_tpu_r10_queue.sh [quick]
+#
+# "quick" drains only the new r10 rows (skips the r9 backlog and the
+# long 2^30 blocks).
+set -u
+OUT=${SRTB_PERF_OUT:-PERF_TPU.jsonl}
+stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
+note() { echo "{\"ts\": \"$(stamp)\", \"variant\": \"note\", \"note\": \"$1\"}" >> "$OUT"; }
+run() {
+  local tag="$1"; shift
+  echo "== $tag =="
+  local line
+  line=$("$@" 2>/dev/null | grep '^{' | tail -1)
+  if [ -n "$line" ]; then
+    echo "{\"ts\": \"$(stamp)\", \"variant\": \"$tag\", \"result\": $line}" >> "$OUT"
+    echo "$line"
+  else
+    echo "{\"ts\": \"$(stamp)\", \"variant\": \"$tag\", \"error\": true}" >> "$OUT"
+  fi
+}
+
+QUICK=${1:-}
+
+# ---- 0. the r9 backlog first (staged_ffuse A/B + Mosaic probe) ----
+if [ "$QUICK" != "quick" ] && [ -f tools_tpu_r9_queue.sh ]; then
+  note "r10 queue: draining r9 backlog first"
+  bash tools_tpu_r9_queue.sh quick
+fi
+
+note "r10 queue start: cross-tenant continuous batching (fleet_batch) A/B"
+
+# ---- 1. fleet-batch A/B at 2^27, 4 streams: the headline pair.
+#          Alternated off/on/off/on so drift between legs reads as
+#          noise, not as the win (the PERF.md round-18 discipline).
+for rep in 1 2; do
+  run fleet_batch_off_27_$rep env SRTB_BENCH_LOG2N=27 \
+      SRTB_BENCH_FLEET_STREAMS=4 SRTB_BENCH_FLEET_SEGMENTS=6 \
+      SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=1800 \
+      python bench.py --fleet-batch off
+  run fleet_batch_on_27_$rep env SRTB_BENCH_LOG2N=27 \
+      SRTB_BENCH_FLEET_STREAMS=4 SRTB_BENCH_FLEET_SEGMENTS=6 \
+      SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=1800 \
+      python bench.py --fleet-batch on
+done
+
+# ---- 2. width sweep at 2^27: where does the amortization flatten?
+#          (2 streams = the smallest batch; 8 probes whether a wider
+#          vmap still fits HBM at this shape — an error row here is
+#          an answer, not a failure.)
+run fleet_batch_on_27_w2 env SRTB_BENCH_LOG2N=27 \
+    SRTB_BENCH_FLEET_STREAMS=2 SRTB_BENCH_FLEET_SEGMENTS=6 \
+    SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=1800 \
+    python bench.py --fleet-batch on
+run fleet_batch_on_27_w8 env SRTB_BENCH_LOG2N=27 \
+    SRTB_BENCH_FLEET_STREAMS=8 SRTB_BENCH_FLEET_SEGMENTS=4 \
+    SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=2400 \
+    python bench.py --fleet-batch on
+
+if [ "$QUICK" = "quick" ]; then exit 0; fi
+
+# ---- 3. smaller-segment regime, 2^23: dispatch overhead is a larger
+#          fraction of segment time here, so the batching win should
+#          GROW as the segment shrinks — the many-small-files archive
+#          case in fleet form.
+run fleet_batch_off_23 env SRTB_BENCH_LOG2N=23 \
+    SRTB_BENCH_FLEET_STREAMS=4 SRTB_BENCH_FLEET_SEGMENTS=12 \
+    SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=1200 \
+    python bench.py --fleet-batch off
+run fleet_batch_on_23 env SRTB_BENCH_LOG2N=23 \
+    SRTB_BENCH_FLEET_STREAMS=4 SRTB_BENCH_FLEET_SEGMENTS=12 \
+    SRTB_BENCH_REPS=3 SRTB_BENCH_DEADLINE=1200 \
+    python bench.py --fleet-batch on
+
+note "r10 queue done"

@@ -12,10 +12,10 @@
 #   nohup bash tools_tpu_watcher.sh >/dev/null 2>&1 &   # arm
 #   bash ci.sh --hardware                                # same, via CI
 #
-# Env: SRTB_TPU_QUEUE (default tools_tpu_r9_queue.sh), SRTB_WATCH_LOG.
+# Env: SRTB_TPU_QUEUE (default tools_tpu_r10_queue.sh), SRTB_WATCH_LOG.
 set -u
 cd "$(dirname "$0")"
-QUEUE=${SRTB_TPU_QUEUE:-tools_tpu_r9_queue.sh}
+QUEUE=${SRTB_TPU_QUEUE:-tools_tpu_r10_queue.sh}
 LOG=${SRTB_WATCH_LOG:-/tmp/tpu_watcher.log}
 PIDFILE=/tmp/tpu_watcher.pid
 
