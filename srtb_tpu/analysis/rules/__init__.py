@@ -3,13 +3,19 @@
 Each rule module exposes ``RULE`` (the id used in findings, pragmas and
 the baseline), ``DOC`` (one line for ``--list-rules``) and
 ``check(project, module) -> iterator of Finding``.
+
+The four srtb-tsan concurrency rules (lock_order, blocking_lock,
+condvar, atomicity) share lock identity and thread-entry resolution
+via ``_concurrency``; their runtime twin is ``analysis/tsan.py``.
 """
 
-from srtb_tpu.analysis.rules import (donate, dtype_drift, host_callback,
-                                     host_sync, recompile, shared_state,
-                                     swallowed_except)
+from srtb_tpu.analysis.rules import (atomicity, blocking_lock, condvar,
+                                     donate, dtype_drift, host_callback,
+                                     host_sync, lock_order, recompile,
+                                     shared_state, swallowed_except)
 
 ALL_RULES = (host_sync, host_callback, donate, recompile, dtype_drift,
-             shared_state, swallowed_except)
+             shared_state, swallowed_except, lock_order, blocking_lock,
+             condvar, atomicity)
 
 RULE_IDS = tuple(r.RULE for r in ALL_RULES)

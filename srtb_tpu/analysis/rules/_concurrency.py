@@ -1,0 +1,264 @@
+"""Shared machinery for the srtb-tsan concurrency rules.
+
+The four concurrency rules (lock-order-inversion, blocking-under-lock,
+condvar-misuse, check-then-act) all reason about the same primitives:
+*which expressions name locks*, *which code runs while a lock is
+held* (lexically inside a ``with <lock>:`` span, or reachable through
+the project call graph from a call made inside one), and *which
+functions run on spawned threads* (the same thread-entry resolution
+``unguarded-shared-state`` uses).  This module centralizes that so the
+rules agree on lock identity — a cycle between the names rule A
+derives and the names rule B derives would be meaningless.
+
+Lock identity is a static approximation: ``self._x_lock`` canonicalizes
+to ``"<rel>::<Class>._x_lock"`` (instance identity is erased — good
+enough for the engine, where every lock attribute belongs to exactly
+one object per scope), bare names to ``"<rel>::<scope>:<name>"``.
+Only names containing a lock-ish token count, so ``with open(...)``
+and ``with tempfile...`` spans never pollute the graph.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from srtb_tpu.analysis.core import FunctionInfo, ModuleSource, Project
+
+# tokens that mark a name as a lock/condvar (superset of
+# shared_state._LOCKISH: the fleet's scheduler condvar is `_wake`)
+LOCKISH = ("lock", "_cv", "cv", "cond", "mutex", "_mu", "wake", "sem")
+
+# condition-variable method names (threading.Condition)
+CV_WAIT = ("wait", "wait_for")
+CV_NOTIFY = ("notify", "notify_all")
+
+
+def is_lockish(text: str) -> bool:
+    low = text.lower()
+    return any(tok in low for tok in LOCKISH)
+
+
+def lock_key(mod: ModuleSource, info: FunctionInfo,
+             expr: ast.expr) -> str | None:
+    """Canonical cross-function identity of a lock expression, or None
+    when the expression does not name a lock-ish object."""
+    try:
+        text = ast.unparse(expr)
+    except Exception:  # noqa: BLE001 - exotic expr, not a lock name
+        return None
+    if not is_lockish(text):
+        return None
+    chain: list[str] = []
+    t = expr
+    while isinstance(t, ast.Attribute):
+        chain.append(t.attr)
+        t = t.value
+    if not isinstance(t, ast.Name):
+        return None
+    if t.id == "self" and chain:
+        cls = info.class_name or "<no-class>"
+        return f"{mod.rel}::{cls}." + ".".join(reversed(chain))
+    parts = ".".join([t.id] + list(reversed(chain)))
+    scope = info.qualname if info is not None else "<module>"
+    return f"{mod.rel}::{scope}:{parts}"
+
+
+def pretty(key: str) -> str:
+    """Human form of a lock key (drop the file prefix)."""
+    return key.split("::", 1)[-1]
+
+
+def span_contains(outer: ast.AST, node: ast.AST) -> bool:
+    """Lexical containment by line span (the same approximation
+    shared_state._guarded uses)."""
+    line = getattr(node, "lineno", None)
+    if line is None:
+        return False
+    end = getattr(outer, "end_lineno", outer.lineno)
+    return outer.lineno <= line <= end
+
+
+def with_locks(mod: ModuleSource, info: FunctionInfo):
+    """Yield ``(key, with_node, item_expr)`` for every lock-ish
+    ``with`` item in this function's own body."""
+    for node in info.body_nodes():
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            key = lock_key(mod, info, item.context_expr)
+            if key is not None:
+                yield key, node, item.context_expr
+
+
+def guarded_span(mod: ModuleSource, info: FunctionInfo,
+                 node: ast.AST) -> bool:
+    """Is ``node``'s ENTIRE span inside one lock-ish with block?  The
+    check-then-act rule needs whole-statement containment: a test
+    outside the lock with the mutation inside is exactly the bug."""
+    end = getattr(node, "end_lineno", node.lineno)
+    for _key, w, _e in with_locks(mod, info):
+        wend = getattr(w, "end_lineno", w.lineno)
+        if w.lineno <= node.lineno and end <= wend and w is not node:
+            return True
+    return False
+
+
+def thread_entries(project: Project, mod: ModuleSource) -> set:
+    """Functions handed to ``threading.Thread``/``Timer`` or the
+    framework's ``start_pipe`` in this module (shared with
+    unguarded-shared-state — one definition of "runs on a thread")."""
+    from srtb_tpu.analysis.rules.shared_state import _entry_functions
+    return _entry_functions(project, mod)
+
+
+# ------------------------------------------------------------------
+# project-wide concurrency analysis (memoized on the Project object,
+# like host_sync's hot-path cache: rules run per module, the graph is
+# global)
+# ------------------------------------------------------------------
+
+
+class ConcurrencyAnalysis:
+    """Per-project lock-acquisition facts: every function's own
+    ``with <lock>`` acquisitions, the transitive closure of
+    acquisitions reachable through its calls, and the global
+    acquisition-order edge set."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        # FunctionInfo -> list[(lock_key, with_node)]
+        self.own_acquires: dict = {}
+        for m in project.modules:
+            for info in m.functions.values():
+                acq = [(k, w) for k, w, _e in with_locks(m, info)]
+                if acq:
+                    self.own_acquires[info] = acq
+        self._closure_cache: dict = {}
+        # (A, B) -> (mod, anchor_node, context_qualname, note)
+        self.edges: dict = {}
+        self._build_edges()
+
+    # -- transitive acquisitions
+
+    def acquires_closure(self, fn: FunctionInfo) -> set:
+        """Lock keys acquired by ``fn`` or anything reachable from it."""
+        hit = self._closure_cache.get(fn)
+        if hit is None:
+            hit = set()
+            for g in self.project.reachable({fn}):
+                for key, _w in self.own_acquires.get(g, ()):
+                    hit.add(key)
+            self._closure_cache[fn] = hit
+        return hit
+
+    # -- acquisition-order edges
+
+    def _build_edges(self) -> None:
+        for mod in self.project.modules:
+            for info in mod.functions.values():
+                self._edges_in(mod, info)
+
+    def _edges_in(self, mod: ModuleSource, info: FunctionInfo) -> None:
+        spans = list(with_locks(mod, info))
+        if not spans:
+            return
+        nodes = list(info.body_nodes())
+        for held, w, _e in spans:
+            # multi-item `with A, B:` orders left-to-right
+            keys = [lock_key(mod, info, it.context_expr)
+                    for it in w.items]
+            keys = [k for k in keys if k is not None]
+            if len(keys) > 1:
+                i = keys.index(held)
+                for nxt in keys[i + 1:]:
+                    self._edge(held, nxt, mod, w, info,
+                               "acquired in the same with statement")
+            for node in nodes:
+                if not span_contains(w, node) or node is w:
+                    continue
+                if isinstance(node, ast.With):
+                    for it in node.items:
+                        nxt = lock_key(mod, info, it.context_expr)
+                        if nxt is not None and nxt != held:
+                            self._edge(held, nxt, mod, node, info,
+                                       "nested with")
+                        elif nxt == held and node is not w:
+                            # re-acquiring a non-reentrant lock you
+                            # already hold: a self-deadlock
+                            self._edge(held, nxt, mod, node, info,
+                                       "re-acquired while held")
+                elif isinstance(node, ast.Call):
+                    callee = self.project.resolve_call(
+                        mod, info, node.func)
+                    if callee is None:
+                        continue
+                    for nxt in self.acquires_closure(callee):
+                        if nxt != held:
+                            self._edge(held, nxt, mod, node, info,
+                                       f"via {callee.qualname}()")
+
+    def _edge(self, a: str, b: str, mod, node, info, note) -> None:
+        self.edges.setdefault((a, b), (mod, node, info.qualname, note))
+
+    # -- cycles (strongly connected components of the edge set)
+
+    def cycles(self) -> list[list[str]]:
+        adj: dict[str, set[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        index: dict[str, int] = {}
+        low: dict[str, int] = {}
+        on_stack: set[str] = set()
+        stack: list[str] = []
+        out: list[list[str]] = []
+        counter = [0]
+
+        def strongconnect(v0):
+            work = [(v0, iter(sorted(adj[v0])))]
+            index[v0] = low[v0] = counter[0]
+            counter[0] += 1
+            stack.append(v0)
+            on_stack.add(v0)
+            while work:
+                v, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(sorted(adj[w]))))
+                        advanced = True
+                        break
+                    if w in on_stack:
+                        low[v] = min(low[v], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    pv = work[-1][0]
+                    low[pv] = min(low[pv], low[v])
+                if low[v] == index[v]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        scc.append(w)
+                        if w == v:
+                            break
+                    if len(scc) > 1 or (v, v) in self.edges:
+                        out.append(sorted(scc))
+
+        for v in sorted(adj):
+            if v not in index:
+                strongconnect(v)
+        return out
+
+
+def analysis(project: Project) -> ConcurrencyAnalysis:
+    a = getattr(project, "_tsan_concurrency", None)
+    if a is None:
+        a = project._tsan_concurrency = ConcurrencyAnalysis(project)
+    return a

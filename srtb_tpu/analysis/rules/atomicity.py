@@ -1,0 +1,128 @@
+"""check-then-act: a test on thread-shared state and the mutation it
+gates are not atomic.
+
+``unguarded-shared-state`` sees each attribute access in isolation: if
+every *mutation* of ``self.active`` sits under the lock it stays
+silent.  But ``if self.active: ... self.active = False`` is still a
+race when the ``if`` reads outside the lock — another thread can flip
+the flag between the check and the act, and both sides win.  This rule
+tracks attributes shared between thread-entry closures (the same
+entry-point resolution unguarded-shared-state uses) and the rest of
+the class, and flags any ``if``/``while`` whose test reads a shared
+attribute and whose body mutates it, unless the WHOLE statement sits
+inside one lock-ish ``with`` block — check and act under the same
+critical section is the fix, locking only the act is the bug.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from srtb_tpu.analysis.core import Finding, FunctionInfo, ModuleSource, Project
+from srtb_tpu.analysis.rules import _concurrency as cc
+from srtb_tpu.analysis.rules.shared_state import (
+    _EXEMPT, _MUTATORS, _mutations)
+
+RULE = "check-then-act"
+DOC = ("non-atomic test-then-mutate on state shared with a spawned "
+       "thread")
+
+
+def _attr_key(info: FunctionInfo, expr: ast.expr) -> str | None:
+    """"Class.self.attr" for a self-attribute chain (same key shape as
+    unguarded-shared-state, so the two rules agree on identity)."""
+    chain = []
+    t = expr
+    while isinstance(t, ast.Attribute):
+        chain.append(t.attr)
+        t = t.value
+    if isinstance(t, ast.Name) and t.id == "self" and chain:
+        cls = info.class_name or "<no-class>"
+        return f"{cls}.self.{chain[-1]}"
+    return None
+
+
+def _reads(info: FunctionInfo, node: ast.AST):
+    """Self-attr keys read anywhere under ``node``."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load):
+            key = _attr_key(info, n)
+            if key is not None:
+                yield key
+
+
+def _writes(info: FunctionInfo, node: ast.AST):
+    """Self-attr keys mutated anywhere under ``node``."""
+    for n in ast.walk(node):
+        targets = []
+        if isinstance(n, ast.Assign):
+            targets = n.targets
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            targets = [n.target]
+        for t in targets:
+            if isinstance(t, ast.Subscript):
+                t = t.value
+            key = _attr_key(info, t) if isinstance(
+                t, ast.Attribute) else None
+            if key is not None:
+                yield key
+        if isinstance(n, ast.Call) and isinstance(
+                n.func, ast.Attribute) and n.func.attr in _MUTATORS:
+            key = _attr_key(info, n.func.value)
+            if key is not None:
+                yield key
+
+
+def _shared_keys(project: Project, mod: ModuleSource) -> set[str]:
+    """Self-attr keys mutated on one side of the thread boundary and
+    touched (read or mutated) on the other."""
+    entries = cc.thread_entries(project, mod)
+    if not entries:
+        return set()
+    entry_closure = {f for f in project.reachable(entries)
+                     if f.module is mod}
+    muts: dict[bool, set[str]] = {True: set(), False: set()}
+    touch: dict[bool, set[str]] = {True: set(), False: set()}
+    for info in mod.functions.values():
+        if info.name in _EXEMPT:
+            continue
+        side = info in entry_closure
+        for key, _node, _g in _mutations(mod, info):
+            if ".self." in key:
+                muts[side].add(key)
+                touch[side].add(key)
+        for key in _reads(info, info.node):
+            touch[side].add(key)
+    return (muts[True] & touch[False]) | (muts[False] & touch[True])
+
+
+def check(project: Project, mod: ModuleSource):
+    shared = _shared_keys(project, mod)
+    if not shared:
+        return
+    for info in mod.functions.values():
+        if info.name in _EXEMPT:
+            continue
+        for node in info.body_nodes():
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            tested = set(_reads(info, node.test)) & shared
+            if not tested:
+                continue
+            acted = set()
+            for stmt in node.body + node.orelse:
+                acted |= set(_writes(info, stmt))
+            hits = sorted(tested & acted)
+            if not hits or cc.guarded_span(mod, info, node):
+                continue
+            attrs = ", ".join(
+                f"'{k.replace('.self.', '.')}'" for k in hits)
+            yield Finding(
+                RULE, mod.path, mod.rel, node.lineno, node.col_offset,
+                f"check-then-act on {attrs} (shared with a spawned "
+                "thread) is not atomic — another thread can change it "
+                "between the test and the mutation; hold the lock "
+                "across BOTH (move the if/while inside the with "
+                "block) or record the exclusivity argument in the "
+                "baseline",
+                info.qualname, mod.line_text(node.lineno))

@@ -1,0 +1,146 @@
+"""blocking-under-lock: an unbounded blocking call made while a lock
+is held.
+
+A lock held across a blocking call convoys every other thread that
+needs it for the full duration of the block — on the fleet scheduler
+or a sink-pipe hot path that turns one slow tenant's I/O into a
+fleet-wide stall, and combined with any second lock it upgrades a
+latency bug into a deadlock.  Flagged while a ``with <lock>`` span is
+open (lexically, or reachable through the call graph from a call made
+inside one):
+
+- ``os.fdatasync`` / ``os.fsync`` (storage-durability barrier:
+  milliseconds to seconds on a busy disk);
+- socket ``.recv``/``.recvfrom``/``.recv_into`` (peer-paced);
+- queue ``.get()`` with no timeout (blocks until a producer shows up
+  — the framework's ``WorkQueue.pop`` uses a 50 ms timeout loop for
+  exactly this reason);
+- ``.join()`` on a pipe/thread/process (waits on another thread,
+  which may need the held lock: the classic self-deadlock);
+- ``.wait(...)`` on a DIFFERENT condition/lock than the one held
+  (waiting on cv B under lock A deadlocks the notifier if it needs A;
+  waiting on the cv you hold is the sanctioned idiom and exempt).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from srtb_tpu.analysis.core import Finding, FunctionInfo, ModuleSource, Project
+from srtb_tpu.analysis.rules import _concurrency as cc
+
+RULE = "blocking-under-lock"
+DOC = ("fdatasync / socket recv / untimed queue.get / join / foreign "
+       "condition-wait while a lock is held")
+
+_RECV = ("recv", "recvfrom", "recv_into")
+_JOINISH = ("pipe", "thread", "proc", "worker")
+
+
+def _blocking(mod: ModuleSource, info: FunctionInfo, node: ast.Call,
+              held: str | None):
+    """Describe why ``node`` is an unbounded blocking call, or None.
+    ``held`` is the lock key currently held (None = classifying a
+    callee's body for the transitive scan, where any foreign wait
+    counts)."""
+    dotted = mod.dotted_name(node.func)
+    if dotted in ("os.fdatasync", "os.fsync"):
+        return f"{dotted}() durability barrier"
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    attr = node.func.attr
+    recv = node.func.value
+    try:
+        recv_text = ast.unparse(recv)
+    except Exception:  # noqa: BLE001 - exotic receiver
+        return None
+    if attr in _RECV:
+        return f"socket {attr}() on '{recv_text}'"
+    if attr == "get" and not node.args \
+            and not any(kw.arg == "timeout" for kw in node.keywords):
+        # x.get() with no args and no timeout: the blocking queue
+        # read (dict.get always passes a key)
+        return f"untimed blocking get() on '{recv_text}'"
+    if attr == "join" and dotted != "os.path.join" \
+            and not isinstance(recv, ast.Constant) \
+            and any(tok in recv_text.lower() for tok in _JOINISH):
+        return f"join() on '{recv_text}'"
+    if attr in cc.CV_WAIT:
+        key = cc.lock_key(mod, info, recv)
+        if key is not None and key != held:
+            return (f"wait on '{recv_text}' (a different lock than "
+                    "the one held)")
+    return None
+
+
+def _own_blocking(mod: ModuleSource, info: FunctionInfo):
+    """(desc, node) for blocking calls in this function's own body
+    that are NOT under a with-span of their own (those are reported
+    at the holding site)."""
+    out = []
+    for node in info.body_nodes():
+        if isinstance(node, ast.Call):
+            desc = _blocking(mod, info, node, held=None)
+            if desc is not None:
+                out.append((desc, node))
+    return out
+
+
+def _closure_blocking(project: Project, fn: FunctionInfo):
+    """Blocking calls reachable from ``fn`` (memoized)."""
+    cache = getattr(project, "_blocking_closure", None)
+    if cache is None:
+        cache = project._blocking_closure = {}
+    hit = cache.get(fn)
+    if hit is None:
+        hit = []
+        for g in project.reachable({fn}):
+            for desc, node in _own_blocking(g.module, g):
+                hit.append((desc, g, node))
+        cache[fn] = hit
+    return hit
+
+
+def check(project: Project, mod: ModuleSource):
+    for info in mod.functions.values():
+        spans = list(cc.with_locks(mod, info))
+        if not spans:
+            continue
+        nodes = list(info.body_nodes())
+        seen: set[tuple] = set()
+        for held, w, _e in spans:
+            for node in nodes:
+                if not isinstance(node, ast.Call) \
+                        or not cc.span_contains(w, node):
+                    continue
+                desc = _blocking(mod, info, node, held=held)
+                if desc is not None:
+                    if (node.lineno, node.col_offset, desc) in seen:
+                        continue
+                    seen.add((node.lineno, node.col_offset, desc))
+                    yield Finding(
+                        RULE, mod.path, mod.rel, node.lineno,
+                        node.col_offset,
+                        f"{desc} while holding "
+                        f"'{cc.pretty(held)}' — every thread needing "
+                        "the lock convoys behind the block; move the "
+                        "call outside the critical section or bound "
+                        "it with a timeout", info.qualname,
+                        mod.line_text(node.lineno))
+                    continue
+                callee = project.resolve_call(mod, info, node.func)
+                if callee is None:
+                    continue
+                for desc, g, _bn in _closure_blocking(project, callee):
+                    key = (node.lineno, node.col_offset, desc)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield Finding(
+                        RULE, mod.path, mod.rel, node.lineno,
+                        node.col_offset,
+                        f"call reaches {desc} (in {g.qualname}, "
+                        f"{g.module.rel}) while holding "
+                        f"'{cc.pretty(held)}' — the blocking I/O "
+                        "executes inside the critical section",
+                        info.qualname, mod.line_text(node.lineno))

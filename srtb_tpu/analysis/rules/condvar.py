@@ -1,0 +1,79 @@
+"""condvar-misuse: a condition wait outside a predicate loop, or a
+notify without the lock held.
+
+``Condition.wait`` may return spuriously and may lose a wakeup that
+landed before the wait started; the only correct shape is the
+predicate loop (``while not pred: cv.wait(...)``) — an ``if`` guard
+re-checks nothing and turns a spurious wakeup into a missed state
+transition (the fleet scheduler's idle wakeup was exactly this shape
+before this rule).  ``notify``/``notify_all`` without holding the
+condition's lock races the waiter's predicate check: the waiter can
+test the predicate, lose the CPU, miss the notify, and sleep forever.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from srtb_tpu.analysis.core import Finding, ModuleSource, Project
+from srtb_tpu.analysis.rules import _concurrency as cc
+
+RULE = "condvar-misuse"
+DOC = ("condition wait outside a while-predicate loop, or notify "
+       "without the lock held")
+
+
+def _in_predicate_loop(info, w: ast.With, node: ast.AST) -> bool:
+    """Is ``node`` inside a while loop that is itself inside the
+    with-span ``w``?  (``while True`` with a break counts: the
+    re-check is the loop body's job and deadline-bounded variants
+    spell it that way.)"""
+    for n in info.body_nodes():
+        if isinstance(n, ast.While) and cc.span_contains(w, n) \
+                and cc.span_contains(n, node) and n is not node:
+            return True
+    return False
+
+
+def check(project: Project, mod: ModuleSource):
+    for info in mod.functions.values():
+        nodes = list(info.body_nodes())
+        spans = list(cc.with_locks(mod, info))
+        for node in nodes:
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            recv_key = cc.lock_key(mod, info, node.func.value)
+            if recv_key is None:
+                continue
+            if attr == "wait":
+                # a wait on the condition you hold must sit in a
+                # predicate loop; wait_for embeds the loop itself
+                for held, w, _e in spans:
+                    if held == recv_key and cc.span_contains(w, node):
+                        if not _in_predicate_loop(info, w, node):
+                            yield Finding(
+                                RULE, mod.path, mod.rel, node.lineno,
+                                node.col_offset,
+                                f"wait on '{cc.pretty(recv_key)}' "
+                                "outside a predicate loop — a "
+                                "spurious wakeup skips the re-check; "
+                                "use `while not <predicate>: "
+                                "cv.wait(...)` (or cv.wait_for)",
+                                info.qualname,
+                                mod.line_text(node.lineno))
+                        break
+            elif attr in cc.CV_NOTIFY:
+                if not any(held == recv_key
+                           and cc.span_contains(w, node)
+                           for held, w, _e in spans):
+                    yield Finding(
+                        RULE, mod.path, mod.rel, node.lineno,
+                        node.col_offset,
+                        f"{attr}() on '{cc.pretty(recv_key)}' "
+                        "without holding its lock — the waiter can "
+                        "check its predicate, miss this notify, and "
+                        "sleep forever; wrap in `with "
+                        f"{cc.pretty(recv_key).split('.')[-1]}:`",
+                        info.qualname, mod.line_text(node.lineno))

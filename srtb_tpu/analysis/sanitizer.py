@@ -262,8 +262,7 @@ class Sanitizer:
             self.release_owners()
             leaked = termination.leaked_threads(snapshot)
             if leaked:
-                names = ", ".join(
-                    f"'{t.name}'" for t in leaked)
+                names = termination.describe_threads(leaked)
                 raise SanitizerError(
                     f"[sanitize] leaked thread(s) after run: {names} "
                     "— every thread spawned during a run must be "

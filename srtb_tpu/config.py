@@ -271,6 +271,13 @@ class Config:
     # every backend.  Serializes dispatch — a debugging mode with zero
     # cost when off.  A/B methodology: PERF.md "Sanitizer".
     sanitize: bool = False
+    # opt-in runtime concurrency checker (analysis/tsan.py): lockdep
+    # acquisition-order graph with live cycle traps, held-too-long
+    # stall log, and claim-on-first-use ownership guards on fleet lane
+    # state and batch-former group slots.  The fleet holds None when
+    # off — zero wrapper indirection on the hot path.  Driven under
+    # schedule perturbation by tools/race_soak.py.
+    tsan: bool = False
     # fail-fast watchdog on the per-segment device sync (seconds,
     # 0 = disabled): a wedged accelerator runtime otherwise hangs the
     # observation silently — on expiry the process aborts through the
@@ -612,6 +619,7 @@ class Config:
     _BOOL_FIELDS = frozenset({
         "baseband_reserve_sample", "baseband_write_all", "gui_enable",
         "use_emulated_fp64", "use_pallas", "use_pallas_sk", "sanitize",
+        "tsan",
         "degrade_enable", "chirp_exact", "manifest_fsync",
         "manifest_hash", "deterministic_timestamps", "events_enable",
         "telemetry_journal_compress", "quality_stats",

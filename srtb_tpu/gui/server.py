@@ -23,6 +23,7 @@ import os
 import re
 import threading
 
+from srtb_tpu.utils import termination
 from srtb_tpu.utils.logging import log
 
 _INDEX_TEMPLATE = """<!DOCTYPE html>
@@ -300,6 +301,7 @@ class WaterfallHTTPServer:
         self._thread = threading.Thread(target=self._serve,
                                         name="srtb-gui-server",
                                         daemon=True)
+        termination.tag_thread(self._thread)
 
     def _serve(self):
         while True:

@@ -24,6 +24,7 @@ from __future__ import annotations
 import collections
 import threading
 
+from srtb_tpu.utils import termination
 from srtb_tpu.utils.logging import log
 from srtb_tpu.utils.metrics import metrics
 
@@ -65,6 +66,7 @@ class DropOldestSegmentBuffer:
         self._error: BaseException | None = None
         self._thread = threading.Thread(target=self._pump, name=name,
                                         daemon=True)
+        termination.tag_thread(self._thread)
         self._thread.start()
 
     def _pump(self) -> None:

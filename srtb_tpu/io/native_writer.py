@@ -25,6 +25,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from srtb_tpu.utils import termination
 from srtb_tpu.utils.logging import log
 
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "..", "native",
@@ -94,6 +95,7 @@ class _DaemonWriterPool:
                                  name=f"{self.name_prefix}_{i}")
                 for i in range(self.n_threads)]
             for t in self._threads:
+                termination.tag_thread(t)
                 t.start()
         fut = Future()
         self._jobs.put((fut, fn, args))

@@ -27,6 +27,7 @@ import numpy as np
 from srtb_tpu.config import Config
 from srtb_tpu.io import formats
 from srtb_tpu.pipeline.work import SegmentWork
+from srtb_tpu.utils import termination
 from srtb_tpu.utils.metrics import metrics
 from srtb_tpu.utils.logging import log
 
@@ -408,6 +409,7 @@ class AsyncioBlockReceiver(PythonBlockReceiver):
         self._thread = threading.Thread(target=self._run_loop,
                                         name="srtb-asyncio-udp",
                                         daemon=True)
+        termination.tag_thread(self._thread)
         self._thread.start()
         # bounded wait + error propagation: a loop-setup failure (e.g. fd
         # exhaustion while creating the selector) must surface here, not

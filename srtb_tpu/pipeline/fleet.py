@@ -271,6 +271,11 @@ class _BatchFormer:
         batch; returns the slot the lane must append to ``pending``.
         Reaching ``batch_max`` flushes the family immediately (the
         slot comes back already filled)."""
+        ts = getattr(self.fleet, "_tsan", None)
+        if ts is not None:
+            # group slots are scheduler-owned (offer/pump/flush all
+            # run on the scheduler thread): claim-on-first-use
+            ts.assert_owner("former.groups")
         seg, ingest_s, offset_after = one
         slot = _BatchSlot(lane, seg, ingest_s, offset_after, index)
         proc = lane.pipe.processor
@@ -288,6 +293,9 @@ class _BatchFormer:
         """Scheduler-paced linger check: flush every family whose
         oldest live offer has waited past the deadline.  True when
         anything dispatched."""
+        ts = getattr(self.fleet, "_tsan", None)
+        if ts is not None:
+            ts.assert_owner("former.groups")
         now = time.perf_counter()
         flushed = False
         for key in list(self._groups):
@@ -305,6 +313,9 @@ class _BatchFormer:
         """Idle-scheduler flush: nothing else can make progress, so
         every pending offer dispatches now (partial batches included —
         waiting out the linger would only add latency)."""
+        ts = getattr(self.fleet, "_tsan", None)
+        if ts is not None:
+            ts.assert_owner("former.groups")
         flushed = False
         for key in list(self._groups):
             if any(not s.cancelled for s in self._groups[key][1]):
@@ -341,6 +352,9 @@ class _BatchFormer:
     # -------------------------------------------------------- dispatch
 
     def _flush(self, key: int) -> None:
+        ts = getattr(self.fleet, "_tsan", None)
+        if ts is not None:
+            ts.assert_owner("former.groups")
         proc, slots = self._groups.pop(key)
         live = [s for s in slots if not s.cancelled]
         # priority fill: higher-priority streams ride the first
@@ -615,8 +629,12 @@ class _StreamLane:
         self._t_close = None
         # dispatched-through-sink count (the lane's live window);
         # written by the scheduler thread and the lane's sink thread
-        import threading
-        self._live_lock = threading.Lock()
+        if fleet._tsan is not None:
+            self._live_lock = fleet._tsan.lock(
+                f"lane.{spec.name}._live_lock")
+        else:
+            import threading
+            self._live_lock = threading.Lock()
         self._live = 0
         # per-lane sink pipe + bounded-restart supervision (each
         # stream its own restart budget)
@@ -650,6 +668,10 @@ class _StreamLane:
     # ------------------------------------------------------- sink side
 
     def _sink_f(self, _stop, item):
+        ts = getattr(self.fleet, "_tsan", None)
+        if ts is not None:
+            # per-lane sink state is sink-thread-owned
+            ts.assert_owner(f"lane.{self.name}.sink")
         self._current[0] = item
         self._progress[0] = self.drained[0]
         try:
@@ -681,6 +703,10 @@ class _StreamLane:
                 log.warning(
                     f"[fleet:{self.name}] sink crashed after its "
                     "segment was accounted; skipping replay")
+        if self.fleet._tsan is not None:
+            # the restarted pipe is a NEW thread: drop the crashed
+            # thread's ownership claim so the successor can re-claim
+            self.fleet._tsan.release_owners(f"lane.{self.name}.sink")
         self._sink_pipe = fw.start_pipe(
             self._sink_f, self._q_sink, None, self._stop,
             f"sink_drain:{self.name}", on_done=self.fleet._notify)
@@ -936,6 +962,10 @@ class _StreamLane:
         never observe it."""
         if self.done:
             return False
+        ts = getattr(self.fleet, "_tsan", None)
+        if ts is not None:
+            # lane step state is scheduler-owned: claim-on-first-use
+            ts.assert_owner(f"lane.{self.name}.step")
         try:
             return self._step_inner(allow_block)
         except (KeyboardInterrupt, SystemExit):
@@ -1251,10 +1281,21 @@ class StreamFleet:
                 self, batch_max,
                 max(0.0, float(getattr(cfg0, "fleet_batch_linger_ms",
                                        2.0) or 0.0)) / 1e3)
+        # opt-in runtime concurrency checker (analysis/tsan.py,
+        # Config.tsan): None when off — every hook site is an
+        # `if ts is not None`, and the locks below stay plain
+        # threading objects, so the disabled path has zero wrapper
+        # indirection
+        self._tsan = None
+        if getattr(cfg0, "tsan", False):
+            from srtb_tpu.analysis.tsan import Tsan
+            self._tsan = Tsan()
         # event-driven scheduler wakeup: sink threads notify when a
         # drain frees window/queue space, so an idle scheduler round
         # waits on the condition instead of polling on a fixed sleep
-        self._wake = threading.Condition()
+        self._wake = (self._tsan.condition("fleet._wake")
+                      if self._tsan is not None
+                      else threading.Condition())
         self._wake_seq = 0
         # the SHARED device-halt reinit budget (one device, one
         # budget): per-lane healers keep demotion only
@@ -1488,11 +1529,23 @@ class StreamFleet:
                         # sequence check skips the wait when a drain
                         # landed since this round observed the lanes
                         metrics.add("fleet_idle_waits")
+                        deadline = time.monotonic() + 0.05
                         with self._wake:
-                            if self._wake_seq == wake_seq:
-                                self._wake.wait(0.05)
+                            # predicate loop: a spurious wakeup
+                            # re-checks the sequence instead of
+                            # re-scanning idle lanes; the deadline
+                            # bounds a lost wakeup
+                            while self._wake_seq == wake_seq:
+                                left = deadline - time.monotonic()
+                                if left <= 0:
+                                    break
+                                self._wake.wait(left)
         finally:
             metrics.set("fleet_running", 0)
+            if self._tsan is not None:
+                # a later run() may be driven from a different thread;
+                # claims are per-run, the order graph persists
+                self._tsan.release_owners()
         return self.results
 
     def close(self) -> None:

@@ -22,6 +22,7 @@ import threading
 import time
 from typing import Callable
 
+from srtb_tpu.utils import termination
 from srtb_tpu.utils.logging import log
 
 WORK_QUEUE_CAPACITY = 2  # ref: config.hpp:40
@@ -112,6 +113,9 @@ class Pipe:
         self.on_done = on_done
         self.thread = threading.Thread(target=self._run, name=self.name,
                                        daemon=True)
+        # attribution for leak/wedge reports: which caller spawned
+        # this pipe (utils/termination.tag_thread walks past this file)
+        termination.tag_thread(self.thread)
         self.exception: BaseException | None = None
 
     def _run(self):

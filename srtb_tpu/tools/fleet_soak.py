@@ -200,12 +200,15 @@ def _solo_run(cfg) -> tuple:
 
 def run_soak(streams: int = 3, segments: int = 5, log2n: int = 13,
              plan: str | None = None, seed: int = 0,
-             tmpdir: str | None = None, batch: int = 0) -> dict:
+             tmpdir: str | None = None, batch: int = 0,
+             extra_cfg: dict | None = None) -> dict:
     """One full soak (solo goldens + fleet run + the gate).  Returns
     the report dict; raises :class:`SoakFailure` on any broken
     invariant.  ``batch >= 2`` arms cross-tenant continuous batching
     (``fleet_batch_max=batch``) and swaps healthy bit-identity for
-    the vmap-tolerance contract plus the batching-economy checks."""
+    the vmap-tolerance contract plus the batching-economy checks.
+    ``extra_cfg`` overrides land on the FLEET lanes only (the solo
+    goldens stay canonical) — race_soak uses it to arm ``tsan=1``."""
     from srtb_tpu.io.writers import WriteSignalSink
     from srtb_tpu.pipeline.fleet import StreamFleet, StreamSpec
     from srtb_tpu.resilience.faults import parse_plan
@@ -257,7 +260,7 @@ def run_soak(streams: int = 3, segments: int = 5, log2n: int = 13,
         jpaths[name] = os.path.join(tmp, f"journal_{name}.jsonl")
         cfg = _cfg(tmp, name, run_dir, n, fault_plan=plan,
                    telemetry_journal_path=jpaths[name],
-                   fleet_batch_max=batch)
+                   fleet_batch_max=batch, **(extra_cfg or {}))
         taps[name] = _DecisionTap()
         specs.append(StreamSpec(
             name=name, cfg=cfg,

@@ -42,6 +42,41 @@ LEAK_ALLOW_PREFIXES = ("ThreadPoolExecutor", "srtb-writer", "pydevd",
                        "asyncio_")
 
 
+def tag_thread(thread: threading.Thread) -> None:
+    """Stamp ``thread`` with the file:line that constructed it, so
+    leak/wedge reports name the spawn site instead of just the thread
+    name.  The site recorded is the first frame OUTSIDE the calling
+    module (the wrapper — Pipe.__init__, a receiver constructor —
+    is not the interesting site; whoever asked for the thread is);
+    when the whole stack is in one file, the immediate caller is
+    kept.  Cheap: frame walking only, no stack formatting."""
+    f = sys._getframe(1)
+    wrapper_file = f.f_code.co_filename
+    g = f
+    while g is not None and g.f_code.co_filename == wrapper_file:
+        g = g.f_back
+    f = g or f
+    thread._srtb_created_at = (f"{f.f_code.co_filename}:"
+                               f"{f.f_lineno}")
+
+
+def created_at(thread: threading.Thread) -> str | None:
+    """The creation site stamped by :func:`tag_thread`, or None for
+    threads spawned outside the instrumented paths."""
+    return getattr(thread, "_srtb_created_at", None)
+
+
+def describe_threads(threads) -> str:
+    """One-line-per-thread description with the creation site when
+    known — the leaked-thread report's attribution."""
+    parts = []
+    for t in threads:
+        site = created_at(t)
+        parts.append(f"'{t.name}'"
+                     + (f" (created at {site})" if site else ""))
+    return ", ".join(parts)
+
+
 def thread_snapshot() -> set[int]:
     """Idents of currently-live threads (leak-check baseline)."""
     return {t.ident for t in threading.enumerate()}
@@ -72,8 +107,11 @@ def format_thread_stacks(threads) -> str:
     frames = sys._current_frames()
     parts = []
     for t in threads:
+        site = created_at(t)
         header = (f"--- thread {t.name!r} (ident {t.ident}, "
-                  f"daemon={t.daemon}) ---")
+                  f"daemon={t.daemon}"
+                  + (f", created at {site}" if site else "")
+                  + ") ---")
         frame = frames.get(t.ident)
         if frame is None:
             parts.append(header + "\n  <no frame: already exiting>")
