@@ -402,6 +402,28 @@ class Config:
     # before it is flushed anyway (milliseconds) — a lone tenant
     # never waits longer than this for neighbors that may not come
     fleet_batch_linger_ms: float = 2.0
+    # elastic device pool (pipeline/pool.py): number of pool members
+    # the fleet places lanes across.  0/1 = the single-device fleet
+    # (bit-identical to the pre-pool engine).  >= 2 on an accelerator
+    # host maps onto real jax.devices() (capped at the hardware
+    # count); on CPU it builds a deterministic VIRTUAL pool — N
+    # logical devices with distinct plan caches / batch families /
+    # HALT domains on one physical device (what CI's migration gates
+    # run on).  Read from the FLEET config, not per stream.
+    fleet_devices: int = 0
+    # SLO-driven rebalance: when the burn-rate tracker (utils/slo.py)
+    # marks a stream degraded/burning and a strictly less-loaded
+    # healthy pool member exists, live-migrate that stream onto it
+    # before the error budget is spent.  Needs fleet_devices >= 2 and
+    # an armed SLO objective.  Read from the FLEET config.
+    migrate_on_burn: bool = False
+    # live-migration drain budget (seconds): how long a TRUSTED
+    # migration (rebalance / rolling restart — the source device is
+    # healthy) may spend draining the lane's in-flight window before
+    # the remainder moves via cold re-dispatch instead.  Halted-device
+    # migrations never drain (the in-flight results died with the
+    # device); cold re-dispatch is lossless either way.
+    drain_deadline_s: float = 5.0
     # segment-span telemetry journal: one JSONL record per processed
     # segment (per-stage wall clock, queue depth, loss counters,
     # detection count, dump decision — utils/telemetry.py); "" disables.
@@ -589,7 +611,7 @@ class Config:
         "segment_watchdog_requeues", "supervisor_max_restarts",
         "degrade_hold_segments", "promote_after_segments",
         "device_reinit_max", "stream_priority", "fleet_max_streams",
-        "fleet_queue_limit", "periodicity_harmonics",
+        "fleet_queue_limit", "fleet_devices", "periodicity_harmonics",
         "periodicity_candidates", "periodicity_fold_bins",
         "periodicity_min_bin", "events_ring_size",
         "incident_max_bundles", "profile_capture_segments",
@@ -610,7 +632,8 @@ class Config:
         "incident_min_interval_s", "slo_latency_ms",
         "slo_latency_budget", "slo_loss_budget", "slo_staleness_s",
         "slo_staleness_budget", "slo_fast_window_s",
-        "slo_slow_window_s", "slo_burn_threshold", "hbm_peak_gbps",
+        "slo_slow_window_s", "slo_burn_threshold", "drain_deadline_s",
+        "hbm_peak_gbps",
         "slo_sensitivity_budget", "quality_dead_threshold",
         "quality_hot_threshold", "quality_drift_threshold",
         "quality_drift_alpha", "canary_amp", "canary_dm",
@@ -623,6 +646,7 @@ class Config:
         "degrade_enable", "chirp_exact", "manifest_fsync",
         "manifest_hash", "deterministic_timestamps", "events_enable",
         "telemetry_journal_compress", "quality_stats",
+        "migrate_on_burn",
     })
     _LIST_FIELDS = frozenset({
         "udp_receiver_address", "udp_receiver_port",

@@ -1,4 +1,4 @@
-"""Multi-tenant stream fleet: N concurrent streams on one device.
+"""Multi-tenant stream fleet: N concurrent streams on a device pool.
 
 The reference backend serves one stream per process; the production
 target (ROADMAP item 1) is one engine serving many concurrent beams
@@ -60,10 +60,32 @@ This module makes that multi-tenancy SAFE before it is fast:
   accounted per stream) instead of letting the overload land on an
   arbitrary tenant.
 
+- **Elastic device pool + live migration** (ROADMAP item 4,
+  ``Config.fleet_devices``): lanes are placed across a
+  :class:`~srtb_tpu.pipeline.pool.DevicePool` (real ``jax.devices()``
+  members, or a deterministic virtual pool on CPU CI) by the
+  ``pipeline/placement.py`` policy — least-loaded first, soft
+  same-tenant anti-affinity, explicit ``StreamSpec.pin_device``
+  honored.  Each member owns its OWN plan cache, batch-former
+  families and HALT domain.  A lane **live-migrates** between members
+  (``_StreamLane.migrate_to``): quiesce → drain the in-flight window
+  (trusted sources only) → checkpoint + manifest consistency point →
+  re-admit on the target's plan cache → cold ring re-arm → resume,
+  bit-identical to an unmigrated run (the cold re-dispatch recovers
+  from retained host buffers — the solo engine's reinit proof).
+  Three drivers: (a) a HALTED member drains its lanes onto survivors
+  and only ITS plan cache is retired (fleet-wide reinit is the last
+  resort when no peer exists), (b) ``migrate_on_burn`` rebalances a
+  burning-SLO stream onto the least-loaded member before its error
+  budget is spent, (c) ``rolling_restart()`` drains members one at a
+  time for operator maintenance.  ``fleet_devices <= 1`` keeps the
+  single-device fleet bit-identical to the pre-pool engine.
+
 Every per-stream quantity is labeled: loss counters, degrade /
 ladder levels, in-flight depth (``{stream="..."}`` series on
-/metrics), the v6 journal's ``stream`` field, and /healthz per-stream
-staleness.  The fleet chaos gate is ``tools/fleet_soak.py``.
+/metrics), the v6 journal's ``stream`` field (``device`` since v11),
+and /healthz per-stream staleness.  The fleet chaos gate is
+``tools/fleet_soak.py`` (``--migrate`` for the pool gates).
 
 Limits (documented, enforced loudly): REAL-TIME lanes are
 single-segment dispatch units (``micro_batch_segments`` must be 1
@@ -86,6 +108,9 @@ from typing import Any
 
 from srtb_tpu.config import Config
 from srtb_tpu.pipeline import framework as fw
+from srtb_tpu.pipeline import placement
+from srtb_tpu.pipeline.pool import (STATE_DRAINING, STATE_HALTED,
+                                    STATE_OK, DevicePool, PoolDevice)
 from srtb_tpu.pipeline.runtime import Pipeline, PipelineStats
 from srtb_tpu.pipeline.segment import SegmentProcessor
 from srtb_tpu.resilience.admission import (ADMIT, QUEUE,
@@ -112,6 +137,10 @@ class StreamSpec:
     sinks: Any = None
     keep_waterfall: bool = True
     max_segments: int | None = None
+    # explicit pool placement (None = the placement policy decides):
+    # validated against the healthy pool before any pipeline state is
+    # built, so a bad pin fails like any other pure-config error
+    pin_device: int | None = None
 
     @property
     def priority(self) -> int:
@@ -135,12 +164,19 @@ class SharedPlanCache:
     stream whose trace-relevant config projects identically
     (``SegmentProcessor.plan_cache_key``).  ``compiles`` counts
     processor builds (one per family — the proof the fleet soak
-    gates on), ``hits`` counts streams served an existing plan."""
+    gates on), ``hits`` counts streams served an existing plan.
 
-    def __init__(self):
+    Plan families are shared WITHIN a pool device, never across
+    devices: each :class:`~srtb_tpu.pipeline.pool.PoolDevice` owns
+    one cache (``device`` labels its metric twins), so compiled
+    handles die with their member and a scoped halt retires exactly
+    one cache."""
+
+    def __init__(self, device: str | None = None):
         self._by_key: dict[str, SegmentProcessor] = {}
         self.compiles = 0
         self.hits = 0
+        self.device = device
 
     def get(self, cfg: Config,
             donate_input: bool = False) -> SegmentProcessor:
@@ -164,13 +200,21 @@ class SharedPlanCache:
             metrics.add("fleet_plan_compiles")
             if lbl is not None:
                 metrics.add("fleet_plan_compiles", labels=lbl)
+            if self.device is not None:
+                metrics.add("fleet_plan_compiles",
+                            labels={"device": self.device})
             log.info(f"[fleet] plan cache MISS: built shared plan "
-                     f"{proc.plan_name} ({self.compiles} families)")
+                     f"{proc.plan_name} ({self.compiles} families"
+                     + (f" on {self.device}" if self.device else "")
+                     + ")")
         else:
             self.hits += 1
             metrics.add("fleet_plan_cache_hits")
             if lbl is not None:
                 metrics.add("fleet_plan_cache_hits", labels=lbl)
+            if self.device is not None:
+                metrics.add("fleet_plan_cache_hits",
+                            labels={"device": self.device})
         return proc
 
     def invalidate(self) -> None:
@@ -259,11 +303,18 @@ class _BatchFormer:
         group here — the bulkhead's membership rule.  Staged plans
         reject ``process_batch`` (their dispatch is already
         amortized), and lanes micro-batching internally (archive
-        replay units > 1) already fill the device."""
+        replay units > 1) already fill the device.
+
+        Re-validated after any migration/heal by construction: a
+        migrated lane's swap installed a processor from the TARGET
+        device's cache (a different object, so a different group
+        key), and a draining/halted member's lanes stop offering —
+        a lane can never batch into its former device's family."""
         proc = lane.pipe.processor
         return (getattr(proc, "_fleet_shared", False)
                 and not getattr(proc, "staged", False)
-                and lane._unit() == 1)
+                and lane._unit() == 1
+                and lane.device.state == STATE_OK)
 
     def offer(self, lane: "_StreamLane", one: tuple,
               index: int) -> _BatchSlot:
@@ -454,10 +505,28 @@ class _BatchFormer:
             live.append(slot)
         # a mid-formation heal may have re-dispatched members (solo
         # fallback) or cancelled them (fleet reinit); only untouched
-        # members still on the shared program proceed
+        # members still on the shared program proceed.  A member
+        # whose lane migrated (processor now from another device's
+        # cache) or whose device left the OK state between offer and
+        # flush must NEVER ride this family's dispatch: route it to
+        # its own solo path instead of dropping it silently (the
+        # post-migration membership guard; migration normally
+        # cancels parked offers, so this counter staying 0 is the
+        # regression signal)
+        stale = [s for s in live
+                 if not s.cancelled and s.item is None
+                 and s.error is None
+                 and (s.lane.pipe.processor is not proc
+                      or s.lane.device.state != STATE_OK)]
         live = [s for s in live
                 if not s.cancelled and s.item is None
-                and s.error is None and s.lane.pipe.processor is proc]
+                and s.error is None and s.lane.pipe.processor is proc
+                and s.lane.device.state == STATE_OK]
+        for s in stale:
+            metrics.add("fleet_batch_device_guard")
+            log.warning(f"[fleet:{s.lane.name}] batch offer left "
+                        "behind by a migration/heal: dispatching solo")
+            self._single_fallback(s, requeue=True)
         if not live:
             return
         if len({id(s.lane) for s in live}) < 2:
@@ -467,6 +536,12 @@ class _BatchFormer:
                 self._single_fallback(s)
             return
         datas = [s.lane.pipe._device_bytes(s.seg) for s in live]
+        # one formed batch = one device dispatch on the family's pool
+        # member (every live member shares it: same cache, same
+        # device).  check=False — a scheduled virtual halt firing
+        # inside a formed batch would be absorbed by the solo
+        # fallback below; halts fire at solo dispatch boundaries.
+        live[0].lane.device.note_dispatch(check=False)
         try:
             if any(s.lane.pipe._ring_live for s in live):
                 # a ring carry belongs to ONE lane's consecutive-seq
@@ -583,12 +658,25 @@ class _StreamLane:
         self.spec = spec
         self.name = spec.name
         self.priority = spec.priority
+        # placement: pick this lane's pool member BEFORE the Pipeline
+        # is built (an invalid pin_device fails the pure-config way,
+        # leaking nothing), and draw the shared plan from THAT
+        # member's cache — the per-device plan family
+        self.device: PoolDevice = fleet._place(spec)
+        self.migrations = 0
+        self._migrated_t = 0.0
+        # False between a migration and the lane's first dispatch on
+        # its NEW member: the rolling-restart pacer waits for every
+        # migrant to actually resume before draining the next device
+        self._resumed = True
         from srtb_tpu.utils.platform import on_accelerator
         self.pipe = Pipeline(
             cfg, source=spec.source, sinks=spec.sinks,
             keep_waterfall=spec.keep_waterfall,
-            processor=fleet.plans.get(
+            processor=self.device.plans.get(
                 cfg, donate_input=on_accelerator()))
+        # journal attribution (span schema v11 ``device`` field)
+        self.pipe.device_label = self.device.label
         self.real_time = real_time
         self.max_segments = spec.max_segments
         self.deadline_s = float(cfg.segment_deadline_s or 0.0)
@@ -718,9 +806,11 @@ class _StreamLane:
         """Device-fault recovery with the fleet's blast-radius rules:
         OOM/compile faults demote THIS lane's plan only (the shared
         processor is swapped out for an unshared demoted one — and
-        never retired under the neighbors); a device HALT is the one
-        shared failure domain and goes to the fleet's single budgeted
-        reinit."""
+        never retired under the neighbors); a device HALT is shared
+        by the lanes of ONE pool member: with a healthy peer its
+        lanes drain-migrate onto survivors (scoped HALT domain), and
+        only with no peer does the fleet fall back to its single
+        budgeted fleet-wide reinit."""
         h = self.pipe.healer
         if h is None:
             return False
@@ -728,7 +818,7 @@ class _StreamLane:
         if kind is None:
             return False
         if kind == DEVICE_HALT:
-            if self.fleet._reinit_all(exc, faulting=self.name):
+            if self.fleet._device_halt(exc, lane=self):
                 return True
             raise ReinitBudgetExceeded(
                 "device halt beyond fleet reinit recovery "
@@ -745,6 +835,12 @@ class _StreamLane:
                   requeue=False):
         while True:
             try:
+                # the pool's dispatch clock: counts this member's
+                # device work and fires any SCHEDULED virtual halt
+                # here, where the healer classifies it (a migrated
+                # lane re-dispatches through its NEW device's clock)
+                self.device.note_dispatch()
+                self._resumed = True
                 return self.pipe._dispatch_segment(
                     seg, ingest_s, offset_after, index,
                     requeue=requeue)
@@ -774,6 +870,8 @@ class _StreamLane:
         first = self.dispatched
         if b > 1 and len(segs) == b:
             try:
+                self.device.note_dispatch()
+                self._resumed = True
                 return self.pipe._dispatch_micro_batch(
                     segs, ingests, offsets, first)
             except (KeyboardInterrupt, SystemExit):
@@ -787,26 +885,23 @@ class _StreamLane:
         return [self._dispatch(s, dt, off, first + i)
                 for i, (s, dt, off) in enumerate(got)]
 
-    def reinit_cold(self) -> None:
-        """Fleet-wide device reinit, this lane's share: swap in a
-        fresh processor at the lane's current ladder rung and
-        re-dispatch every in-flight segment cold from its retained
-        host buffer, in dispatch order."""
-        h = self.pipe.healer
-        if h is not None:
-            newp = h.rebuild()
-        else:
-            from srtb_tpu.utils.platform import on_accelerator
-            newp = self.fleet.plans.get(
-                self.pipe.cfg, donate_input=on_accelerator())
-        self.pipe._swap_processor(newp)
+    def _shared_factory(self) -> SegmentProcessor:
+        """Build/fetch this lane's processor from its CURRENT pool
+        member's plan cache — the shared path for rung-0 rebuilds."""
+        from srtb_tpu.utils.platform import on_accelerator
+        return self.device.plans.get(
+            self.pipe.cfg, donate_input=on_accelerator())
+
+    def _redispatch_pending_cold(self) -> None:
+        """Re-dispatch every in-flight segment cold from its retained
+        host buffer, in dispatch order (journal order and checkpoint
+        offsets unchanged — the solo engine's reinit proof).  Offers
+        still parked in the batch former are withdrawn first: the
+        retained host buffer is the recovery source either way."""
         for i in range(len(self.pending)):
             item = self.pending[i]
             if isinstance(item, _BatchSlot):
                 if item.item is None:
-                    # still parked in the batch former: withdraw the
-                    # offer and dispatch cold directly — the retained
-                    # host buffer is the recovery source either way
                     item.cancelled = True
                     self.pending[i] = self.pipe._dispatch_segment(
                         item.seg, item.ingest_s, item.offset_after,
@@ -816,6 +911,95 @@ class _StreamLane:
             seg, _wf, _det, offset_after, span, _t0, idx = item
             self.pending[i] = self.pipe._dispatch_segment(
                 seg, span["ingest"], offset_after, idx, requeue=True)
+
+    def reinit_cold(self) -> None:
+        """Fleet-wide device reinit, this lane's share: swap in a
+        fresh processor at the lane's current ladder rung and
+        re-dispatch every in-flight segment cold from its retained
+        host buffer, in dispatch order."""
+        h = self.pipe.healer
+        if h is not None:
+            newp = h.rebuild()
+        else:
+            newp = self._shared_factory()
+        self.pipe._swap_processor(newp)
+        self._redispatch_pending_cold()
+
+    def migrate_to(self, device: PoolDevice, trusted: bool,
+                   deadline_s: float = 0.0) -> None:
+        """LIVE migration onto another pool member: quiesce → drain
+        the in-flight window (trusted sources only — a HALTED
+        device's in-flight results died with it) → checkpoint +
+        manifest consistency point → re-admit on the target's plan
+        cache → cold ring re-arm → resume.  Bit-identical to an
+        unmigrated run: drained segments were already exactly-once
+        accounted, and everything undrained re-dispatches cold from
+        its retained host buffer on the target (the same proof as
+        the solo engine's reinit).  Runs on the scheduler thread
+        while the lane is quiescent (or re-entrantly from the
+        faulting lane's own ``_heal``, whose current segment is not
+        yet in ``pending``)."""
+        src = self.device
+        if trusted:
+            # drain whatever the (healthy) source device already
+            # computed: fewer cold re-dispatches on the target.
+            # Bounded by the drain deadline and by sink backpressure
+            # — breaking early is always safe, the cold path below
+            # is lossless.
+            deadline = time.monotonic() + max(0.0, deadline_s)
+            try:
+                while self.pending:
+                    if self._staged_emit is not None \
+                            and not self._try_emit():
+                        break
+                    if time.monotonic() > deadline:
+                        log.warning(
+                            f"[fleet:{self.name}] migration drain "
+                            f"deadline ({deadline_s:g}s) hit; moving "
+                            "the remaining window cold")
+                        break
+                    if not self._drain_head(block=True):
+                        break
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — cold path covers
+                log.warning(
+                    f"[fleet:{self.name}] migration drain failed "
+                    f"({e!r}); the remaining window moves cold")
+        # consistency point: the checkpoint is already durable per
+        # drained segment (atomic replace + fsync); sync the manifest
+        # WAL so the target-side resume sees every record the drain
+        # produced
+        man = getattr(self.pipe, "manifest", None)
+        if man is not None:
+            try:
+                man.sync()
+            except Exception as e:  # noqa: BLE001 — advisory
+                log.warning(f"[fleet:{self.name}] manifest sync at "
+                            f"migration consistency point: {e!r}")
+        self.device = device
+        h = self.pipe.healer
+        newp = (h.rebuild(shared=self._shared_factory)
+                if h is not None else self._shared_factory())
+        self.pipe._swap_processor(newp)
+        self.pipe.device_label = device.label
+        self._redispatch_pending_cold()
+        # a re-dispatched window already resumed on the target; an
+        # empty one resumes at the lane's next fresh dispatch
+        self._resumed = bool(self.pending)
+        self.migrations += 1
+        self._migrated_t = time.monotonic()
+        self.fleet.admission.note_migration(
+            self.name, src.label, device.label)
+        metrics.add("migrations")
+        metrics.add("migrations", labels={"stream": self.name})
+        events.emit("fleet.migrate", trace=0, stream=self.name,
+                    info=f"{src.label}->{device.label}")
+        log.warning(f"[fleet:{self.name}] migrated {src.label} -> "
+                    f"{device.label}"
+                    f" ({len(self.pending)} segment(s) re-dispatched "
+                    "cold)")
+        self.fleet._publish_lanes()
 
     # ----------------------------------------------------- engine step
 
@@ -1268,7 +1452,21 @@ class StreamFleet:
             s.cfg.stream_name = s.name
         self.specs = {s.name: s for s in specs}
         cfg0 = fleet_cfg if fleet_cfg is not None else specs[0].cfg
-        self.plans = SharedPlanCache()
+        # the elastic device pool (fleet_devices; a single-member pool
+        # when unset — every code path below routes through it).
+        # ``plans`` stays the member-0 cache for compatibility: the
+        # single-device fleet's soak/tests read fleet.plans directly.
+        self.pool = DevicePool.from_config(cfg0)
+        self.plans = self.pool.devices[0].plans
+        self._migrate_on_burn = bool(getattr(cfg0, "migrate_on_burn",
+                                             False))
+        self._drain_deadline = float(
+            getattr(cfg0, "drain_deadline_s", 5.0) or 0.0)
+        # rolling-restart queue: device indices awaiting a drain
+        # (appended by the operator-facing rolling_restart(), drained
+        # one per scheduler round; deque append/popleft are atomic)
+        self._rolling: collections.deque = collections.deque()
+        self._rebalance_t = 0.0
         self.admission = AdmissionController.from_config(cfg0)
         self.fairness = FleetShedPolicy.from_config(cfg0)
         # cross-tenant continuous batching (fleet-config knob, like
@@ -1311,6 +1509,48 @@ class StreamFleet:
         self.results: dict[str, StreamResult] = {}
         self._waitlist: dict[str, StreamSpec] = {}
 
+    # ------------------------------------------------------- placement
+
+    def _loads(self) -> dict[int, int]:
+        """Live lane count per pool member index."""
+        loads = {d.index: 0 for d in self.pool.devices}
+        for ln in self.lanes.values():
+            if not ln.done:
+                loads[ln.device.index] = \
+                    loads.get(ln.device.index, 0) + 1
+        return loads
+
+    def _tenants_by_device(self) -> dict[int, set]:
+        """Tenant keys (stream-name prefix) per pool member index —
+        the anti-affinity input."""
+        out: dict[int, set] = {}
+        for ln in self.lanes.values():
+            if not ln.done:
+                out.setdefault(ln.device.index, set()).add(
+                    placement.tenant_of(ln.name))
+        return out
+
+    def _place(self, spec: StreamSpec) -> PoolDevice:
+        """Initial placement for a starting lane (pure policy in
+        pipeline/placement.py: pin honored, else least-loaded with
+        soft same-tenant anti-affinity)."""
+        dev = placement.choose_initial(
+            spec, self.pool.healthy(), self._loads(),
+            self._tenants_by_device())
+        if dev is None:
+            raise RuntimeError(
+                f"stream {spec.name!r}: no healthy pool device to "
+                "place on")
+        return dev
+
+    def _publish_lanes(self) -> None:
+        """Per-device lane-count gauges (the /healthz + Prometheus
+        twins of the placement state)."""
+        loads = self._loads()
+        for d in self.pool.devices:
+            metrics.set("fleet_device_lanes", loads.get(d.index, 0),
+                        labels={"device": d.label})
+
     # ---------------------------------------------------- lane control
 
     def _notify(self) -> None:
@@ -1327,6 +1567,7 @@ class StreamFleet:
         spec = self.specs[name]
         try:
             self.lanes[name] = _StreamLane(self, spec)
+            self._publish_lanes()
             return True
         except (KeyboardInterrupt, SystemExit):
             self.admission.release(name)
@@ -1359,13 +1600,50 @@ class StreamFleet:
             # capacity is genuinely full or the queue is drained
             self._start(nxt)
 
+    def _device_halt(self, exc: BaseException,
+                     lane: "_StreamLane") -> bool:
+        """Scoped HALT domain (driver (a) of the migration
+        machinery): when the faulted lane's pool member has a healthy
+        peer, mark it halted, force-retire ONLY its plan cache, and
+        drain-migrate its lanes onto survivors — the neighbors'
+        compiled programs keep dispatching untouched, no reinit
+        budget is spent (a member halts at most once; it never
+        returns except through a fleet-wide reinit).  With no peer,
+        fall back to the budgeted fleet-wide reinit (today's
+        behavior, now the last resort)."""
+        dev = lane.device
+        survivors = [d for d in self.pool.healthy() if d is not dev]
+        if not survivors:
+            return self._reinit_all(exc, faulting=lane.name)
+        dev.set_state(STATE_HALTED)
+        # only the faulted member's cache: a fleet-wide invalidate
+        # would recompile every healthy tenant for a fault their
+        # device never saw
+        dev.plans.invalidate()
+        metrics.add("device_drains")
+        metrics.add("device_drains", labels={"device": dev.label})
+        events.emit("fleet.device_halt", trace=0, stream=lane.name,
+                    info=dev.label)
+        log.warning(f"[fleet] device halt on {dev.label} (stream "
+                    f"{lane.name!r}): draining its lanes onto "
+                    f"{len(survivors)} survivor(s) ({exc!r})")
+        for ln in [l for l in self.lanes.values()
+                   if not l.done and l.device is dev]:
+            target = placement.choose_target(
+                ln.name, dev.index, self.pool.healthy(),
+                self._loads(), self._tenants_by_device())
+            # survivors is non-empty, so a target always exists
+            ln.migrate_to(target, trusted=False)
+        return True
+
     def _reinit_all(self, exc: BaseException, faulting: str) -> bool:
-        """The one shared failure domain: a device halt.  One budgeted
-        decision (the fleet supervisor), then: drop the jax caches,
-        retire + forget every shared plan, rebuild each lane's
-        processor at its own ladder rung and re-dispatch each lane's
-        in-flight window cold — journal order and checkpoint offsets
-        unchanged per stream."""
+        """The no-peer failure domain: a device halt with nothing to
+        migrate onto.  One budgeted decision (the fleet supervisor),
+        then: drop the jax caches, retire + forget every pool
+        member's shared plans, rebuild each lane's processor at its
+        own ladder rung and re-dispatch each lane's in-flight window
+        cold — journal order and checkpoint offsets unchanged per
+        stream."""
         if self._reinit_sup is None or \
                 not self._reinit_sup.should_restart(exc):
             return False
@@ -1382,7 +1660,7 @@ class StreamFleet:
         except Exception as e:  # pragma: no cover - version drift
             log.warning(f"[fleet] jax.clear_caches failed ({e!r}); "
                         "proceeding with the rebuild")
-        self.plans.invalidate()
+        self.pool.invalidate_all()
         for lane in self.lanes.values():
             if not lane.done:
                 lane.reinit_cold()
@@ -1404,7 +1682,10 @@ class StreamFleet:
             drained=lane.drained[0] - lane._drained0,
             dropped=dropped,
             extras={"plan": getattr(lane.pipe.processor, "plan_name",
-                                    None)})
+                                    None),
+                    "device": lane.device.label,
+                    "migrations": lane.migrations})
+        self._publish_lanes()
         # capacity freed: start queued streams in priority order
         self._start_queued()
 
@@ -1423,11 +1704,110 @@ class StreamFleet:
         shed = self.fairness.observe(
             pressure, loss,
             [(ln.name, ln.priority, ln.real_time,
-              self._former is not None and self._former.eligible(ln))
+              self._former is not None and self._former.eligible(ln),
+              ln.device.label)
              for ln in running])
         for ln in running:
             ln.forced_shed = ln.name in shed
             ln._emitted_since_obs = 0
+
+    # -------------------------------------------- migration drivers b+c
+
+    def _maybe_rebalance(self) -> None:
+        """SLO-driven escape hatch (driver (b), ``migrate_on_burn``):
+        a stream whose burn-rate tracker verdict is not ok migrates
+        onto a STRICTLY less-loaded healthy peer before its error
+        budget is spent — paced (4 Hz), with a per-lane cooldown so
+        a still-burning migrant cannot flap between members."""
+        if not self._migrate_on_burn:
+            return
+        now = time.monotonic()
+        if now - self._rebalance_t < 0.25:
+            return
+        self._rebalance_t = now
+        healthy = self.pool.healthy()
+        if len(healthy) < 2:
+            return
+        from srtb_tpu.utils import slo
+        tr = slo.tracker
+        if tr is None:
+            return
+        try:
+            per = tr.evaluate()
+        except Exception as e:  # noqa: BLE001 — advisory telemetry
+            log.debug(f"[fleet] slo evaluate failed: {e!r}")
+            return
+        for ln in list(self.lanes.values()):
+            if ln.done or ln.status != "running":
+                continue
+            verdict = per.get(ln.name)
+            if verdict is None or verdict.get("ok", True):
+                continue
+            if now - ln._migrated_t < 5.0:
+                continue
+            loads = self._loads()
+            target = placement.choose_target(
+                ln.name, ln.device.index, healthy, loads,
+                self._tenants_by_device())
+            if target is None or loads.get(target.index, 0) \
+                    >= loads.get(ln.device.index, 0):
+                continue
+            log.warning(f"[fleet] SLO burn on {ln.name!r}: "
+                        f"rebalancing {ln.device.label} -> "
+                        f"{target.label}")
+            ln.migrate_to(target, trusted=True,
+                          deadline_s=self._drain_deadline)
+
+    def rolling_restart(self) -> None:
+        """Operator-facing rolling restart (driver (c)): queue every
+        pool member for a drain.  The scheduler drains ONE member per
+        round — its lanes live-migrate onto peers, its plan cache is
+        retired (the compiled handles die with the restart the drain
+        is for), and it re-arms before the next member drains.
+        Callable from any thread; the scheduler thread does the
+        work."""
+        self._rolling.extend(d.index for d in self.pool.devices)
+        self._notify()
+
+    def _pump_rolling(self) -> bool:
+        """Drain at most one queued rolling-restart member (one at a
+        time is the contract).  A member that would leave the pool
+        without a healthy peer is skipped loudly — a one-member pool
+        cannot roll."""
+        if not self._rolling:
+            return False
+        # pace: the previous drain's migrants must RESUME (dispatch on
+        # their new member) before the next member is pulled — the
+        # operator contract is a live roll, not a simultaneous yank
+        if any(not ln.done and not ln._resumed
+               for ln in self.lanes.values()):
+            return False
+        idx = self._rolling.popleft()
+        dev = self.pool.devices[idx]
+        if dev.state != STATE_OK:
+            return False
+        if len(self.pool.healthy()) < 2:
+            log.warning(f"[fleet] rolling restart: {dev.label} has "
+                        "no healthy peer to drain onto; skipping")
+            return False
+        dev.set_state(STATE_DRAINING)
+        metrics.add("device_drains")
+        metrics.add("device_drains", labels={"device": dev.label})
+        events.emit("fleet.device_drain", trace=0, stream=None,
+                    info=dev.label)
+        log.info(f"[fleet] rolling restart: draining {dev.label}")
+        for ln in [l for l in self.lanes.values()
+                   if not l.done and l.device is dev]:
+            target = placement.choose_target(
+                ln.name, dev.index, self.pool.healthy(),
+                self._loads(), self._tenants_by_device())
+            ln.migrate_to(target, trusted=True,
+                          deadline_s=self._drain_deadline)
+        dev.plans.invalidate()
+        dev.set_state(STATE_OK)
+        log.info(f"[fleet] rolling restart: {dev.label} drained "
+                 "and re-armed")
+        return True
 
     # ------------------------------------------------------------ run
 
@@ -1500,6 +1880,9 @@ class StreamFleet:
                     # a linger deadline flushed a partial batch: the
                     # filled slots drain next round
                     progressed = True
+                if self._pump_rolling():
+                    progressed = True
+                self._maybe_rebalance()
                 self._observe_fairness()
                 for name in self.admission.rejected:
                     if name in self._waitlist:
@@ -1551,7 +1934,7 @@ class StreamFleet:
     def close(self) -> None:
         for lane in self.lanes.values():
             lane.close()
-        self.plans.invalidate()
+        self.pool.invalidate_all()
 
     def __enter__(self):
         return self

@@ -1,0 +1,188 @@
+"""Elastic device pool: the fleet's placement substrate.
+
+ROADMAP item 4 generalizes :class:`~srtb_tpu.pipeline.fleet.StreamFleet`
+from "N streams on ONE device" to "N streams on a POOL of devices" —
+the compose-modules direction of the FPGA pulsar-search stacks
+(PAPERS.md): treat accelerators as interchangeable pool members and
+MOVE work between them, instead of healing a sick device in place.
+
+A :class:`DevicePool` holds one :class:`PoolDevice` per member.  Each
+member owns:
+
+- its OWN :class:`~srtb_tpu.pipeline.fleet.SharedPlanCache` — plan
+  families are shared *within* a device, never across devices, so a
+  member's compiled handles die with the member and a halt can only
+  force-retire ITS cache, never a neighbor's (the per-device HALT
+  domain);
+- its health state (``ok`` / ``draining`` / ``halted``) published as
+  the ``fleet_device_state`` gauge (per-device ``/healthz`` +
+  Prometheus twins);
+- a dispatch counter, which doubles as the deterministic fault
+  injection point for CPU CI: :meth:`schedule_halt` arms a virtual
+  halt that raises a :class:`~srtb_tpu.resilience.errors.DeviceHalt`
+  (the exact class the fault injector's ``device_halt`` action
+  raises) on the first dispatch at or past the scheduled count — no
+  wall clock, no RNG, bit-reproducible across runs.
+
+On an accelerator host with ``fleet_devices >= 2`` the pool labels map
+onto real ``jax.devices()`` members; on CPU (CI) the pool is VIRTUAL:
+N logical devices share one physical device but keep fully distinct
+plan caches, batch-former families and halt domains — the control
+plane (placement, migration, drain, scoped invalidation) is identical,
+which is what the migration soak gates.
+
+``fleet_devices`` <= 1 builds a single-member pool: every fleet code
+path goes through the pool, and the one-device fleet is bit-identical
+to the pre-pool engine (PERF round 23 pins the A/B within noise).
+"""
+
+from __future__ import annotations
+
+from srtb_tpu.utils.logging import log
+from srtb_tpu.utils.metrics import metrics
+
+# fleet_device_state gauge codes (per-device label)
+STATE_OK = "ok"
+STATE_DRAINING = "draining"
+STATE_HALTED = "halted"
+_STATE_CODE = {STATE_OK: 0, STATE_DRAINING: 1, STATE_HALTED: 2}
+
+
+class PoolDevice:
+    """One pool member: identity + its own plan cache + health state
+    + the deterministic dispatch counter."""
+
+    def __init__(self, index: int, label: str | None = None,
+                 jax_device=None):
+        from srtb_tpu.pipeline.fleet import SharedPlanCache
+        self.index = int(index)
+        self.label = label or f"dev{index}"
+        # the per-device plan-family cache (the per-device HALT
+        # domain: invalidating THIS cache never touches a neighbor's)
+        self.plans = SharedPlanCache(device=self.label)
+        self.state = STATE_OK
+        # the real jax.Device when the pool maps onto hardware; None
+        # for a virtual (CPU CI) member
+        self.jax_device = jax_device
+        self.dispatches = 0
+        self._halt_at: int | None = None
+        self._halt_fired = False
+        self._publish()
+
+    # ----------------------------------------------------- health state
+
+    def set_state(self, state: str) -> None:
+        if state not in _STATE_CODE:
+            raise ValueError(f"unknown device state {state!r}")
+        self.state = state
+        self._publish()
+
+    def _publish(self) -> None:
+        metrics.set("fleet_device_state", _STATE_CODE[self.state],
+                    labels={"device": self.label})
+
+    # ------------------------------------------ deterministic injection
+
+    def schedule_halt(self, after_dispatches: int) -> None:
+        """Arm a VIRTUAL halt: the first :meth:`note_dispatch` at or
+        past ``after_dispatches`` total dispatches on this member
+        raises :class:`DeviceHalt` — the deterministic pool-scoped
+        twin of the fault injector's ``device_halt`` action, for CPU
+        CI where no real device can die."""
+        self._halt_at = max(0, int(after_dispatches))
+        self._halt_fired = False
+
+    def note_dispatch(self, check: bool = True) -> None:
+        """Count one device dispatch; fires the scheduled virtual
+        halt exactly once.  Called by the fleet on every lane solo
+        dispatch and once per formed batch (``check=False`` there —
+        scheduled halts fire at SOLO dispatch boundaries, where the
+        lane's healer classifies them; a halt raised mid-batch would
+        be absorbed by the former's solo fallback)."""
+        self.dispatches += 1
+        if (check and self._halt_at is not None and not self._halt_fired
+                and self.state == STATE_OK
+                and self.dispatches > self._halt_at):
+            self._halt_fired = True
+            from srtb_tpu.resilience.errors import DeviceHalt
+            raise DeviceHalt(
+                f"virtual pool device {self.label} halted "
+                f"(scheduled at dispatch {self._halt_at})")
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"PoolDevice({self.label}, state={self.state}, "
+                f"dispatches={self.dispatches})")
+
+
+class DevicePool:
+    """The fleet's device membership: real ``jax.devices()`` members
+    on accelerator hosts, a deterministic virtual pool on CPU CI."""
+
+    def __init__(self, count: int = 1, jax_devices=None):
+        count = max(1, int(count))
+        devs = list(jax_devices or [])
+        self.devices = [
+            PoolDevice(i, jax_device=devs[i] if i < len(devs) else None)
+            for i in range(count)]
+        metrics.set("fleet_pool_size", len(self.devices))
+
+    @classmethod
+    def from_config(cls, cfg) -> "DevicePool":
+        """Build the pool from ``Config.fleet_devices`` (the FLEET
+        config).  0/1 = the legacy single-device fleet (everything
+        still routes through a one-member pool).  >= 2 on an
+        accelerator host binds real ``jax.devices()`` members (capped
+        at the hardware count); on CPU the pool is virtual — N
+        logical members, one physical device, distinct plan caches."""
+        want = int(getattr(cfg, "fleet_devices", 0) or 0)
+        if want <= 1:
+            return cls(1)
+        from srtb_tpu.utils.platform import on_accelerator
+        if on_accelerator():
+            import jax
+            have = jax.devices()
+            if want > len(have):
+                log.warning(
+                    f"[pool] fleet_devices={want} exceeds the "
+                    f"{len(have)} visible devices; capping")
+                want = len(have)
+            return cls(want, jax_devices=have[:want])
+        log.info(f"[pool] virtual {want}-device pool (CPU): distinct "
+                 "plan caches / halt domains on one physical device")
+        return cls(want)
+
+    def __len__(self) -> int:
+        return len(self.devices)
+
+    def __iter__(self):
+        return iter(self.devices)
+
+    def healthy(self) -> list[PoolDevice]:
+        """Members accepting placements (not draining, not halted)."""
+        return [d for d in self.devices if d.state == STATE_OK]
+
+    @property
+    def total_dispatches(self) -> int:
+        return sum(d.dispatches for d in self.devices)
+
+    @property
+    def compiles(self) -> int:
+        """Pool-wide plan-family compiles (sum of member caches)."""
+        return sum(d.plans.compiles for d in self.devices)
+
+    @property
+    def hits(self) -> int:
+        return sum(d.plans.hits for d in self.devices)
+
+    def schedule_halt(self, index: int, after_dispatches: int) -> None:
+        self.devices[index].schedule_halt(after_dispatches)
+
+    def invalidate_all(self) -> None:
+        """Fleet-wide reinit (the no-peer last resort): every member's
+        cache force-retired and every member re-armed — the backend
+        under the whole pool was reinitialized, so halted members are
+        healthy again."""
+        for d in self.devices:
+            d.plans.invalidate()
+            if d.state != STATE_OK:
+                d.set_state(STATE_OK)

@@ -636,7 +636,11 @@ class Pipeline:
                 batch_size=getattr(seg, "batch_size", None),
                 batch_wait_ms=(
                     None if getattr(seg, "batch_wait_s", None) is None
-                    else seg.batch_wait_s * 1e3)))
+                    else seg.batch_wait_s * 1e3),
+                # v11: the pool member this lane dispatches through
+                # (stamped by the fleet at placement and re-stamped
+                # by a live migration); absent outside a fleet
+                device=getattr(self, "device_label", None)))
 
     # ---------------------------------------------- async segment engine
 
@@ -821,6 +825,15 @@ class Pipeline:
         # the head/tail reserved spans, so the warm stride slice and
         # the adopted carry stay consistent with a cold dispatch)
         data = self._device_bytes(seg)
+        # a requeue that lands on a FULLY invalidated ring (processor
+        # swap, device reinit, live migration) is the stream's new
+        # frontier: its cold full upload emits a valid carry, and
+        # adopting it re-arms the ring in the same dispatch — the
+        # follow-up segment warm-assembles instead of paying a second
+        # full upload.  A requeue with ring state still live (watchdog
+        # cancel of a mid-window segment) must NOT anchor: the ring
+        # has moved past it, and adjacency would lie.
+        ring_down = self._ring_prev is None and self._ring_carry is None
         carry = None if requeue or not self._ring_adjacent(seg) \
             else self._ring_carry
         if carry is not None:
@@ -854,9 +867,11 @@ class Pipeline:
                 return proc.run_device_cold(stage_in(data))
 
             out, next_carry = self._op("dispatch", index, run_it)
-        if not requeue:
+        if not requeue or ring_down:
             # adopt the carry for the next dispatch; a requeued
             # segment's carry is stale (the ring has moved past it)
+            # UNLESS the ring was down at entry — then this requeue
+            # IS the re-arm (see ring_down above)
             self._ring_carry = next_carry
             seq = getattr(seg, "seq", -1)
             # an unstamped segment cannot anchor adjacency: the next

@@ -155,6 +155,22 @@ class AdmissionController:
         self._mark("admit", name)
         return name
 
+    def note_migration(self, name: str, src: str, dst: str) -> None:
+        """A running stream was LIVE-migrated between pool devices:
+        its admission slot is unchanged (the stream never stopped
+        running), but the re-admission on the target must be
+        attributable — who moved, from where, to where — from
+        /metrics and the event trace alone, like every other
+        admission decision."""
+        if name not in self.running and self.max_streams > 0:
+            log.warning(f"[admission] migration noted for "
+                        f"{name!r}, which holds no admission slot")
+        metrics.add("fleet_readmitted")
+        metrics.add("fleet_readmitted", labels={"stream": name})
+        metrics.add("fleet_readmitted", labels={"device": dst})
+        events.emit("admission", trace=0, stream=name,
+                    info=f"migrate:{src}->{dst}")
+
     def release(self, name: str) -> None:
         """A running stream finished (or failed): frees its slot."""
         self.running.discard(name)

@@ -172,7 +172,9 @@ class FleetShedPolicy:
         fraction of running lanes that waited on their sink since the
         last observation; ``lanes`` is [(name, priority, real_time)]
         — or [(name, priority, real_time, batched)] when the fleet
-        runs cross-stream batching — for every RUNNING lane.  Returns
+        runs cross-stream batching, or [(..., batched, device)] when
+        it runs on a device pool (the label attributes shed/restore
+        decisions per pool member) — for every RUNNING lane.  Returns
         the set of stream names currently force-shed (their lanes
         drop whole segments as accounted per-stream loss until
         restored).
@@ -182,18 +184,20 @@ class FleetShedPolicy:
         whole family (the formed batches thin out for every
         co-tenant), while shedding a solo lane costs one tenant.
         Restore order mirrors it (batched members come back first)."""
-        lanes4 = [(e[0], e[1], e[2],
-                   bool(e[3]) if len(e) > 3 else False)
+        lanes5 = [(e[0], e[1], e[2],
+                   bool(e[3]) if len(e) > 3 else False,
+                   e[4] if len(e) > 4 else None)
                   for e in lanes]
-        live = {name for name, _, _, _ in lanes4}
+        live = {name for name, _, _, _, _ in lanes5}
         self.shed &= live  # finished lanes leave the shed set
+        device_of = {name: dev for name, _, _, _, dev in lanes5}
         sheddable = sorted(
             ((prio, batched, name)
-             for name, prio, rt, batched in lanes4
+             for name, prio, rt, batched, _dev in lanes5
              if rt and name not in self.shed))
         restorable = sorted(
             ((prio, batched, name)
-             for name, prio, _, batched in lanes4
+             for name, prio, _, batched, _dev in lanes5
              if name in self.shed), reverse=True)
         if pressure >= self.high or loss_active:
             self._above += 1
@@ -209,8 +213,10 @@ class FleetShedPolicy:
             self._above = 0
             metrics.add("fleet_sheds")
             metrics.add("fleet_sheds", labels={"stream": name})
+            dev = device_of.get(name)
             events.emit("fleet.force_shed", trace=0, stream=name,
-                        info=f"priority={prio}")
+                        info=f"priority={prio}"
+                        + (f" device={dev}" if dev else ""))
             log.warning(
                 f"[fleet] sustained fleet pressure {pressure:.2f} "
                 f"(loss={loss_active}): shedding lowest-priority "

@@ -310,13 +310,22 @@ class ComputeHealer:
             f"ladder rung {self._level} ({self.active_step}): {exc!r}")
         return self._build(self._level)
 
-    def rebuild(self):
+    def rebuild(self, shared=None):
         """Fresh processor at the CURRENT rung, with no budget check
         and no counters: the fleet's SHARED device reinit
         (pipeline/fleet.py) makes one budgeted decision for the whole
         device and then rebuilds every lane — charging each lane's own
         reinit budget for a fault it didn't cause would let one
-        flapping neighbor bankrupt the fleet."""
+        flapping neighbor bankrupt the fleet.
+
+        ``shared`` (a zero-arg factory) serves the fleet's LIVE
+        migration: a lane at rung 0 re-admits through its target
+        device's shared plan cache (rejoining that member's batch
+        family and paying a compile only if the family is new there);
+        a DEMOTED lane stays on its unshared rung — exactly the
+        batch-former's membership rule."""
+        if shared is not None and self._level == 0:
+            return shared()
         return self._build(self._level)
 
     # --------------------------------------------- promotion probe

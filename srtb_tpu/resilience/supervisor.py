@@ -65,6 +65,17 @@ class Supervisor:
     def restarts(self) -> int:
         return len(self._restarts)
 
+    def remaining(self) -> int:
+        """Restart budget left inside the current window — advisory
+        (e.g. the fleet's /healthz reports how many fleet-wide
+        reinits the no-peer fallback still has); the authoritative
+        check stays ``should_restart``."""
+        now = self._clock()
+        while self._restarts and \
+                now - self._restarts[0] > self.window_s:
+            self._restarts.popleft()
+        return max(0, self.max_restarts - len(self._restarts))
+
     def should_restart(self, exc: BaseException) -> bool:
         if not self.restart_fatal and classify(exc) == FATAL:
             log.error(f"[supervisor] {self.name}: fatal {exc!r}; "

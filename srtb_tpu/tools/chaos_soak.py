@@ -36,6 +36,18 @@ The gate then asserts the self-healing contract:
 healer does NOT handle (an injected fatal; a device fault with
 healing disabled) must fail the soak, not pass it.
 
+Pool-scoped halts: a plan entry ``device:halt@K`` is NOT a pipeline
+fault-injector spec — it schedules the elastic pool's deterministic
+virtual halt (``pipeline/pool.py``) on one pool member after K of
+ITS dispatches.  Entries are stripped from the pipeline plan and run
+as a fourth phase: one stream on a ``len(entries)+1``-member virtual
+pool, entry i armed on member i, so every halt has a survivor to
+drain onto.  The gate: the run completes with zero loss, decisions
+(and time series — migration stays at rung 0) BIT-equal the clean
+reference, ``device_drains`` matches the scheduled halts exactly,
+every halt produced a live migration, and no halt escalated to a
+fleet-wide reinit.
+
 Usage::
 
     python -m srtb_tpu.tools.chaos_soak [--seed N] [--segments N]
@@ -127,6 +139,21 @@ def generate_plan(seed: int, segments: int, faults: int,
     return ",".join(entries)
 
 
+def _split_pool_plan(plan: str) -> tuple[list[int], str]:
+    """Split ``device:halt@K`` pool-scoped entries out of a fault
+    plan.  Returns (halt dispatch counts, remaining pipeline plan)."""
+    halts, rest = [], []
+    for ent in plan.split(","):
+        ent = ent.strip()
+        if not ent:
+            continue
+        if ent.startswith("device:halt@"):
+            halts.append(int(ent.rsplit("@", 1)[1]))
+        else:
+            rest.append(ent)
+    return halts, ",".join(rest)
+
+
 class _CaptureSink:
     def __init__(self):
         self.out = []
@@ -155,6 +182,30 @@ def _run(cfg, max_segments=None):
     return stats, sink, counters, unfired
 
 
+def _run_pool_phase(tmp: str, n: int, pool_halts: list[int]) -> tuple:
+    """The ``device:halt@K`` phase: one stream on a virtual pool with
+    one member per scheduled halt plus a survivor; entry i arms member
+    i's deterministic halt after K_i of its dispatches.  Returns
+    (result, sink, counters)."""
+    from srtb_tpu.pipeline.fleet import StreamFleet, StreamSpec
+    from srtb_tpu.utils.metrics import metrics
+    metrics.reset()
+    members = len(pool_halts) + 1
+    cfg = _base_cfg(tmp, n, "pool", fleet_devices=members)
+    sink = _CaptureSink()
+    fleet = StreamFleet([StreamSpec(name="chaos", cfg=cfg,
+                                    sinks=[sink])])
+    for i, k in enumerate(pool_halts):
+        fleet.pool.schedule_halt(i, after_dispatches=k)
+    results = fleet.run()
+    fleet.close()
+    counters = {k: int(metrics.get(k)) for k in (
+        "device_drains", "migrations", "device_reinits",
+        "segments_dropped", "plan_demotions")}
+    metrics.reset()
+    return results["chaos"], sink, counters
+
+
 def run_soak(seed: int = 0, segments: int = 6, faults: int = 4,
              log2n: int = 14, plan: str | None = None,
              promote_after: int = 0, tmpdir: str | None = None) -> dict:
@@ -177,7 +228,10 @@ def run_soak(seed: int = 0, segments: int = 6, faults: int = 4,
     if plan is None:
         plan = generate_plan(seed, segments, faults,
                              max_demotions=len(rungs), max_halts=3)
-    specs = parse_plan(plan)
+    # device:halt@K entries are POOL-scoped (pipeline/pool.py), not
+    # fault-injector specs: strip them here, run them as phase 4
+    pool_halts, pipe_plan = _split_pool_plan(plan)
+    specs = parse_plan(pipe_plan) if pipe_plan else []
     n_demote = sum(1 for s in specs
                    if s.action in ("oom", "compile_fail"))
     n_halt = sum(1 for s in specs if s.action == "device_halt")
@@ -195,7 +249,7 @@ def run_soak(seed: int = 0, segments: int = 6, faults: int = 4,
     on, sink_on, c_on, _ = _run(_base_cfg(tmp, n, "on"))
     # run 3: chaos
     chaos_cfg = _base_cfg(
-        tmp, n, "chaos", fault_plan=plan,
+        tmp, n, "chaos", fault_plan=pipe_plan,
         promote_after_segments=promote_after,
         device_reinit_max=max(1, n_halt),
         checkpoint_path=os.path.join(tmp, "chaos_ck.json"),
@@ -257,8 +311,49 @@ def run_soak(seed: int = 0, segments: int = 6, faults: int = 4,
           f"retries_total {int(counters['retries_total'])} < "
           f"{n_transient} injected transient faults")
 
+    # phase 4: pool-scoped device halts — every scheduled halt drains
+    # its member onto a survivor via live migration, losslessly and
+    # bit-identically (migration stays at rung 0, so even the time
+    # series is exact, unlike the demoted-plan tolerance above)
+    pool_counters: dict = {}
+    if pool_halts:
+        pres, psink, pool_counters = _run_pool_phase(tmp, n, pool_halts)
+        check(pres.status == "done",
+              f"pool phase did not finish: {pres.status} "
+              f"({pres.error!r})")
+        check(len(psink.out) + pool_counters["segments_dropped"]
+              == off.segments,
+              f"pool phase loss not accounted: {len(psink.out)} "
+              f"drained + {pool_counters['segments_dropped']} dropped "
+              f"!= {off.segments} source segments")
+        check(pool_counters["segments_dropped"] == 0,
+              f"pool phase dropped "
+              f"{pool_counters['segments_dropped']} segment(s) — a "
+              "scoped halt migrates, it must not shed")
+        for i, (a, b) in enumerate(zip(psink.out, sink_off.out)):
+            check(np.array_equal(a[0], b[0])
+                  and np.array_equal(a[1], b[1])
+                  and np.array_equal(a[2], b[2]) and a[3] == b[3],
+                  f"pool phase segment {i}: output differs from the "
+                  "clean reference — migration must be bit-identical")
+        check(pool_counters["device_drains"] == len(pool_halts),
+              f"device_drains {pool_counters['device_drains']} != "
+              f"{len(pool_halts)} scheduled pool halts")
+        check(pool_counters["migrations"] >= len(pool_halts),
+              f"migrations {pool_counters['migrations']} < "
+              f"{len(pool_halts)} scheduled halts — a halt failed to "
+              "drain its lane onto the survivor")
+        check(pool_counters["device_reinits"] == 0,
+              "a pool-scoped halt escalated to a fleet-wide reinit "
+              "despite a healthy survivor")
+        check(pool_counters["plan_demotions"] == 0,
+              "the pool phase demoted a plan — migration must rejoin "
+              "the survivor's family at rung 0")
+
     return {
         "seed": seed, "segments": int(off.segments), "plan": plan,
+        "pool_halts": pool_halts,
+        "pool_counters": pool_counters,
         "rungs": [r.step for r in rungs],
         "drained": drained, "dropped": dropped,
         "plan_demotions": int(counters["plan_demotions"]),

@@ -40,11 +40,26 @@ journal attribution check, and a scoped single-oom run must pass.
 engine with N=1 vs the solo ``Pipeline``) and reports both medians —
 the PERF.md round-15 measurement.
 
+``--migrate`` runs the ELASTIC-POOL migration soak instead: a seeded
+2-device virtual pool (``fleet_devices=2``), a mid-run scoped device
+kill (``--kill-device IDX --kill-at K`` arms the pool's deterministic
+virtual halt) or an operator rolling restart (``--rolling``).  The
+gate: every victim lane resumes on the surviving member and its final
+output set (relative paths + SHA-256) is BIT-identical to its solo
+golden, loss is zero (the in-flight window re-dispatches cold from
+retained host buffers), the ingest ring records exactly ONE extra
+cold dispatch per migration (``ring_cold_dispatches == streams +
+migrations``), the journal is v11 with every record device-stamped
+and victim journals ending on the survivor's label, and — the scoped
+HALT-domain pin — the pool records exactly one compile per member
+with zero healthy-lane demotions, recompiles or fleet-wide reinits.
+
 Usage::
 
     python -m srtb_tpu.tools.fleet_soak [--streams N] [--segments N]
         [--log2n N] [--plan PLAN] [--batch B] [--selftest]
         [--ab [--reps R]]
+        [--migrate [--kill-device IDX] [--kill-at K] [--rolling]]
 
 Exit 0 on a passing gate (or sharp selftest), 1 on any failure.
 """
@@ -330,7 +345,7 @@ def run_soak(streams: int = 3, segments: int = 5, log2n: int = 13,
         recs = [json.loads(line) for line in open(jpaths[name])
                 if line.strip().startswith("{")]
         recs_by[name] = recs
-        check(recs and all(r.get("stream") == name and r["v"] == 10
+        check(recs and all(r.get("stream") == name and r["v"] == 11
                            for r in recs),
               f"stream {name}: journal records not stream-stamped")
         total_demote = int(recs[-1].get("plan_demotions", 0))
@@ -421,6 +436,226 @@ def selftest(log2n: int = 12) -> list[str]:
     return failures
 
 
+def run_migrate(streams: int = 3, segments: int = 6, log2n: int = 13,
+                seed: int = 0, kill_device: int = 1, kill_at: int = 2,
+                rolling: bool = False, tmpdir: str | None = None,
+                extra_cfg: dict | None = None) -> dict:
+    """Elastic-pool migration soak: solo goldens, then the same
+    streams on a seeded 2-device VIRTUAL pool with either a scoped
+    mid-run device kill (driver (a): the pool's deterministic
+    ``schedule_halt``) or an operator rolling restart (driver (c)).
+    Lanes run with ``inflight_segments=1`` so the cold-dispatch
+    arithmetic is exact: one ring cold per lane start plus exactly
+    one per migration.  ``extra_cfg`` overrides land on the FLEET
+    lanes only (race_soak arms ``tsan=1`` there).  Raises
+    :class:`SoakFailure` on any broken invariant; returns the report
+    dict."""
+    import threading
+    import time as _time
+
+    from srtb_tpu.io.writers import WriteSignalSink
+    from srtb_tpu.pipeline.fleet import StreamFleet, StreamSpec
+    from srtb_tpu.tools.crash_soak import snapshot_outputs
+    from srtb_tpu.utils import termination
+    from srtb_tpu.utils.metrics import metrics
+
+    tmp = tmpdir or tempfile.mkdtemp(prefix="srtb_migrate_")
+    n = 1 << log2n
+    names = _stream_names(streams)
+    _synthesize(tmp, names, n, segments, seed)
+
+    # ---- solo goldens (inflight 1, matching the fleet lanes)
+    solo_out: dict[str, dict] = {}
+    solo_dec: dict[str, list] = {}
+    solo_segs: dict[str, int] = {}
+    for name in names:
+        run_dir = os.path.join(tmp, f"solo_{name}")
+        os.makedirs(run_dir, exist_ok=True)
+        stats, dec = _solo_run(
+            _cfg(tmp, name, run_dir, n, inflight_segments=1))
+        solo_out[name] = snapshot_outputs(run_dir)
+        solo_dec[name] = dec
+        solo_segs[name] = int(stats.segments)
+        if not solo_out[name]:
+            raise SoakFailure(
+                f"solo run of {name} wrote NO artifacts — the "
+                "bit-identical gate would be vacuous")
+
+    # ---- fleet run on the 2-device virtual pool
+    metrics.reset()
+    specs = []
+    taps: dict[str, _DecisionTap] = {}
+    jpaths: dict[str, str] = {}
+    for name in names:
+        run_dir = os.path.join(tmp, f"fleet_{name}")
+        os.makedirs(run_dir, exist_ok=True)
+        jpaths[name] = os.path.join(tmp, f"journal_{name}.jsonl")
+        cfg = _cfg(tmp, name, run_dir, n, fleet_devices=2,
+                   inflight_segments=1,
+                   telemetry_journal_path=jpaths[name],
+                   **(extra_cfg or {}))
+        taps[name] = _DecisionTap()
+        specs.append(StreamSpec(
+            name=name, cfg=cfg,
+            source=make_deterministic_source(cfg),
+            sinks=[WriteSignalSink(cfg), taps[name]]))
+    fleet = StreamFleet(specs)
+    pool_size = len(fleet.pool)
+    if pool_size != 2:
+        raise SoakFailure(
+            f"fleet built a {pool_size}-member pool (fleet_devices=2 "
+            "requested) — the migration soak needs a 2-device pool")
+    trigger: threading.Thread | None = None
+    fired = threading.Event()
+    if rolling:
+        # operator path: a tagged side thread waits for steady state
+        # (a few dispatches landed) then queues the rolling restart —
+        # the scheduler thread does the actual drains
+        def _roll_trigger():
+            while not fired.is_set():
+                if fleet.pool.total_dispatches >= max(1, kill_at):
+                    fleet.rolling_restart()
+                    fired.set()
+                    return
+                _time.sleep(0.001)
+        trigger = threading.Thread(
+            target=_roll_trigger, name="migrate-soak-roll",
+            daemon=True)
+        termination.tag_thread(trigger)
+        trigger.start()
+    else:
+        fleet.pool.schedule_halt(kill_device,
+                                 after_dispatches=max(1, kill_at))
+    results = fleet.run()
+    pool_compiles = fleet.pool.compiles
+    if trigger is not None:
+        fired.set()
+        trigger.join(timeout=10)
+    fleet.close()
+    dropped_by = metrics.by_label("segments_dropped")
+    migs = int(metrics.get("migrations"))
+    drains = int(metrics.get("device_drains"))
+    ring_cold = int(metrics.get("ring_cold_dispatches"))
+
+    def check(cond, msg):
+        if not cond:
+            raise SoakFailure(msg)
+
+    for name in names:
+        check(results[name].status == "done",
+              f"stream {name} did not finish: {results[name].status} "
+              f"({results[name].error!r})")
+
+    # (a) lossless resume: zero drops, every source segment drained
+    for name in names:
+        vdropped = int(dropped_by.get(name, 0))
+        check(vdropped == 0,
+              f"stream {name}: {vdropped} segment(s) dropped — "
+              "migration must be lossless (cold re-dispatch, not "
+              "shed)")
+        check(results[name].drained == solo_segs[name],
+              f"stream {name}: drained {results[name].drained} != "
+              f"{solo_segs[name]} solo source segments")
+
+    # (b) bit-identity for EVERY stream — victims included: the
+    # migrated lane's outputs (paths + SHA-256) and detection
+    # decisions match its solo golden exactly
+    for name in names:
+        fleet_set = snapshot_outputs(os.path.join(tmp, f"fleet_{name}"))
+        check(fleet_set == solo_out[name],
+              f"stream {name}: fleet output set differs from its "
+              f"solo golden (fleet {sorted(fleet_set)} vs solo "
+              f"{sorted(solo_out[name])})")
+        check(len(taps[name].out) == len(solo_dec[name]),
+              f"stream {name}: {len(taps[name].out)} decisions vs "
+              f"{len(solo_dec[name])} solo")
+        for i, (a, b) in enumerate(zip(taps[name].out,
+                                       solo_dec[name])):
+            check(np.array_equal(a[0], b[0])
+                  and np.array_equal(a[1], b[1]) and a[2] == b[2],
+                  f"stream {name}: decision differs at segment {i} "
+                  "(migration changed the science)")
+
+    # (c) migration accounting: drivers fired, victims resumed on the
+    # survivor, exactly one extra ring cold dispatch per migration
+    per_lane_migs = {name: int(results[name].extras.get(
+        "migrations", 0)) for name in names}
+    check(migs >= 1,
+          "no migration happened — the kill/rolling driver never "
+          "fired (did the run finish before the trigger?)")
+    check(migs == sum(per_lane_migs.values()),
+          f"migrations counter {migs} != per-lane sum "
+          f"{sum(per_lane_migs.values())}")
+    check(ring_cold == streams + migs,
+          f"ring_cold_dispatches {ring_cold} != {streams} lane "
+          f"starts + {migs} migrations — a migration must cost "
+          "EXACTLY one cold re-arm")
+    if rolling:
+        check(fired.is_set(), "rolling trigger thread never fired")
+        check(drains == pool_size,
+              f"device_drains {drains} != {pool_size} pool members "
+              "(rolling restart drains each member once)")
+    else:
+        killed = fleet.pool.devices[kill_device].label
+        check(drains == 1,
+              f"device_drains {drains} != 1 (one scoped kill)")
+        victims = [n for n in names if per_lane_migs[n] > 0]
+        check(victims,
+              "scoped kill produced no victim lanes — nothing was "
+              f"placed on {killed}?")
+        for name in victims:
+            check(results[name].extras.get("device") != killed,
+                  f"victim {name} finished on {killed} — it never "
+                  "resumed on the survivor")
+        # the scoped HALT-domain pin: one compile per member, no
+        # survivor recompile (migrants REJOIN the survivor's plan
+        # family), no demotions, no fleet-wide reinit
+        check(pool_compiles == pool_size,
+              f"pool recorded {pool_compiles} compiles for "
+              f"{pool_size} members — a scoped halt must not "
+              "recompile the survivor's plans")
+    check(int(metrics.get("device_reinits")) == 0,
+          "a scoped device halt escalated to a fleet-wide reinit")
+    check(int(metrics.get("plan_demotions")) == 0,
+          "migration demoted a lane's plan — resume must rejoin the "
+          "target's shared family at rung 0")
+
+    # (d) journal: v11, every record device-stamped, victim journals
+    # END on a surviving member's label
+    killed_label = (None if rolling
+                    else fleet.pool.devices[kill_device].label)
+    for name in names:
+        recs = [json.loads(line) for line in open(jpaths[name])
+                if line.strip().startswith("{")]
+        check(recs and all(r["v"] == 11 and r.get("device")
+                           for r in recs),
+              f"stream {name}: journal records missing v11 device "
+              "stamps")
+        check(len(recs) == solo_segs[name],
+              f"stream {name}: {len(recs)} journal records != "
+              f"{solo_segs[name]} drained segments")
+        if killed_label is not None and per_lane_migs[name] > 0:
+            check(recs[-1]["device"] != killed_label,
+                  f"victim {name}: journal ends on the KILLED member "
+                  f"{killed_label}")
+            check(len({r["device"] for r in recs}) >= 2,
+                  f"victim {name}: journal never switched device "
+                  "labels across the migration boundary")
+
+    return {
+        "streams": streams, "segments": segments,
+        "mode": "rolling" if rolling else "kill",
+        "kill_device": None if rolling else kill_device,
+        "kill_at": kill_at, "migrations": migs,
+        "per_lane_migrations": per_lane_migs,
+        "device_drains": drains,
+        "ring_cold_dispatches": ring_cold,
+        "pool_compiles": pool_compiles,
+        "drained": {k: results[k].drained for k in names},
+        "ok": True,
+    }
+
+
 def run_ab(segments: int = 20, log2n: int = 13, reps: int = 3) -> dict:
     """Steady-state single-stream A/B: fleet engine with N=1 vs the
     solo Pipeline, same config/data, median-of-reps seg/s each."""
@@ -489,6 +724,19 @@ def main(argv=None) -> int:
     ap.add_argument("--ab", action="store_true",
                     help="single-stream A/B: fleet N=1 vs Pipeline")
     ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--migrate", action="store_true",
+                    help="elastic-pool migration soak: 2-device "
+                         "virtual pool, scoped mid-run device kill "
+                         "(or --rolling), bit-identical resume gate")
+    ap.add_argument("--kill-device", type=int, default=1,
+                    help="pool member index the scheduled halt kills")
+    ap.add_argument("--kill-at", type=int, default=2,
+                    help="member dispatch count the halt fires after "
+                         "(rolling: pool dispatch count that triggers "
+                         "the restart)")
+    ap.add_argument("--rolling", action="store_true",
+                    help="drive migration via an operator rolling "
+                         "restart instead of a device kill")
     args = ap.parse_args(argv)
 
     if args.selftest:
@@ -503,6 +751,20 @@ def main(argv=None) -> int:
         print(json.dumps(run_ab(segments=args.segments * 4,
                                 log2n=args.log2n, reps=args.reps),
                          sort_keys=True))
+        return 0
+    if args.migrate:
+        try:
+            report = run_migrate(
+                streams=args.streams, segments=args.segments,
+                log2n=args.log2n, seed=args.seed,
+                kill_device=args.kill_device, kill_at=args.kill_at,
+                rolling=args.rolling)
+        except SoakFailure as e:
+            print(json.dumps({"ok": False, "failure": str(e)}))
+            print(f"fleet-soak: MIGRATION GATE FAILED — {e}",
+                  file=sys.stderr)
+            return 1
+        print(json.dumps(report, sort_keys=True))
         return 0
     try:
         report = run_soak(streams=args.streams, segments=args.segments,

@@ -13,7 +13,11 @@ so the same seed yields the same perturbation schedule.
 
 Under that perturbation it runs the full multi-stream fleet +
 batch-former + chaos soak (tools/fleet_soak.py, unchanged gates) with
-a deadline, and checks:
+a deadline, then a second perturbed phase: the elastic-pool rolling-
+restart migration soak (``fleet_soak.run_migrate``), whose scheduler-
+thread drains, tagged trigger thread (``termination.tag_thread``) and
+sink-pipe threads interleave with the perturbation sleeps — live
+migration must stay bit-identical under any interleave.  Checks:
 
 - every fleet_soak invariant still holds (bit-identical healthy
   outputs / vmap tolerance when batched, accounted-only victim loss,
@@ -65,7 +69,7 @@ def run_race_soak(streams: int = 2, segments: int = 4,
     """One perturbed soak.  Returns the report dict; raises
     :class:`RaceSoakFailure` (deadline/determinism) or propagates
     :class:`TsanError` / fleet_soak's ``SoakFailure``."""
-    from srtb_tpu.tools.fleet_soak import run_soak
+    from srtb_tpu.tools.fleet_soak import run_migrate, run_soak
     from srtb_tpu.utils import termination
 
     if plan is None:
@@ -93,22 +97,41 @@ def run_race_soak(streams: int = 2, segments: int = 4,
         except BaseException as e:  # noqa: BLE001 — reported below
             err.append(e)
 
+    def _mig_worker():
+        # phase 2: live migration under perturbation — a rolling
+        # restart of a 2-device virtual pool, its trigger thread
+        # tagged for the deadline gate's stack dumps.  The unchanged
+        # run_migrate gates (bit-identical resume, exact cold-
+        # dispatch arithmetic, v11 device-stamped journals) must hold
+        # under every widened interleave.
+        try:
+            out["migrate"] = run_migrate(
+                streams=streams, segments=max(segments, 5),
+                log2n=log2n, seed=seed, rolling=True, kill_at=3,
+                extra_cfg={"tsan": True})
+        except BaseException as e:  # noqa: BLE001 — reported below
+            err.append(e)
+
     install_perturber(perturber)
     try:
-        t = threading.Thread(target=_worker, name="race-soak-run",
-                             daemon=True)
-        termination.tag_thread(t)
-        t.start()
-        t.join(deadline_s)
-        if t.is_alive():
-            # the deadlock gate: dump every live thread with its
-            # creation site, then fail loudly
-            stacks = termination.format_thread_stacks(
-                threading.enumerate())
-            raise RaceSoakFailure(
-                f"race soak did not finish within {deadline_s:.0f}s "
-                "— deadlock or livelock under perturbation; live "
-                f"threads:\n{stacks}")
+        for tname, target in (("race-soak-run", _worker),
+                              ("race-soak-migrate", _mig_worker)):
+            t = threading.Thread(target=target, name=tname,
+                                 daemon=True)
+            termination.tag_thread(t)
+            t.start()
+            t.join(deadline_s)
+            if t.is_alive():
+                # the deadlock gate: dump every live thread with its
+                # creation site, then fail loudly
+                stacks = termination.format_thread_stacks(
+                    threading.enumerate())
+                raise RaceSoakFailure(
+                    f"race soak ({tname}) did not finish within "
+                    f"{deadline_s:.0f}s — deadlock or livelock under "
+                    f"perturbation; live threads:\n{stacks}")
+            if err:
+                break
     finally:
         uninstall_perturber()
     if err:
@@ -125,10 +148,14 @@ def run_race_soak(streams: int = 2, segments: int = 4,
                 f"{site!r} occurrence {k} was perturbed live but a "
                 f"fresh perturber with seed {seed} declines it")
     report = dict(out["report"])
+    mig = out.get("migrate", {})
     report.update({
         "seed": seed, "perturb_rate": rate,
         "perturbs": len(perturber.journal),
         "perturb_sites": sorted({s for s, _k in perturber.journal}),
+        "migrations": mig.get("migrations"),
+        "migrate_ring_cold": mig.get("ring_cold_dispatches"),
+        "migrate_device_drains": mig.get("device_drains"),
     })
     if not perturber.journal:
         raise RaceSoakFailure(

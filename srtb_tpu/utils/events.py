@@ -82,6 +82,13 @@ import time
 #       recovery flagged unrecoverable loss (fsck-grade)
 #   fleet.reinit / fleet.lane_failed
 #       shared device reinit; a lane's contained failure
+#   fleet.device_halt / fleet.device_drain / fleet.migrate
+#       elastic pool (pipeline/pool.py): a pool member halted
+#       (info = its label) and its lanes drain onto survivors; a
+#       rolling-restart drain of one member; one lane's live
+#       migration (info = "src->dst", stream labels the lane) —
+#       admission re-attribution rides the ``admission`` kind with
+#       info = "migrate:src->dst"
 #   incident
 #       an incident bundle was written; info = the bundle dir name
 #   slo

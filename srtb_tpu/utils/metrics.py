@@ -354,6 +354,19 @@ class Metrics:
         "batch_size": "Formed cross-stream batch sizes (histogram)",
         "fleet_idle_waits": "Idle scheduler rounds parked on the "
                             "event-driven wakeup",
+        "fleet_pool_size": "Pool members the fleet places lanes "
+                           "across",
+        "fleet_device_state": "Pool member state (0 ok / 1 draining "
+                              "/ 2 halted)",
+        "fleet_device_lanes": "Live lanes placed on a pool member",
+        "fleet_readmitted": "Live-migration re-admissions on a "
+                            "target pool member",
+        "fleet_batch_device_guard": "Batch offers re-routed solo by "
+                                    "the post-migration membership "
+                                    "guard",
+        "migrations": "Lane live-migrations between pool members",
+        "device_drains": "Pool members drained (halt, SLO rebalance "
+                         "source, rolling restart)",
         "fleet_restores": "Fleet fairness restore transitions",
         "fleet_shed_streams": "Streams currently force-shed",
         "fleet_streams_total": "Streams submitted to the fleet",

@@ -82,10 +82,16 @@ from srtb_tpu.utils.metrics import metrics
 # between becoming ready and the shared dispatch — the linger cost
 # the fleet_batch_linger_ms deadline bounds).  Both OMITTED on solo
 # dispatches (never a fake 1/0): a journal with no batching armed
-# reads exactly as v9.  Readers must tolerate mixed v1-v10 journals:
-# rotation can leave an older-schema tail in the previous generation
-# after an upgrade.
-SPAN_SCHEMA_VERSION = 10
+# reads exactly as v9.
+# v11 (elastic device pool): adds ``device`` — which pool member
+# (pipeline/pool.py label, e.g. "dev0") this segment was dispatched
+# through at drain time; after a live migration a lane's spans switch
+# labels at the migration boundary, which is how the migration soak
+# proves victims resumed on the survivor.  OMITTED outside a fleet
+# (no pool, no label): a solo run's journal reads exactly as v10.
+# Readers must tolerate mixed v1-v11 journals: rotation can leave an
+# older-schema tail in the previous generation after an upgrade.
+SPAN_SCHEMA_VERSION = 11
 
 # gauge names shared between the pipeline (writer) and health() (reader)
 LAST_SEGMENT_MONOTONIC = "last_segment_monotonic"
@@ -274,7 +280,8 @@ def segment_span(segment: int, stages_s: dict, queue_depth: int,
                  achieved_msamps: float | None = None,
                  roofline_frac: float | None = None,
                  batch_size: int | None = None,
-                 batch_wait_ms: float | None = None) -> dict:
+                 batch_wait_ms: float | None = None,
+                 device: str | None = None) -> dict:
     """One journal record.  ``stages_s`` maps stage name -> seconds for
     THIS segment; loss/drop counters are the cumulative registry values
     at drain time (deltas between consecutive records localize a loss
@@ -395,6 +402,12 @@ def segment_span(segment: int, stages_s: dict, queue_depth: int,
             rec[key] = type(rec[key])(metrics.get(key, labels=lbl))
         rec["compile_ms"] = round(
             metrics.get("compile_seconds", labels=lbl) * 1e3, 1)
+    if device:
+        # v11: the pool member this segment dispatched through (the
+        # fleet stamps its lanes; a migration switches the label at
+        # the boundary).  Omitted outside a fleet — never a fake
+        # placeholder.
+        rec["device"] = str(device)
     if trace_id:
         # v7: joins this span to its flight-recorder events (omitted
         # when tracing is off — never a fake 0)
@@ -509,6 +522,24 @@ def health(stale_after_s: float = 30.0) -> dict:
         out["slo"] = slo_report
         out["slo_ok"] = all(v.get("ok", True)
                             for v in slo_report.values())
+    # elastic device pool (pipeline/pool.py): per-member state and
+    # lane count, present only when a fleet published the pool gauges
+    # this process.  Deliberately NOT folded into liveness ``ok``
+    # either: a halted member whose lanes already live-migrated onto
+    # survivors is a CAPACITY alert (the fleet_device_state gauge and
+    # device_drains counter), not a reason to restart a process that
+    # is still draining every stream.
+    dev_states = metrics.by_label("fleet_device_state", label="device")
+    if dev_states:
+        _names = {0: "ok", 1: "draining", 2: "halted"}
+        dev_lanes = metrics.by_label("fleet_device_lanes",
+                                     label="device")
+        out["devices"] = {
+            d: {"state": _names.get(int(v), str(int(v))),
+                "lanes": int(dev_lanes.get(d, 0))}
+            for d, v in sorted(dev_states.items())}
+        out["migrations"] = int(metrics.get("migrations"))
+        out["device_drains"] = int(metrics.get("device_drains"))
     # detection health (quality/canary.py): present only once a
     # pulse-injection canary has been CHECKED this process — a
     # canary-off run (or one whose first canary hasn't drained)

@@ -326,7 +326,7 @@ def test_fleet_victim_oom_isolated(tmp_path):
     # v7 journals: stream-stamped; per-stream attribution fields
     for t in bbs:
         recs = [json.loads(line) for line in open(jp[t])]
-        assert all(r["v"] == 10 and r["stream"] == t for r in recs)
+        assert all(r["v"] == 11 and r["stream"] == t for r in recs)
         want = 1 if t == "s1" else 0
         assert recs[-1]["plan_demotions"] == want, t
 
@@ -549,9 +549,9 @@ def test_fleet_prometheus_labels(tmp_path):
 def test_span_schema_v7_stream_field():
     from srtb_tpu.utils.telemetry import (SPAN_SCHEMA_VERSION,
                                           segment_span)
-    assert SPAN_SCHEMA_VERSION == 10
+    assert SPAN_SCHEMA_VERSION == 11
     rec = segment_span(0, {"ingest": 0.01}, 1, 0, False, 4)
-    assert rec["v"] == 10 and "stream" not in rec
+    assert rec["v"] == 11 and "stream" not in rec
     metrics.set("plan_demotions", 7)  # global; must NOT leak into a
     metrics.add("plan_demotions", 2, labels={"stream": "x"})
     rec = segment_span(0, {"ingest": 0.01}, 1, 0, False, 4,
@@ -678,6 +678,251 @@ def test_fleet_lane_failure_contained(tmp_path):
     assert bad.drained + bad.dropped == bad.stats.segments
 
 
+# ------------------------------------- elastic pool: drain + migration
+
+
+def test_fleet_pool_scoped_halt_drains_victim_only(tmp_path):
+    """Satellite 1: with >= 2 pool members, a device HALT is no longer
+    the shared domain — the faulted member is drained (its plan cache
+    alone force-retired, its lanes live-migrated onto the survivor)
+    and the budgeted fleet-wide reinit is NOT spent.  The migrant
+    rejoins the survivor's plan family at rung 0: pool-wide compiles
+    stay at one per device and every stream stays bit-identical."""
+    bbs = {t: _make_bb(tmp_path, t, i)
+           for i, t in enumerate(("s0", "s1"))}
+    solo = {}
+    for t, bb in bbs.items():
+        metrics.reset()
+        solo[t] = _solo(_mkcfg(tmp_path, t + "solo", bb))
+    metrics.reset()
+    caps = {t: _Cap() for t in bbs}
+    fleet = StreamFleet([
+        StreamSpec(name=t,
+                   cfg=_mkcfg(tmp_path, t, bb,
+                              fleet_devices=2,
+                              fault_plan="s1:dispatch:device_halt@2",
+                              device_reinit_max=1),
+                   sinks=[caps[t]])
+        for t, bb in bbs.items()])
+    assert len(fleet.pool) == 2
+    res = fleet.run()
+    pool_compiles = fleet.pool.compiles
+    halted = fleet.pool.devices[1].state
+    fleet.close()
+    assert all(r.status == "done" for r in res.values())
+    # the reinit budget was available and must NOT have been spent
+    assert metrics.get("device_reinits") == 0
+    assert metrics.get("device_drains") == 1
+    assert metrics.by_label("migrations") == {"s1": 1.0}
+    from srtb_tpu.pipeline.pool import STATE_HALTED
+    assert halted == STATE_HALTED  # a member halts at most once
+    # deterministic placement: s0 -> dev0, s1 -> dev1; the victim
+    # drained onto the survivor
+    assert res["s0"].extras["device"] == "dev0"
+    assert res["s1"].extras["device"] == "dev0"
+    assert res["s1"].extras["migrations"] == 1
+    # one compile per device, zero recompiles for the migration (the
+    # migrant adopted the survivor's family at rung 0)
+    assert pool_compiles == 2
+    assert metrics.get("plan_demotions") == 0
+    for t in bbs:
+        assert res[t].dropped == 0
+        assert res[t].drained == solo[t][0].segments
+        _decisions_equal(caps[t].out, solo[t][1])
+
+
+def test_batch_former_membership_revalidated(tmp_path):
+    """Satellite 2: a migrated/healed lane can never batch into its
+    FORMER device's family — eligibility keys on the lane's CURRENT
+    processor identity and its member's health state."""
+    from types import SimpleNamespace
+
+    from srtb_tpu.pipeline.fleet import _BatchFormer
+    from srtb_tpu.pipeline.pool import (STATE_DRAINING, STATE_OK,
+                                        DevicePool)
+
+    pool = DevicePool(2)
+
+    class _Proc:
+        _fleet_shared = True
+        staged = False
+
+    class _Lane:
+        def __init__(self, proc, dev):
+            self.pipe = SimpleNamespace(processor=proc)
+            self.device = dev
+
+        def _unit(self):
+            return 1
+
+    former = _BatchFormer(SimpleNamespace(_tsan=None),
+                          batch_max=3, linger_s=1.0)
+    proc_a, proc_b = _Proc(), _Proc()
+    lane0 = _Lane(proc_a, pool.devices[0])
+    lane1 = _Lane(proc_a, pool.devices[0])
+    lane2 = _Lane(proc_b, pool.devices[1])
+    assert all(former.eligible(ln) for ln in (lane0, lane1, lane2))
+    # a draining/halted member's lanes stop offering immediately
+    pool.devices[0].set_state(STATE_DRAINING)
+    assert not former.eligible(lane0) and not former.eligible(lane1)
+    assert former.eligible(lane2)
+    pool.devices[0].set_state(STATE_OK)
+    # groups key on processor identity: per-device families can never
+    # merge, and a migration (which swaps in the TARGET cache's
+    # processor) moves the lane to the target's group by construction
+    former.offer(lane0, (object(), 0.0, 0), 0)
+    former.offer(lane2, (object(), 0.0, 0), 0)
+    assert len(former._groups) == 2
+    assert {id(proc_a), id(proc_b)} == set(former._groups)
+    # after a simulated migration lane1 carries dev1's processor: its
+    # next offer joins dev1's family, not dev0's
+    lane1.pipe.processor = proc_b
+    lane1.device = pool.devices[1]
+    former.offer(lane1, (object(), 0.0, 1), 1)
+    assert len(former._groups[id(proc_b)][1]) == 2
+    assert len(former._groups[id(proc_a)][1]) == 1
+
+
+def test_fleet_stream_killed_on_a_resumes_on_b(tmp_path):
+    """Satellite 3: a stream killed mid-segment on device A resumes
+    on device B (pin_device) — final output set bit-identical to an
+    uninterrupted solo run, manifest fsck-clean."""
+    from srtb_tpu.tools.crash_soak import snapshot_outputs
+    from srtb_tpu.tools.fsck import fsck
+
+    bb = _make_bb(tmp_path, "mig", 5)
+
+    def _dcfg(tag, run_dir, **kw):
+        # default sinks (the artifact writers), deterministic names,
+        # detection relaxed so segments actually commit artifacts
+        run_dir.mkdir(exist_ok=True)
+        return _mkcfg(
+            tmp_path, tag, bb,
+            baseband_output_file_prefix=str(run_dir / "out_"),
+            checkpoint_path=str(run_dir / "ck.json"),
+            run_manifest_path=str(run_dir / "manifest.jsonl"),
+            deterministic_timestamps=True,
+            mitigate_rfi_average_method_threshold=1000.0,
+            mitigate_rfi_spectral_kurtosis_threshold=50.0,
+            signal_detect_signal_noise_threshold=2.0,
+            signal_detect_max_boxcar_length=8,
+            inflight_segments=1, **kw)
+
+    golden_dir = tmp_path / "golden_run"
+    metrics.reset()
+    with Pipeline(_dcfg("golden", golden_dir)) as pipe:
+        pipe.run()
+    golden = snapshot_outputs(str(golden_dir))
+    assert golden  # the equality gate below must gate something
+
+    # phase 1: the stream dies on dev0 after a segment committed but
+    # before its checkpoint landed (THE duplicate window)
+    run_dir = tmp_path / "mig_run"
+    metrics.reset()
+    fleet = StreamFleet([StreamSpec(
+        name="mig",
+        cfg=_dcfg("p1", run_dir, fleet_devices=2,
+                  fault_plan="checkpoint:fatal@1"),
+        pin_device=0)])
+    res1 = fleet.run()
+    fleet.close()
+    assert res1["mig"].status == "failed"
+    assert res1["mig"].extras["device"] == "dev0"
+
+    # phase 2: resume the SAME run pinned to dev1
+    metrics.reset()
+    fleet = StreamFleet([StreamSpec(
+        name="mig", cfg=_dcfg("p2", run_dir, fleet_devices=2),
+        pin_device=1)])
+    res2 = fleet.run()
+    fleet.close()
+    assert res2["mig"].status == "done"
+    assert res2["mig"].extras["device"] == "dev1"
+    assert res2["mig"].dropped == 0
+    # exactly-once across the device move: the union of both phases'
+    # outputs equals the uninterrupted golden, byte for byte
+    assert snapshot_outputs(str(run_dir)) == golden
+    rep = fsck(str(run_dir / "manifest.jsonl"),
+               str(run_dir / "ck.json"))
+    assert rep["clean"], rep
+
+
+def test_fleet_rebalance_on_slo_burn(tmp_path, monkeypatch):
+    """Driver (b): a burning stream on the loaded member migrates to
+    the strictly less-loaded peer (migrate_on_burn), exactly once
+    (cooldown), with decisions bit-identical to solo."""
+    from srtb_tpu.utils import slo
+
+    class _Burning:
+        def evaluate(self):
+            # s2 sits on dev0 (load 2) next to s0; dev1 holds s1 only
+            return {"s2": {"ok": False}}
+
+        def note_segment(self, *a, **k):
+            pass
+
+        note_dropped = note_canary = note_segment
+
+    monkeypatch.setattr(slo, "tracker", _Burning())
+    bbs = {t: _make_bb(tmp_path, t, i)
+           for i, t in enumerate(("s0", "s1", "s2"))}
+    metrics.reset()
+    solo = _solo(_mkcfg(tmp_path, "s2solo", bbs["s2"]))
+    metrics.reset()
+    caps = {t: _Cap() for t in bbs}
+    fleet = StreamFleet([
+        StreamSpec(name=t,
+                   cfg=_mkcfg(tmp_path, t, bb, fleet_devices=2,
+                              migrate_on_burn=True),
+                   sinks=[caps[t]])
+        for t, bb in bbs.items()])
+    res = fleet.run()
+    fleet.close()
+    assert all(r.status == "done" for r in res.values())
+    assert res["s2"].extras["device"] == "dev1"
+    assert res["s2"].extras["migrations"] == 1
+    assert metrics.by_label("migrations") == {"s2": 1.0}
+    # the rebalance is a drain-migrate, not a fault: nothing reinits,
+    # nothing demotes, nothing drops
+    assert metrics.get("device_reinits") == 0
+    assert metrics.get("plan_demotions") == 0
+    assert res["s2"].dropped == 0
+    _decisions_equal(caps["s2"].out, solo[1])
+
+
+def test_fleet_rolling_restart_drains_one_at_a_time(tmp_path):
+    """Driver (c): an operator rolling restart drains every member
+    exactly once, lanes live-migrate onto peers and every stream
+    finishes bit-identical with zero loss."""
+    bbs = {t: _make_bb(tmp_path, t, i)
+           for i, t in enumerate(("s0", "s1"))}
+    solo = {}
+    for t, bb in bbs.items():
+        metrics.reset()
+        solo[t] = _solo(_mkcfg(tmp_path, t + "solo", bb))
+    metrics.reset()
+    caps = {t: _Cap() for t in bbs}
+    fleet = StreamFleet([
+        StreamSpec(name=t,
+                   cfg=_mkcfg(tmp_path, t, bb, fleet_devices=2),
+                   sinks=[caps[t]])
+        for t, bb in bbs.items()])
+    fleet.rolling_restart()
+    res = fleet.run()
+    pool_states = [d.state for d in fleet.pool.devices]
+    fleet.close()
+    assert all(r.status == "done" for r in res.values())
+    assert metrics.get("device_drains") == 2
+    assert metrics.get("migrations") >= 2
+    assert metrics.get("device_reinits") == 0
+    from srtb_tpu.pipeline.pool import STATE_OK
+    assert pool_states == [STATE_OK, STATE_OK]  # drained members re-arm
+    for t in bbs:
+        assert res[t].dropped == 0
+        assert res[t].drained == solo[t][0].segments
+        _decisions_equal(caps[t].out, solo[t][1])
+
+
 # ----------------------------------------------------- fleet soak gate
 
 
@@ -694,3 +939,12 @@ def test_fleet_soak_gate():
 def test_fleet_soak_selftest_sharp():
     from srtb_tpu.tools.fleet_soak import selftest
     assert selftest(log2n=11) == []
+
+
+@pytest.mark.slow
+def test_fleet_migrate_soak_gate():
+    from srtb_tpu.tools.fleet_soak import run_migrate
+    report = run_migrate(streams=3, segments=6, log2n=12)
+    assert report["ok"]
+    assert report["device_drains"] == 1
+    assert report["migrations"] >= 1

@@ -1,0 +1,164 @@
+"""Device pool + placement policy units (pipeline/pool.py,
+pipeline/placement.py).
+
+The elastic-fleet control plane must be provable WITHOUT a fleet:
+the pool's deterministic virtual halt (the CPU-CI stand-in for a
+dying accelerator), the per-member plan-cache/halt-domain isolation,
+the health-state gauge twins, and the pure placement policy
+(least-loaded, soft same-tenant anti-affinity, pin validation) are
+all unit-scoped here; tests/test_fleet.py proves the same machinery
+end-to-end through live migration.
+"""
+
+import pytest
+
+from srtb_tpu.pipeline import placement
+from srtb_tpu.pipeline.pool import (STATE_DRAINING, STATE_HALTED,
+                                    STATE_OK, DevicePool, PoolDevice)
+from srtb_tpu.resilience.errors import DeviceHalt
+from srtb_tpu.utils.metrics import metrics
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+# ------------------------------------------------------------- pool
+
+
+def test_pool_single_member_by_default():
+    class Cfg:
+        fleet_devices = 0
+
+    pool = DevicePool.from_config(Cfg())
+    assert len(pool) == 1
+    assert pool.devices[0].label == "dev0"
+    assert pool.healthy() == pool.devices
+    assert metrics.get("fleet_pool_size") == 1
+
+
+def test_pool_virtual_members_have_distinct_caches():
+    class Cfg:
+        fleet_devices = 3
+
+    pool = DevicePool.from_config(Cfg())
+    assert len(pool) == 3
+    caches = {id(d.plans) for d in pool}
+    assert len(caches) == 3  # per-member halt domains, never shared
+    assert [d.label for d in pool] == ["dev0", "dev1", "dev2"]
+    assert metrics.get("fleet_pool_size") == 3
+
+
+def test_scheduled_halt_fires_exactly_once():
+    dev = PoolDevice(0)
+    dev.schedule_halt(after_dispatches=2)
+    dev.note_dispatch()
+    dev.note_dispatch()  # == threshold: still healthy
+    with pytest.raises(DeviceHalt, match="dev0"):
+        dev.note_dispatch()  # first dispatch PAST the threshold
+    # one-shot: the member is being drained, not flapping
+    dev.note_dispatch()
+    assert dev.dispatches == 4
+
+
+def test_scheduled_halt_skips_formed_batches():
+    """check=False (the formed-batch dispatch clock) counts but never
+    fires: scheduled halts land at solo dispatch boundaries where the
+    lane's healer classifies them."""
+    dev = PoolDevice(0)
+    dev.schedule_halt(after_dispatches=0)
+    dev.note_dispatch(check=False)
+    dev.note_dispatch(check=False)
+    assert dev.dispatches == 2
+    with pytest.raises(DeviceHalt):
+        dev.note_dispatch()
+
+
+def test_state_gauge_publishes_per_device():
+    pool = DevicePool(2)
+    pool.devices[1].set_state(STATE_DRAINING)
+    states = metrics.by_label("fleet_device_state", label="device")
+    assert states == {"dev0": 0.0, "dev1": 1.0}
+    pool.devices[1].set_state(STATE_HALTED)
+    assert metrics.by_label("fleet_device_state",
+                            label="device")["dev1"] == 2.0
+    assert pool.healthy() == [pool.devices[0]]
+
+
+def test_invalidate_all_rearms_halted_members():
+    pool = DevicePool(2)
+    pool.devices[0].set_state(STATE_HALTED)
+    pool.invalidate_all()
+    assert all(d.state == STATE_OK for d in pool)
+    assert len(pool.healthy()) == 2
+
+
+def test_pool_counts_sum_members():
+    pool = DevicePool(2)
+    pool.devices[0].plans.compiles = 1
+    pool.devices[1].plans.compiles = 1
+    pool.devices[1].plans.hits = 3
+    assert pool.compiles == 2 and pool.hits == 3
+    pool.devices[0].note_dispatch()
+    pool.devices[1].note_dispatch()
+    assert pool.total_dispatches == 2
+
+
+# -------------------------------------------------------- placement
+
+
+class _Spec:
+    def __init__(self, name, pin_device=None):
+        self.name = name
+        self.pin_device = pin_device
+
+
+def test_tenant_is_prefix_before_dot():
+    assert placement.tenant_of("radioA.band0") == "radioA"
+    assert placement.tenant_of("flat") == "flat"
+
+
+def test_initial_placement_least_loaded_min_index_tie():
+    devs = DevicePool(3).devices
+    assert placement.choose_initial(
+        _Spec("s"), devs, {0: 2, 1: 1, 2: 1}).index == 1
+    # full tie -> deterministic min index
+    assert placement.choose_initial(
+        _Spec("s"), devs, {}).index == 0
+
+
+def test_anti_affinity_prefers_tenant_clean_member():
+    devs = DevicePool(2).devices
+    # equal load, but dev0 already hosts the tenant: dev1 wins
+    got = placement.choose_initial(
+        _Spec("radioA.band1"), devs, {0: 1, 1: 1},
+        tenants_by_device={0: {"radioA"}, 1: {"radioB"}})
+    assert got.index == 1
+    # anti-affinity is SOFT: a strictly less-loaded co-tenant device
+    # still wins over an empty-of-tenant but busier one
+    got = placement.choose_initial(
+        _Spec("radioA.band2"), devs, {0: 0, 1: 5},
+        tenants_by_device={0: {"radioA"}})
+    assert got.index == 0
+
+
+def test_pin_device_validated_pure_config():
+    devs = DevicePool(2).devices
+    assert placement.choose_initial(
+        _Spec("s", pin_device=1), devs, {}).index == 1
+    with pytest.raises(ValueError, match="pin_device=7"):
+        placement.choose_initial(_Spec("s", pin_device=7), devs, {})
+    # a pin onto an unhealthy (pre-filtered) member fails the same way
+    with pytest.raises(ValueError, match="pin_device=0"):
+        placement.choose_initial(_Spec("s", pin_device=0),
+                                 devs[1:], {})
+
+
+def test_choose_target_excludes_current_and_handles_no_peer():
+    devs = DevicePool(2).devices
+    got = placement.choose_target("s", 0, devs, {0: 1, 1: 9})
+    assert got.index == 1  # only peer, load notwithstanding
+    assert placement.choose_target("s", 0, devs[:1], {}) is None
