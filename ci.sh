@@ -24,13 +24,13 @@ if [ "${1:-}" = "--hardware" ]; then
   exit 0
 fi
 
-echo "== [1/22] native build =="
+echo "== [1/23] native build =="
 make -C srtb_tpu/native
 
-echo "== [2/22] native sanitizer harness (ASan/UBSan) =="
+echo "== [2/23] native sanitizer harness (ASan/UBSan) =="
 make -C srtb_tpu/native check
 
-echo "== [3/22] static checks (compile + import) =="
+echo "== [3/23] static checks (compile + import) =="
 python -m compileall -q srtb_tpu tests bench.py __graft_entry__.py
 python - <<'EOF'
 import importlib, pkgutil
@@ -45,7 +45,7 @@ assert not bad, bad
 print(f"all srtb_tpu modules import cleanly")
 EOF
 
-echo "== [4/22] srtb-lint (static analysis vs baseline) =="
+echo "== [4/23] srtb-lint (static analysis vs baseline) =="
 # fails on findings not in srtb_tpu/analysis/baseline.json; accept an
 # intentional finding with --write-baseline + a note, or a pragma.
 # The machine-readable run lands next to the other CI artifacts.
@@ -54,7 +54,7 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.lint srtb_tpu/ \
   --format json > artifacts/lint.json \
   || { cat artifacts/lint.json; exit 1; }
 
-echo "== [5/22] plan audit (compile-time HLO cards vs baseline) =="
+echo "== [5/23] plan audit (compile-time HLO cards vs baseline) =="
 # AOT-lowers every plan family and audits the compiled artifacts:
 # spectrum-sized HBM sweeps vs the declared hbm_passes floor, donation
 # proven aliased (not silently dropped), no f64/host-callback/
@@ -66,7 +66,7 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.plan_audit \
   --out artifacts/plan_cards_audit.json
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.plan_audit --selftest
 
-echo "== [6/22] pytest (8-device CPU mesh) =="
+echo "== [6/23] pytest (8-device CPU mesh) =="
 FAST_ARGS=()
 if [ "${1:-}" = "--fast" ]; then
   # one source of truth for what "slow" means: the pytest marker
@@ -75,11 +75,11 @@ if [ "${1:-}" = "--fast" ]; then
 fi
 python -m pytest tests/ -q "${FAST_ARGS[@]}"
 
-echo "== [7/22] bench smoke (with the roofline/audit cross-check) =="
+echo "== [7/23] bench smoke (with the roofline/audit cross-check) =="
 JAX_PLATFORMS=cpu SRTB_BENCH_LOG2N=16 SRTB_BENCH_AUDIT=1 \
   python bench.py | tail -1
 
-echo "== [8/22] fused-plan parity (spectrum-pass fusion, Pallas interpret on CPU) =="
+echo "== [8/23] fused-plan parity (spectrum-pass fusion, Pallas interpret on CPU) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import numpy as np
 
@@ -155,7 +155,7 @@ print(f"ffuse parity OK: plan {ffuse.plan_name} (hbm_passes "
       f"{staged.hbm_passes}), decisions bit-identical")
 EOF
 
-echo "== [9/22] ring parity smoke (incremental H2D ring on vs off, Pallas interpret) =="
+echo "== [9/23] ring parity smoke (incremental H2D ring on vs off, Pallas interpret) =="
 # The ISSUE-8 acceptance gate: ring-on output is bit-identical to
 # ring-off on a Pallas-kernel plan (interpret mode on CPU), and the
 # per-segment h2d_bytes counter equals the stride model exactly — the
@@ -224,7 +224,7 @@ print(f"ring parity OK: plan {proc.plan_name}, {s_on.segments} segments "
       f"{proc.reserved_bytes / seg_b:.1%} per warm segment)")
 EOF
 
-echo "== [10/22] telemetry + sanitizer smoke (journal + report + /metrics + /healthz + Config.sanitize) =="
+echo "== [10/23] telemetry + sanitizer smoke (journal + report + /metrics + /healthz + Config.sanitize) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import json, os, tempfile, urllib.request
 
@@ -316,7 +316,7 @@ print(f"sanitizer smoke OK: {stats_s.segments} segments with "
       "Config.sanitize on, tripwire restored")
 EOF
 
-echo "== [11/22] fault-injection smoke (one transient fault at every site -> recovery + v8 telemetry) =="
+echo "== [11/23] fault-injection smoke (one transient fault at every site -> recovery + v8 telemetry) =="
 JAX_PLATFORMS=cpu python - <<'EOF'
 import json, os, tempfile
 
@@ -394,7 +394,7 @@ print(f"fault-injection smoke OK: {st1.segments} segments recovered "
       "/metrics + v8 journal")
 EOF
 
-echo "== [12/22] chaos smoke (self-healing compute: oom + compile_fail + device_halt in one run) =="
+echo "== [12/23] chaos smoke (self-healing compute: oom + compile_fail + device_halt in one run) =="
 # The ISSUE-9 acceptance gate: a deterministic fault plan injecting all
 # three device-fault classes completes with accounted-only loss,
 # detection decisions identical to the clean run, and the
@@ -408,7 +408,7 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.chaos_soak --segments 6 \
   | tail -1
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.chaos_soak --selftest
 
-echo "== [13/22] crash-soak smoke (SIGKILL exactly-once: manifest recovery + fsck + bit-identical union) =="
+echo "== [13/23] crash-soak smoke (SIGKILL exactly-once: manifest recovery + fsck + bit-identical union) =="
 # The ISSUE-10 acceptance gate, CI-sized: a deterministic two-kill plan
 # — one SIGKILL mid-checkpoint-flush (between sink commit and the
 # checkpoint update, the duplicate-on-resume window) and one mid-
@@ -423,11 +423,11 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.crash_soak --segments 5 \
   --kills 2 --kill-plan "ckpt_stall@1,rename@1" --log2n 13 | tail -1
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.fsck --selftest
 
-echo "== [14/22] multichip dryrun (8 virtual devices) =="
+echo "== [14/23] multichip dryrun (8 virtual devices) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== [15/22] fleet smoke (multi-tenant bulkheads: 3 streams, 1 victim, shared plan cache) =="
+echo "== [15/23] fleet smoke (multi-tenant bulkheads: 3 streams, 1 victim, shared plan cache) =="
 # The ISSUE-11 acceptance gate, CI-sized: 3 seeded streams on one
 # device, a stream-selector fault plan injected into stream0 (oom ->
 # victim-only demotion, plus a transient sink fault and a fetch
@@ -442,7 +442,7 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.fleet_soak --streams 3 \
   --segments 4 --log2n 12 | tail -1
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.fleet_soak --selftest
 
-echo "== [16/22] fleet-batch smoke (cross-tenant continuous batching: 4 streams, one shared dispatch) =="
+echo "== [16/23] fleet-batch smoke (cross-tenant continuous batching: 4 streams, one shared dispatch) =="
 # The ISSUE-17 acceptance gate, CI-sized: the round-15 fleet soak
 # re-run with the batch former armed (fleet_batch_max=4).  Gate, on
 # top of the bulkhead checks above: the v10 journal records batched
@@ -456,7 +456,7 @@ echo "== [16/22] fleet-batch smoke (cross-tenant continuous batching: 4 streams,
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.fleet_soak --streams 4 \
   --segments 5 --log2n 12 --batch 4 | tail -1
 
-echo "== [17/22] race-soak smoke (seeded schedule perturbation + lockdep, Config.tsan) =="
+echo "== [17/23] race-soak smoke (seeded schedule perturbation + lockdep, Config.tsan) =="
 # The ISSUE-18 acceptance gate, CI-sized.  First the selftest: the
 # lockdep layer must TRAP a deliberately injected lock-order inversion
 # (and stay quiet on a consistent global order) — a soak that cannot
@@ -475,7 +475,7 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.race_soak --selftest
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.race_soak --streams 2 \
   --segments 4 --log2n 12 --batch 2 --seed 0 --deadline 240 | tail -1
 
-echo "== [18/22] archive-replay smoke (full-throughput replay: SIGTERM resume + bit-identical union + micro-batch tolerance) =="
+echo "== [18/23] archive-replay smoke (full-throughput replay: SIGTERM resume + bit-identical union + micro-batch tolerance) =="
 # The ISSUE-12 acceptance gate, CI-sized: a 2-file fleet-fanned replay
 # (deterministic timestamps, per-file checkpoint + manifest namespaces)
 # killed by a SIGTERM steered into one lane's sink-write window, then
@@ -487,7 +487,7 @@ echo "== [18/22] archive-replay smoke (full-throughput replay: SIGTERM resume + 
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.archive_replay --selftest \
   --segments 4 --log2n 13 | tail -1
 
-echo "== [19/22] trace/incident smoke (causal tracing + flight recorder + bundle + Chrome-trace export) =="
+echo "== [19/23] trace/incident smoke (causal tracing + flight recorder + bundle + Chrome-trace export) =="
 # The ISSUE-13 acceptance gate, CI-sized: a clean traced run proves
 # every segment leaves a complete ingest->dispatch->fetch->sink causal
 # chain whose export is valid Chrome-trace JSON (schema-checked, flow
@@ -574,7 +574,7 @@ print(f"trace/incident smoke OK: {stats.segments} traced segments "
       f"{meta['trace_id']}")
 EOF
 
-echo "== [20/22] canary + quality smoke (pulse-injection sensitivity gate + quality report artifact) =="
+echo "== [20/23] canary + quality smoke (pulse-injection sensitivity gate + quality report artifact) =="
 # The ISSUE-16 acceptance gate, CI-sized.  Leg 1 (clean): a file-mode
 # run with the canary on and the quality epilogue enabled must inject,
 # recover, and PASS every sensitivity check (auto-calibrated expected
@@ -662,7 +662,7 @@ python -m srtb_tpu.tools.quality_report "$CANARY_JOURNAL" \
 grep -q '"canary"' artifacts/quality_report.json
 grep -q '## Canary' artifacts/quality_report.md
 
-echo "== [21/22] perf-gate smoke (noise-aware regression gate + ledger trajectory) =="
+echo "== [21/23] perf-gate smoke (noise-aware regression gate + ledger trajectory) =="
 # The ISSUE-14 acceptance gate: (a) the gate's selftest proves an
 # injected dispatch-path slowdown (Config.fault_plan stall) FAILS the
 # statistical gate while a clean rerun passes within the COMPUTED
@@ -695,7 +695,7 @@ print(f"perf trajectory OK: {doc['records']} records across "
       f"{len(doc['groups'])} group(s), imports + gate captures present")
 EOF
 
-echo "== [22/22] migration smoke (elastic pool: scoped device kill + rolling restart, live migration bit-identical) =="
+echo "== [22/23] migration smoke (elastic pool: scoped device kill + rolling restart, live migration bit-identical) =="
 # The ISSUE-19 acceptance gate, CI-sized: 3 seeded streams placed
 # across a 2-member VIRTUAL pool (distinct plan caches / halt domains
 # on one CPU device).  Kill mode: a scheduled mid-run halt of member
@@ -715,5 +715,96 @@ JAX_PLATFORMS=cpu python -m srtb_tpu.tools.fleet_soak --migrate \
   | tail -1
 JAX_PLATFORMS=cpu python -m srtb_tpu.tools.fleet_soak --migrate \
   --rolling --streams 3 --segments 6 --log2n 12 --kill-at 2 | tail -1
+
+echo "== [23/23] fleet control tower (aggregator + rollup store + cross-device trace join + console + regression watch) =="
+# The ISSUE-20 acceptance gate, CI-sized: re-run the 2-member virtual
+# pool migration soak, then drive its three v11 journals + the flight
+# recorder dump through the REAL tower path: aggregator -> rollup
+# store (compaction byte-idempotent, cursor resume reads zero) ->
+# cross-device Perfetto join (a migrated stream's lane flows span
+# BOTH device process-tracks, same validate() gate as trace_export)
+# -> /fleet endpoint + pool-aggregated /metrics + operator console.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import glob, json, os, shutil, sys, urllib.request
+
+OUT = "artifacts/obs"
+shutil.rmtree(OUT, ignore_errors=True)
+os.makedirs(OUT, exist_ok=True)
+
+from srtb_tpu.tools.fleet_soak import run_migrate
+os.makedirs(os.path.join(OUT, "migrate_run"), exist_ok=True)
+rep = run_migrate(streams=3, segments=6, log2n=12, kill_device=1,
+                  kill_at=2, tmpdir=os.path.join(OUT, "migrate_run"))
+print("soak:", json.dumps({k: rep[k] for k in ("migrations", "device_drains")
+                           if k in rep}))
+
+from srtb_tpu.utils import events
+ev_path = os.path.join(OUT, "events.jsonl")
+n_ev = events.hub.dump_jsonl(ev_path)
+assert n_ev > 0, "event dump empty"
+
+journals = sorted(glob.glob(os.path.join(OUT, "migrate_run", "journal_*.jsonl")))
+assert len(journals) == 3, journals
+
+from srtb_tpu.obs.rollup import Aggregator
+from srtb_tpu.obs.store import RollupStore
+store_dir = os.path.join(OUT, "store")
+store = RollupStore(store_dir)
+agg = Aggregator(store, journals=journals, events_dumps=[ev_path])
+got = agg.poll()
+assert got["spans"] >= 18, got   # 3 streams x 6 segments
+assert got["events"] > 0, got
+agg.flush()
+# idempotent compaction: byte-identical on re-run
+store.compact()
+def seg_bytes():
+    return {n: open(os.path.join(store.segment_dir, n), "rb").read()
+            for n in sorted(os.listdir(store.segment_dir))}
+b1 = seg_bytes(); store.compact(); b2 = seg_bytes()
+assert b1 == b2, "compaction not idempotent"
+# resume cursor: a fresh aggregator re-reads nothing
+agg2 = Aggregator(RollupStore(store_dir), journals=journals)
+assert agg2.poll()["spans"] == 0, "cursor resume double-counted spans"
+print(f"store OK: {got['spans']} spans, {got['events']} fleet events, "
+      f"compaction idempotent, cursor resume clean")
+
+from srtb_tpu.obs import trace_join
+from srtb_tpu.tools.trace_export import validate
+doc = trace_join.join([ev_path], journals)
+problems = validate(doc)
+assert not problems, problems
+sd = doc["otherData"]["stream_devices"]
+assert any(len(v) >= 2 for v in sd.values()), sd
+with open(os.path.join(OUT, "fleet_trace.json"), "w") as f:
+    json.dump(doc, f)
+print(f"fleet trace OK: {len(doc['traceEvents'])} events, "
+      f"stream_devices={json.dumps(sd)}")
+
+from srtb_tpu.gui.server import WaterfallHTTPServer
+srv = WaterfallHTTPServer(OUT, port=0, fleet_store_dir=store_dir).start()
+try:
+    base = f"http://127.0.0.1:{srv.port}"
+    with urllib.request.urlopen(base + "/fleet", timeout=10) as r:
+        fleet = json.loads(r.read().decode())
+    assert fleet["devices"], fleet
+    assert fleet["pool"]["migrations"] >= 1, fleet["pool"]
+    assert fleet.get("store", {}).get("timeline"), "no migration timeline"
+    with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+        prom = r.read().decode()
+    assert "srtb_migrations_pool_sum" in prom, "pool aggregate family missing"
+    assert "srtb_fleet_device_state_pool_max" in prom
+    from srtb_tpu.tools import console
+    assert console.main(["--url", base, "--once"]) == 0
+finally:
+    srv.stop()
+print("console + /fleet + pool-aggregated /metrics OK")
+EOF
+# Mid-run regression watch selftest: mini pipeline -> journal ->
+# aggregator rollup -> ledger history -> perf_stats verdict.  The
+# injected dispatch stall must escalate EXACTLY one incident bundle
+# (and latch on the second tick); the clean leg exactly zero.
+JAX_PLATFORMS=cpu python -m srtb_tpu.obs.regression --selftest \
+  2>/dev/null | tail -1 | tee artifacts/obs/regression_selftest.json
+grep -q '"selftest": "ok"' artifacts/obs/regression_selftest.json
 
 echo "CI OK"

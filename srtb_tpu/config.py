@@ -560,6 +560,26 @@ class Config:
     # ledger (utils/perf_ledger.py JSONL; tools/perf_report.py renders
     # the trajectory, tools/perf_gate.py gates regressions).  "" = off.
     perf_ledger_path: str = ""
+    # ---- fleet control tower (srtb_tpu/obs/) ----
+    # long-horizon rollup store directory the aggregator writes
+    # (obs/rollup.py tails the lanes' journals + event dumps into
+    # per-minute rollups, quantile digests and the fleet event
+    # timeline; gui/server.py's /fleet and tools/console.py read it).
+    # "" = off (zero cost).
+    obs_store_dir: str = ""
+    # downsampling resolution of the rollup minute-series (seconds
+    # per bucket)
+    obs_rollup_resolution_s: int = 60
+    # compaction drops rollup rows older than this many minutes
+    # behind the newest minute IN THE DATA (0 = keep everything)
+    obs_retention_minutes: int = 0
+    # mid-run regression watch (obs/regression.py): both the live
+    # rollup and the ledger history must have at least this many
+    # per-segment samples before a verdict is attempted
+    obs_regression_min_samples: int = 8
+    # extra required effect on top of the computed noise floor
+    # (fractional; 0.0 = the floor alone decides)
+    obs_regression_min_effect: float = 0.0
     # /healthz flips to 503 when the last processed segment is older
     # than this many seconds (gui/server.py staleness detection)
     health_stale_after_s: float = 30.0
@@ -617,6 +637,8 @@ class Config:
         "incident_max_bundles", "profile_capture_segments",
         "quality_coarse_bins", "quality_subsample",
         "canary_every_segments", "canary_width",
+        "obs_rollup_resolution_s", "obs_retention_minutes",
+        "obs_regression_min_samples",
     })
     _FLOAT_FIELDS = frozenset({
         "baseband_freq_low", "baseband_bandwidth", "baseband_sample_rate",
@@ -638,6 +660,7 @@ class Config:
         "quality_hot_threshold", "quality_drift_threshold",
         "quality_drift_alpha", "canary_amp", "canary_dm",
         "canary_position", "canary_expected_snr", "canary_min_ratio",
+        "obs_regression_min_effect",
     })
     _BOOL_FIELDS = frozenset({
         "baseband_reserve_sample", "baseband_write_all", "gui_enable",

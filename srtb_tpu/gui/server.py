@@ -149,6 +149,7 @@ contrast <input class="contrast" type="range" min="20" max="300"
 class _Handler(http.server.BaseHTTPRequestHandler):
     directory = "."
     health_stale_after_s = 30.0
+    fleet_store_dir = ""  # rollup store surfaced via /fleet
 
     def log_message(self, *args):  # quiet
         pass
@@ -227,6 +228,22 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(data)
             return
+        if self.path == "/fleet":
+            # the control tower's status snapshot (obs/status.py):
+            # pool member states, per-stream SLO burn, roofline,
+            # batch occupancy, drift — plus the rollup-store tail
+            # when the server was started with fleet_store_dir
+            from srtb_tpu.obs.status import fleet_status
+
+            status = fleet_status(store_dir=self.fleet_store_dir)
+            data = (json.dumps(status, sort_keys=True)
+                    + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
         if self.path == "/frames.json":
             data = (json.dumps(
                 {"streams": self._all_frames()}) + "\n").encode()
@@ -285,10 +302,11 @@ class WaterfallHTTPServer:
     def __init__(self, directory: str, port: int = 0,
                  address: str = "127.0.0.1",
                  health_stale_after_s: float = 30.0,
-                 supervisor=None):
+                 supervisor=None, fleet_store_dir: str = ""):
         handler = type("Handler", (_Handler,), {
             "directory": directory,
-            "health_stale_after_s": health_stale_after_s})
+            "health_stale_after_s": health_stale_after_s,
+            "fleet_store_dir": fleet_store_dir})
         self._httpd = http.server.ThreadingHTTPServer((address, port),
                                                       handler)
         self.port = self._httpd.server_address[1]

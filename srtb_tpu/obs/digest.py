@@ -1,0 +1,166 @@
+"""Mergeable quantile digest with a guaranteed relative error.
+
+The control tower needs distributions (stage wall clock, device time,
+batch size) that MERGE — across lanes, across devices, across
+aggregator flushes — which rules out both raw sample lists (unbounded)
+and the registry's fixed-bucket histograms (bucket edges tuned for
+host stage times, useless for batch sizes; merging two histograms with
+different edges is lossy in uncontrolled ways).
+
+:class:`QuantileDigest` is a DDSketch-style sketch: geometric buckets
+with relative accuracy ``alpha`` (bucket ``i`` covers
+``(gamma^(i-1), gamma^i]`` with ``gamma = (1+alpha)/(1-alpha)``), a
+sparse dict of non-empty buckets, and an exact zero/min/max/sum/count
+sidecar.  Properties the tests pin:
+
+- **accuracy**: any quantile estimate is within ``alpha`` RELATIVE
+  error of some sample at that rank — for positive values,
+  ``|est - exact| / exact <= alpha`` (the tests check against exact
+  numpy percentiles on seeded data, with a one-order-statistic slack
+  for interpolation-convention differences);
+- **mergeable**: ``merge`` is bucket-wise addition — digesting a
+  stream in three parts then merging equals digesting it whole,
+  exactly (same buckets, same counts);
+- **serializable**: ``to_dict``/``from_dict`` round-trip through the
+  rollup store's canonical JSON without drift (integer bucket keys as
+  strings, counts as ints).
+
+Memory is O(log(max/min) / alpha) buckets — ~1.4k buckets span
+nanoseconds to hours at the default 1% accuracy, and real stage-time
+distributions touch a few dozen.
+"""
+
+from __future__ import annotations
+
+import math
+
+DEFAULT_ALPHA = 0.01
+
+# values below this are counted in the exact zero bucket: stage times
+# and batch sizes are never meaningfully sub-nanosecond, and a
+# geometric sketch cannot bucket 0 (log(0))
+MIN_TRACKABLE = 1e-9
+
+
+class QuantileDigest:
+    """Sparse DDSketch-style quantile sketch for non-negative values."""
+
+    __slots__ = ("alpha", "gamma", "_log_gamma", "buckets", "zeros",
+                 "count", "sum", "min", "max")
+
+    def __init__(self, alpha: float = DEFAULT_ALPHA):
+        if not 0.0 < alpha < 1.0:
+            raise ValueError("alpha must be in (0, 1)")
+        self.alpha = float(alpha)
+        self.gamma = (1.0 + self.alpha) / (1.0 - self.alpha)
+        self._log_gamma = math.log(self.gamma)
+        self.buckets: dict[int, int] = {}
+        self.zeros = 0
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    # ------------------------------------------------------- updates
+
+    def add(self, value: float, n: int = 1) -> None:
+        """Record ``value`` ``n`` times.  Negative / non-finite values
+        raise — every digested quantity here (milliseconds, batch
+        sizes) is non-negative by construction, and silently clamping
+        would hide a producer bug."""
+        v = float(value)
+        n = int(n)
+        if n <= 0:
+            return
+        if not math.isfinite(v) or v < 0.0:
+            raise ValueError(f"digest values must be finite and >= 0, "
+                             f"got {value!r}")
+        self.count += n
+        self.sum += v * n
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        if v < MIN_TRACKABLE:
+            self.zeros += n
+            return
+        i = math.ceil(math.log(v) / self._log_gamma)
+        self.buckets[i] = self.buckets.get(i, 0) + n
+
+    def merge(self, other: "QuantileDigest") -> None:
+        """Bucket-wise addition; digests must share ``alpha`` (merging
+        across accuracies would silently degrade the bound)."""
+        if abs(other.alpha - self.alpha) > 1e-12:
+            raise ValueError(
+                f"cannot merge digests with different alpha "
+                f"({self.alpha} vs {other.alpha})")
+        for i, c in other.buckets.items():
+            self.buckets[i] = self.buckets.get(i, 0) + c
+        self.zeros += other.zeros
+        self.count += other.count
+        self.sum += other.sum
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+
+    # ------------------------------------------------------- queries
+
+    def quantile(self, q: float) -> float:
+        """The q-quantile estimate (q in [0, 1]); NaN when empty.
+        Estimates clamp to the exact [min, max] envelope, so q=0 / q=1
+        are exact and no estimate can leave the observed range."""
+        if self.count == 0:
+            return math.nan
+        q = min(1.0, max(0.0, float(q)))
+        if q <= 0.0:
+            return self.min
+        if q >= 1.0:
+            return self.max
+        # 1-based target rank; walk zero bucket then geometric buckets
+        rank = max(1, math.ceil(q * self.count))
+        if rank <= self.zeros:
+            return 0.0
+        cum = self.zeros
+        for i in sorted(self.buckets):
+            cum += self.buckets[i]
+            if cum >= rank:
+                # bucket midpoint 2*gamma^i/(gamma+1): within alpha
+                # relative of every value in (gamma^(i-1), gamma^i]
+                est = 2.0 * self.gamma ** i / (self.gamma + 1.0)
+                return min(self.max, max(self.min, est))
+        return self.max
+
+    def percentiles(self) -> dict:
+        return {"p50": self.quantile(0.50), "p95": self.quantile(0.95),
+                "p99": self.quantile(0.99)}
+
+    # -------------------------------------------------- serialization
+
+    def to_dict(self) -> dict:
+        """Canonical JSON-able form (bucket keys as strings, sorted by
+        json.dumps(sort_keys=True) downstream — the store's
+        byte-identical compaction depends on this being stable)."""
+        out = {
+            "alpha": self.alpha,
+            "count": int(self.count),
+            "zeros": int(self.zeros),
+            "sum": round(self.sum, 6),
+            "b": {str(i): int(c)
+                  for i, c in sorted(self.buckets.items())},
+        }
+        if self.count:
+            out["min"] = self.min
+            out["max"] = self.max
+        return out
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "QuantileDigest":
+        dig = cls(alpha=float(d.get("alpha", DEFAULT_ALPHA)))
+        dig.count = int(d.get("count", 0))
+        dig.zeros = int(d.get("zeros", 0))
+        dig.sum = float(d.get("sum", 0.0))
+        dig.buckets = {int(i): int(c)
+                       for i, c in (d.get("b") or {}).items()}
+        if dig.count:
+            dig.min = float(d.get("min", 0.0))
+            dig.max = float(d.get("max", 0.0))
+        return dig

@@ -1,0 +1,282 @@
+"""Mid-run regression watch: rollups vs the perf ledger's history.
+
+tools/perf_gate.py judges a finished capture against a checked-in
+baseline — a CI-time verdict.  The control tower wants the same
+statistics DURING a run: the aggregator (obs/rollup.py) already
+accumulates per-segment host seconds per plan from the live journals,
+and the perf ledger already holds this host's history for the same
+``(plan, shape, host_fp)`` key — so every watch tick is one
+:func:`perf_stats.compare` call, no extra benchmarking.
+
+Escalation is an incident bundle (utils/incidents.py) of kind
+``throughput_regression`` carrying the full statistical verdict, plus
+an ``obs.regression`` flight-recorder event.  Two rules keep it from
+crying wolf:
+
+- the verdict must CONFIRM — Mann-Whitney significance AND the
+  bootstrap CI clear of the computed noise floor, the same
+  triple-agreement perf_gate requires;
+- one bundle per plan per watch lifetime (the latch): a sustained
+  regression is one incident, not one per poll tick.
+
+``--selftest`` proves both directions end to end through the REAL
+path (mini pipeline -> journal -> aggregator rollup -> ledger history
+-> verdict): an injected ``dispatch:stall`` fault plan must trip
+exactly one bundle, and a clean leg against the same baseline must
+trip zero.
+
+Usage::
+
+    python -m srtb_tpu.obs.regression --selftest
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+from srtb_tpu.utils import perf_ledger as PL
+from srtb_tpu.utils import perf_stats as PS
+
+INCIDENT_KIND = "throughput_regression"
+
+
+class RegressionWatch:
+    """Compare live per-plan samples against ledger history; escalate
+    at most one incident bundle per plan."""
+
+    def __init__(self, ledger_path: str, incident_dir: str = "",
+                 host_fp: str | None = None, alpha: float = 0.05,
+                 min_effect: float = 0.0, min_samples: int = 8):
+        self.ledger_path = ledger_path
+        self.incident_dir = incident_dir
+        # None = "this host" (the only raw-comparable history);
+        # pass "" to disable the host filter (tests, imported data)
+        self.host_fp = PL.host_fingerprint() if host_fp is None \
+            else (host_fp or None)
+        self.alpha = float(alpha)
+        self.min_effect = float(min_effect)
+        self.min_samples = max(2, int(min_samples))
+        self._escalated: set[str] = set()
+        self._recorder = None
+
+    @classmethod
+    def from_config(cls, cfg):
+        ledger = str(getattr(cfg, "perf_ledger_path", "") or "")
+        if not ledger:
+            return None
+        return cls(
+            ledger,
+            incident_dir=str(getattr(cfg, "incident_dir", "") or ""),
+            min_effect=float(
+                getattr(cfg, "obs_regression_min_effect", 0.0) or 0.0),
+            min_samples=int(
+                getattr(cfg, "obs_regression_min_samples", 8) or 8))
+
+    def check(self, plan: str, samples_s, shape: dict | None = None,
+              stream: str = "") -> dict:
+        """One watch tick.  Returns the verdict dict; ``checked`` is
+        False when either side lacks ``min_samples`` (a thin rollup or
+        an unseen plan is not evidence of anything)."""
+        samples = [float(s) for s in samples_s]
+        if len(samples) < self.min_samples:
+            return {"checked": False, "plan": plan,
+                    "reason": f"only {len(samples)} live samples "
+                              f"(< {self.min_samples})"}
+        baseline = PL.history(PL.load(self.ledger_path), plan,
+                              host_fp=self.host_fp, shape=shape)
+        if len(baseline) < self.min_samples:
+            return {"checked": False, "plan": plan,
+                    "reason": f"only {len(baseline)} ledger samples "
+                              f"for ({plan!r}, host="
+                              f"{self.host_fp or 'any'})"}
+        verdict = PS.compare(baseline, samples, alpha=self.alpha,
+                             min_effect=self.min_effect)
+        verdict.update(checked=True, plan=plan,
+                       n_baseline=len(baseline), n_live=len(samples))
+        if verdict["regression"]:
+            verdict["escalated"] = self._escalate(plan, verdict,
+                                                  stream=stream)
+        return verdict
+
+    def _escalate(self, plan: str, verdict: dict,
+                  stream: str = "") -> bool:
+        """One bundle per plan per watch lifetime (the latch)."""
+        from srtb_tpu.utils import events
+        if plan in self._escalated:
+            return False
+        self._escalated.add(plan)
+        events.emit("obs.regression", stream=stream,
+                    info=f"plan={plan} effect={verdict['effect']:+.3f}"
+                         f" p={verdict['p']:.4f}")
+        if not self.incident_dir:
+            return True
+        if self._recorder is None:
+            from srtb_tpu.utils.incidents import IncidentRecorder
+            self._recorder = IncidentRecorder(self.incident_dir)
+        bundle = self._recorder.dump(
+            INCIDENT_KIND,
+            reason=(f"rollup medians for plan {plan!r} regressed "
+                    f"{verdict['effect']:+.1%} vs ledger history "
+                    f"(p={verdict['p']:.4f}, floor="
+                    f"{verdict['noise_floor']:.3f})"),
+            stream=stream, extra={"verdict": verdict})
+        return bundle is not None
+
+
+# --------------------------------------------------------- selftest
+
+def _bundles(directory: str) -> list[str]:
+    try:
+        return sorted(n for n in os.listdir(directory)
+                      if os.path.isdir(os.path.join(directory, n)))
+    except OSError:
+        return []
+
+
+def _leg(tmp: str, segments: int, warmup: int, log2n: int,
+         channels: int, fault_plan: str = ""):
+    """One mini pipeline run whose journal is aggregated through the
+    REAL rollup path; returns (plan, measured per-segment seconds).
+    Reuses perf_gate's mini config so the injected stall travels the
+    same guarded dispatch path the gate selftest proves out."""
+    from srtb_tpu.io.synth import make_dispersed_baseband
+    from srtb_tpu.obs.rollup import Aggregator
+    from srtb_tpu.obs.store import RollupStore
+    from srtb_tpu.pipeline.runtime import Pipeline
+    from srtb_tpu.tools.perf_gate import _mini_cfg
+    from srtb_tpu.utils.metrics import metrics
+
+    n = 1 << log2n
+    total = segments + warmup
+    os.makedirs(tmp, exist_ok=True)
+    cfg = _mini_cfg(tmp, n, channels, fault_plan=fault_plan)
+    make_dispersed_baseband(
+        n * total, 1405.0, 64.0, 0.0, pulse_positions=n // 2,
+        nbits=8).tofile(cfg.input_file_path)
+    metrics.reset()
+    with Pipeline(cfg, sinks=[]) as pipe:
+        stats = pipe.run()
+        plan = getattr(pipe.processor, "plan_name", "")
+    if stats.segments != total:
+        raise RuntimeError(f"leg expected {total} segments, drained "
+                           f"{stats.segments}")
+    agg = Aggregator(RollupStore(os.path.join(tmp, "store")),
+                     journals=[cfg.telemetry_journal_path])
+    agg.poll()
+    agg.flush()
+    samples = agg.segment_seconds(plan)
+    if len(samples) < total:
+        raise RuntimeError(f"rollup saw {len(samples)} samples, "
+                           f"expected {total}")
+    # the serial mini config (inflight_segments=1) journals segments
+    # in order: the first ``warmup`` carry trace/compile — drop them
+    return plan, samples[warmup:]
+
+
+def _clean_leg(tmp: str, name: str, ledger: str, plan: str,
+               shape: dict, args, kw) -> tuple:
+    """One clean leg judged by a FRESH watch with its own incident
+    directory; returns (verdict, bundles written)."""
+    _plan, clean = _leg(os.path.join(tmp, name), **kw)
+    inc_dir = os.path.join(tmp, f"incidents_{name}")
+    watch = RegressionWatch(ledger, incident_dir=inc_dir,
+                            alpha=args.alpha,
+                            min_samples=min(8, args.segments))
+    verdict = watch.check(plan, clean, shape=shape)
+    return verdict, len(_bundles(inc_dir))
+
+
+def selftest(args) -> int:
+    """End-to-end proof: pipeline -> journal -> aggregator -> ledger
+    -> watch.  The stalled leg must escalate EXACTLY one bundle (and
+    latch), the clean leg exactly zero."""
+    shape = {"log2n": args.log2n, "channels": args.channels,
+             "segments": args.segments, "warmup": args.warmup}
+    kw = dict(segments=args.segments, warmup=args.warmup,
+              log2n=args.log2n, channels=args.channels)
+    with tempfile.TemporaryDirectory(prefix="srtb_obs_watch_") as tmp:
+        ledger = os.path.join(tmp, "ledger.jsonl")
+        plan, base = _leg(os.path.join(tmp, "leg_base"), **kw)
+        med = sorted(base)[len(base) // 2]
+        PL.PerfLedger(ledger).append(PL.make_record(
+            "watch-selftest", med, "s/segment", plan=plan,
+            shape=shape, samples_s=base))
+
+        from srtb_tpu.tools.perf_gate import stall_plan
+        stall_s = max(0.02, 2.0 * med)
+        _plan_b, stalled = _leg(
+            os.path.join(tmp, "leg_stall"),
+            fault_plan=stall_plan(args.segments, args.warmup, stall_s),
+            **kw)
+        dir_stall = os.path.join(tmp, "incidents_stall")
+        watch = RegressionWatch(ledger, incident_dir=dir_stall,
+                                alpha=args.alpha,
+                                min_samples=min(8, args.segments))
+        v_stall = watch.check(plan, stalled, shape=shape)
+        # the latch: a second tick on the same sustained regression
+        # must NOT mint a second incident
+        v_again = watch.check(plan, stalled, shape=shape)
+        n_stall = len(_bundles(dir_stall))
+
+        v_clean, n_clean = _clean_leg(tmp, "leg_clean", ledger, plan,
+                                      shape, args, kw)
+        if v_clean.get("regression"):
+            # same flake bound as perf_gate's selftest: a clean/clean
+            # comparison false-alarms with probability ~alpha/2 (plus
+            # real mid-run throttling) — one independent recapture
+            # (fresh leg, fresh watch) squares that away while a
+            # genuine shift fails both legs
+            v_clean, n_clean = _clean_leg(tmp, "leg_clean2", ledger,
+                                          plan, shape, args, kw)
+            v_clean["retried"] = True
+
+    ok = (v_stall.get("regression") is True
+          and v_stall.get("escalated") is True
+          and v_again.get("escalated") is False
+          and n_stall == 1
+          and v_clean.get("checked") is True
+          and not v_clean.get("regression")
+          and n_clean == 0)
+    print(json.dumps({
+        "selftest": "ok" if ok else "FAILED",
+        "plan": plan, "stall_s": round(stall_s, 4),
+        "stalled": {k: v_stall.get(k) for k in
+                    ("regression", "effect", "p", "noise_floor",
+                     "escalated")},
+        "clean": {k: v_clean.get(k) for k in
+                  ("regression", "effect", "p", "noise_floor")},
+        "bundles_stalled_leg": n_stall,
+        "bundles_clean_leg": n_clean,
+        "detail": ("injected stall escalated exactly one incident "
+                   "bundle; clean leg escalated zero" if ok else
+                   "watch verdicts did not match expectations"),
+    }, sort_keys=True))
+    sys.stdout.flush()
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--selftest", action="store_true")
+    p.add_argument("--alpha", type=float, default=0.05)
+    p.add_argument("--segments", type=int, default=12)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--log2n", type=int, default=12)
+    p.add_argument("--channels", type=int, default=32)
+    args = p.parse_args(argv)
+    if args.selftest:
+        try:
+            return selftest(args)
+        except (OSError, ValueError, RuntimeError) as e:
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+            return 2
+    p.print_usage(sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

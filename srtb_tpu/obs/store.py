@@ -1,0 +1,190 @@
+"""Long-horizon rollup store: append-only JSONL + idempotent compaction.
+
+The aggregator (obs/rollup.py) needs somewhere durable for its rollups
+that (a) appends cheaply while a fleet is live, (b) survives any crash
+with at worst a torn last line, (c) bounds disk via retention, and
+(d) compacts DETERMINISTICALLY — the same rows in always produce the
+same bytes out, so re-running compaction is a no-op and CI can assert
+byte-identity instead of trusting a "compacted" flag.
+
+Layout (one directory per store)::
+
+    <dir>/active.jsonl        the append arm (one JSON object per line)
+    <dir>/segments/seg*.jsonl compacted history, one file per
+                              ``segment_minutes`` bucket of rollup
+                              minutes (plus seg-meta.jsonl for
+                              minute-less rows like cumulative digests)
+    <dir>/cursor.json         the aggregator's resume cursor (owned by
+                              obs/rollup.py, not this class)
+
+Every row carries ``k`` — its identity key.  Appends are snapshots,
+not deltas: a later row with the same ``k`` SUPERSEDES the earlier one
+(last-wins), which is what makes re-flushing a still-open rollup
+minute safe and compaction idempotent — duplicates collapse instead of
+double-counting.
+
+Compaction: read everything (segments oldest-first, then active),
+last-wins by ``k``, drop rows whose ``minute`` is older than
+``retention_minutes`` behind the NEWEST minute present (relative to
+the data, not the wall clock — deterministic and testable), group by
+minute bucket, write each bucket sorted by ``k`` as canonical JSON via
+temp + rename, remove buckets retention emptied, truncate the active
+arm.  Running it twice produces byte-identical files — the test
+re-runs it and compares bytes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+ACTIVE_NAME = "active.jsonl"
+SEGMENT_DIR = "segments"
+META_SEGMENT = "seg-meta.jsonl"
+TMP_SUFFIX = ".srtb_tmp"  # matches the repo's atomic-rename convention
+
+
+def _parse_lines(path: str) -> list[dict]:
+    """Tolerant JSONL read: foreign lines and a torn tail (a crash
+    mid-append) yield their readable prefix, never an exception."""
+    rows = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(row, dict) and "k" in row:
+                    rows.append(row)
+    except OSError:
+        pass
+    return rows
+
+
+class RollupStore:
+    """One rollup-store directory (see module docstring)."""
+
+    def __init__(self, directory: str, retention_minutes: int = 0,
+                 segment_minutes: int = 60):
+        if segment_minutes <= 0:
+            raise ValueError("segment_minutes must be positive")
+        self.directory = directory
+        self.retention_minutes = max(0, int(retention_minutes))
+        self.segment_minutes = int(segment_minutes)
+        self.active_path = os.path.join(directory, ACTIVE_NAME)
+        self.segment_dir = os.path.join(directory, SEGMENT_DIR)
+        os.makedirs(self.segment_dir, exist_ok=True)
+        # sweep torn temp files from a crashed compaction (the rename
+        # never happened, so the previous generation is still whole)
+        for name in os.listdir(self.segment_dir):
+            if name.endswith(TMP_SUFFIX):
+                try:
+                    os.unlink(os.path.join(self.segment_dir, name))
+                except OSError:
+                    pass
+
+    # ------------------------------------------------------- appends
+
+    def append(self, row: dict) -> None:
+        self.append_many([row])
+
+    def append_many(self, rows) -> int:
+        """Append row snapshots to the active arm.  Rows must carry
+        ``k`` (identity) — last-wins dedup is the store's whole
+        consistency model, so an unkeyed row is a programming error."""
+        lines = []
+        for row in rows:
+            if "k" not in row:
+                raise ValueError(f"store row without identity key: "
+                                 f"{row!r}")
+            lines.append(json.dumps(row, sort_keys=True) + "\n")
+        if not lines:
+            return 0
+        with open(self.active_path, "a") as f:
+            f.writelines(lines)
+        return len(lines)
+
+    # --------------------------------------------------------- reads
+
+    def _segment_files(self) -> list[str]:
+        try:
+            names = sorted(os.listdir(self.segment_dir))
+        except OSError:
+            names = []
+        return [os.path.join(self.segment_dir, n) for n in names
+                if n.endswith(".jsonl")]
+
+    def rows(self) -> list[dict]:
+        """Every row, compacted history first (oldest segment file
+        first), then the active arm — so iterating in order and
+        applying last-wins by ``k`` yields the current state."""
+        out = []
+        for p in self._segment_files():
+            out.extend(_parse_lines(p))
+        out.extend(_parse_lines(self.active_path))
+        return out
+
+    def latest(self) -> dict:
+        """Current state: identity key -> winning row."""
+        state: dict[str, dict] = {}
+        for row in self.rows():
+            state[row["k"]] = row
+        return state
+
+    # ---------------------------------------------------- compaction
+
+    def compact(self) -> dict:
+        """Merge + retain + rewrite (see module docstring).  Returns
+        ``{"rows": kept, "dropped": retention_drops, "segments":
+        file_count}``.  Deterministic: running it again with no new
+        appends rewrites byte-identical files."""
+        state = self.latest()
+        minutes = [int(r["minute"]) for r in state.values()
+                   if isinstance(r.get("minute"), int)
+                   and r["minute"] >= 0]
+        dropped = 0
+        if self.retention_minutes and minutes:
+            cutoff = max(minutes) - self.retention_minutes
+            doomed = [k for k, r in state.items()
+                      if isinstance(r.get("minute"), int)
+                      and 0 <= r["minute"] < cutoff]
+            for k in doomed:
+                del state[k]
+            dropped = len(doomed)
+        buckets: dict[str, list] = {}
+        for k in sorted(state):
+            row = state[k]
+            minute = row.get("minute")
+            if isinstance(minute, int) and minute >= 0:
+                start = minute - minute % self.segment_minutes
+                name = f"seg{start:012d}.jsonl"
+            else:
+                name = META_SEGMENT
+            buckets.setdefault(name, []).append(row)
+        want = set(buckets)
+        for name, rows in buckets.items():
+            final = os.path.join(self.segment_dir, name)
+            tmp = final + TMP_SUFFIX
+            with open(tmp, "w") as f:
+                for row in rows:
+                    f.write(json.dumps(row, sort_keys=True) + "\n")
+            os.replace(tmp, final)
+        # buckets a previous compaction wrote that retention (or a
+        # key-space change) emptied must not linger as phantom history
+        for p in self._segment_files():
+            if os.path.basename(p) not in want:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+        # truncate the active arm LAST: a crash before this point
+        # leaves duplicates that the next compaction's last-wins merge
+        # collapses — never lost rows
+        with open(self.active_path, "w"):
+            pass
+        return {"rows": len(state), "dropped": dropped,
+                "segments": len(buckets)}

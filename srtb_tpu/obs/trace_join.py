@@ -1,0 +1,239 @@
+"""Cross-device trace join: one Perfetto timeline for a whole fleet.
+
+tools/trace_export.py renders one flight-recorder dump with a trace
+*process* per stream — the right cut for a single host, but a fleet
+question ("what did device 1 look like around the migration?") wants
+the DEVICE cut: one trace process per pool member, each stream's
+stages parked on whichever device executed that segment, so a
+migrated stream's flow arrows visibly JUMP from one device's process
+track to the other's at the migration boundary.
+
+The join needs two sources, because neither alone knows the mapping:
+
+- the event dumps carry per-segment stage timings + thread identity
+  but no device (events are emitted host-side);
+- the v11 span journals carry ``device`` per (stream, segment) — the
+  pool member that executed it, switching exactly at the migration
+  boundary.
+
+So: build ``(stream, segment) -> device`` from every lane's journal
+(mixed v1–v10 records simply lack ``device`` and fall through to the
+host track), then re-render the merged event streams with device
+process-tracks.  Three kinds of arrows come out:
+
+- per-``trace_id`` segment chains (same as trace_export) — now
+  crossing device tracks when a segment's stages split host/device;
+- per-stream LANE chains over the device-mapped dispatch slices
+  (flow ids from 10^9 up, clear of trace ids): THE migration
+  visual — one arrow per consecutive dispatch pair, crossing process
+  tracks at the boundary segment;
+- fleet control events (migrate / drain / halt) as instants on the
+  involved device's track, so the cause sits next to the effect.
+
+The output passes the exact same :func:`trace_export.validate`
+structural gate as the single-host exporter — CI asserts that, plus
+that some stream's ``stream_devices`` spans >= 2 devices after a
+migration soak.
+
+Usage::
+
+    python -m srtb_tpu.obs.trace_join EVENTS.jsonl... \
+        --journals J1.jsonl J2.jsonl [--out OUT.json] [--validate]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from srtb_tpu.tools.trace_export import (STAGE_TYPES, load_events,
+                                         validate)
+
+HOST_TRACK = "host"          # events with no device mapping
+LANE_FLOW_BASE = 1_000_000_000  # lane-chain ids, clear of trace ids
+
+
+def device_map(journal_paths) -> dict:
+    """``(stream, segment) -> device`` from v11 span journals
+    (rotated generations included).  Pre-v11 records carry no
+    ``device`` and contribute nothing — the reader contract."""
+    from srtb_tpu.tools.telemetry_report import load
+    mapping: dict[tuple, str] = {}
+    for path in journal_paths:
+        try:
+            records = load(path)
+        except OSError:
+            continue
+        for rec in records:
+            dev = rec.get("device")
+            seg = rec.get("segment")
+            if dev and seg is not None:
+                mapping[(str(rec.get("stream") or ""), int(seg))] = \
+                    str(dev)
+    return mapping
+
+
+def render(events: list[dict], mapping: dict) -> dict:
+    """Merged events + device map -> Chrome-trace document with one
+    process per device (plus ``host`` for unmapped events)."""
+    if not events:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    t0 = min(e["t"] for e in events)
+
+    def us(t: float) -> float:
+        return round((t - t0) * 1e6, 3)
+
+    pids: dict[str, int] = {}
+    tids: dict[tuple, int] = {}
+    out: list[dict] = []
+
+    def pid_of(track: str) -> int:
+        if track not in pids:
+            pids[track] = len(pids) + 1
+            name = track if track == HOST_TRACK else f"device:{track}"
+            out.append({"name": "process_name", "ph": "M",
+                        "pid": pids[track], "tid": 0,
+                        "args": {"name": name}})
+        return pids[track]
+
+    def tid_of(pid: int, lane: str) -> int:
+        key = (pid, lane)
+        if key not in tids:
+            tids[key] = sum(1 for (p, _t) in tids if p == pid) + 1
+            out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                        "tid": tids[key], "args": {"name": lane}})
+        return tids[key]
+
+    # devices in first-appearance order per stream: the migration
+    # assertion ("some stream touched >= 2 devices") reads this
+    stream_devices: dict[str, list] = {}
+    trace_points: dict[int, list] = {}
+    lane_points: dict[str, list] = {}
+
+    for e in events:
+        stream = str(e.get("stream") or "")
+        thread = str(e.get("thread") or "?")
+        seg = e.get("seg")
+        etype = e["type"]
+        device = mapping.get((stream, int(seg))) \
+            if seg is not None and seg >= 0 else None
+        if device is None and etype.startswith("fleet."):
+            # control events name their device in info ("dev0->dev1"
+            # for migrate, the member label for halt/drain) — park
+            # them on the destination device's track
+            info = str(e.get("info") or "")
+            tail = info.rsplit("->", 1)[-1].strip()
+            if tail in pids or any(tail == d for devs
+                                   in stream_devices.values()
+                                   for d in devs) \
+                    or tail in set(mapping.values()):
+                device = tail
+        track = device or HOST_TRACK
+        pid = pid_of(track)
+        lane = f"{stream or 'pipeline'}:{thread}"
+        tid = tid_of(pid, lane)
+        if device and stream:
+            devs = stream_devices.setdefault(stream, [])
+            if not devs or devs[-1] != device:
+                devs.append(device)
+        trace = int(e.get("trace") or 0)
+        args = {"trace_id": trace, "segment": e.get("seg", -1),
+                "stream": stream or "pipeline"}
+        if e.get("info"):
+            args["info"] = e["info"]
+        if etype in STAGE_TYPES:
+            dur_us = max(float(e.get("dur_ms") or 0.0) * 1e3, 0.001)
+            start = us(e["t"]) - dur_us  # emitted at stage END
+            out.append({"name": etype.split(".", 1)[1], "cat": "stage",
+                        "ph": "X", "ts": round(start, 3),
+                        "dur": round(dur_us, 3), "pid": pid,
+                        "tid": tid, "args": args})
+            mid = us(e["t"]) - dur_us / 2
+            if trace > 0:
+                trace_points.setdefault(trace, []).append(
+                    (mid, pid, tid))
+            if etype == "stage.dispatch" and device and stream:
+                lane_points.setdefault(stream, []).append(
+                    (mid, pid, tid))
+        else:
+            out.append({"name": etype, "cat": "event", "ph": "i",
+                        "s": "t", "ts": us(e["t"]), "pid": pid,
+                        "tid": tid, "args": args})
+
+    def chain(points: list, fid: int, name: str) -> None:
+        if len(points) < 2:
+            return
+        points.sort()
+        for i, (ts, pid, tid) in enumerate(points):
+            ph = "s" if i == 0 else ("f" if i == len(points) - 1
+                                     else "t")
+            ev = {"name": name, "cat": "flow", "ph": ph, "id": fid,
+                  "ts": round(ts, 3), "pid": pid, "tid": tid}
+            if ph == "f":
+                ev["bp"] = "e"
+            out.append(ev)
+
+    for trace, points in sorted(trace_points.items()):
+        chain(points, trace, "segment")
+    for i, stream in enumerate(sorted(lane_points)):
+        # the migration arrows: consecutive device-mapped dispatches
+        # of one stream, crossing process tracks at the boundary
+        chain(lane_points[stream], LANE_FLOW_BASE + i,
+              f"lane:{stream}")
+
+    return {"traceEvents": out, "displayTimeUnit": "ms",
+            "otherData": {"source": "srtb_tpu fleet trace join",
+                          "devices": sorted(p for p in pids
+                                            if p != HOST_TRACK),
+                          "stream_devices": stream_devices}}
+
+
+def join(events_paths, journal_paths) -> dict:
+    """Load + merge event dumps, build the device map, render."""
+    events: list[dict] = []
+    for p in events_paths:
+        events.extend(load_events(p))
+    events.sort(key=lambda e: e["t"])
+    return render(events, device_map(journal_paths))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("events", nargs="+",
+                   help="events JSONL dump(s) / incident bundle "
+                        "dir(s)")
+    p.add_argument("--journals", nargs="*", default=[],
+                   help="v11 span journals supplying the "
+                        "(stream, segment) -> device map")
+    p.add_argument("--out", default="",
+                   help="output path (default: fleet_trace.json)")
+    p.add_argument("--validate", action="store_true",
+                   help="schema-check only; exit 1 on problems")
+    args = p.parse_args(argv)
+    doc = join(args.events, args.journals)
+    if not doc["traceEvents"]:
+        print(json.dumps({"error": "no events"}), file=sys.stderr)
+        return 1
+    problems = validate(doc)
+    if problems:
+        for msg in problems:
+            print(f"INVALID: {msg}", file=sys.stderr)
+        return 1
+    if args.validate:
+        print(f"valid fleet trace: {len(doc['traceEvents'])} events, "
+              f"devices={doc['otherData']['devices']}, "
+              f"stream_devices="
+              f"{json.dumps(doc['otherData']['stream_devices'])}")
+        return 0
+    out = args.out or "fleet_trace.json"
+    with open(out, "w") as f:
+        json.dump(doc, f)
+    print(f"wrote {out}: {len(doc['traceEvents'])} trace events "
+          f"across {len(doc['otherData']['devices'])} device "
+          f"track(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

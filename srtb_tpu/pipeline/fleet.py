@@ -993,6 +993,9 @@ class _StreamLane:
             self.name, src.label, device.label)
         metrics.add("migrations")
         metrics.add("migrations", labels={"stream": self.name})
+        # per-device twin (arrival side): feeds the control tower's
+        # per-member breakdown and the _pool_sum/_pool_max aggregates
+        metrics.add("migrations", labels={"device": device.label})
         events.emit("fleet.migrate", trace=0, stream=self.name,
                     info=f"{src.label}->{device.label}")
         log.warning(f"[fleet:{self.name}] migrated {src.label} -> "

@@ -59,6 +59,7 @@ def main(argv=None) -> int:
             gui_server = WaterfallHTTPServer(
                 out_dir, port=cfg.gui_http_port,
                 health_stale_after_s=cfg.health_stale_after_s,
+                fleet_store_dir=getattr(cfg, "obs_store_dir", ""),
                 # the configured restart budget covers the GUI server
                 # too (config.py: supervisor_max_restarts, 0 = give up
                 # on the first crash); best-effort, so fatal crashes

@@ -77,9 +77,11 @@ def _md(rep: dict) -> str:
              f"{rep['records']} perf records."]
     for key, g in rep["groups"].items():
         lines += ["", f"## {key}", "",
-                  f"best {g['best']}, latest {g['latest']}"
-                  + (f", {g['failed_rounds']} failed round(s)"
-                     if g["failed_rounds"] else ""),
+                  ("all rounds failed — no measured value yet"
+                   if not g["best"] and g["failed_rounds"] else
+                   f"best {g['best']}, latest {g['latest']}"
+                   + (f", {g['failed_rounds']} failed round(s)"
+                      if g["failed_rounds"] else "")),
                   "",
                   "| when | value | source | platform | git | host | "
                   "reps | note |", "|---|---|---|---|---|---|---|---|"]
@@ -105,9 +107,14 @@ def main(argv=None) -> int:
     sources = [s for s in args.source.split(",") if s] or None
     rep = report(args.ledger, sources)
     if not rep["records"]:
-        print(json.dumps({"error": f"no perf records in {args.ledger}"}),
-              file=sys.stderr)
-        return 1
+        # empty / missing / filtered-to-nothing ledger: a clear note,
+        # not a failure — dashboards render before the first record
+        # lands (same contract as telemetry_report on a fresh journal)
+        note = {"note": f"no perf records in {args.ledger} yet",
+                "records": 0}
+        print(json.dumps(note) if args.format == "json"
+              else f"# Perf trajectory\n\n{note['note']}\n")
+        return 0
     if args.format == "json":
         print(json.dumps(rep, sort_keys=True))
     else:

@@ -39,8 +39,12 @@ pipeline writes (one record per segment) and reports
 - science observatory (schema-v9 spans): the per-segment ``quality``
   and ``canary`` extras are summarized by tools/quality_report.py;
   this report treats them like any other extra payload.
+- fleet devices (schema-v11 spans): per-POOL-MEMBER breakdown for
+  elastic-fleet runs — spans, streams hosted, detections, loss and
+  migrations-in grouped by the ``device`` label (which switches
+  exactly at a lane's migration boundary).
 
-Mixed v1-v9 journals (rotation can leave an older-schema tail
+Mixed v1-v11 journals (rotation can leave an older-schema tail
 after an upgrade) are summarized tolerantly: records simply lack the
 newer fields and drop out of the sections that need them.
 
@@ -77,26 +81,13 @@ def load(path: str, include_rotated: bool = True) -> list[dict]:
     generation (``<path>.1.gz``, or legacy plaintext ``<path>.1``) is
     read first when present; a torn gzip tail (crash mid-rotation)
     yields its readable prefix."""
+    from srtb_tpu.utils.telemetry import rotated_generation
     records = []
     paths = []
     if include_rotated:
-        cands = [p for p in (path + ".1.gz", path + ".1")
-                 if os.path.exists(p)]
-        if len(cands) == 2:
-            # both exist only after a failed compress left the newer
-            # plaintext next to an older .gz — single-generation
-            # semantics: the newer one IS the previous generation.
-            # The mtime read races with a live journal's rotation
-            # (compress unlinks the .1 it just gzipped): a vanished
-            # candidate sorts oldest and drops out.
-            def _mtime(p: str) -> float:
-                try:
-                    return os.path.getmtime(p)
-                except OSError:
-                    return -1.0
-            cands.sort(key=_mtime)
-            cands = cands[-1:]
-        paths.extend(cands)
+        gen = rotated_generation(path)
+        if gen:
+            paths.append(gen)
     paths.append(path)
     import zlib
     for p in paths:
@@ -387,6 +378,51 @@ def fleet_stats(records: list[dict]) -> dict:
     return out
 
 
+def fleet_device_stats(records: list[dict]) -> dict:
+    """Per-POOL-MEMBER breakdown from v11 spans (the elastic device
+    fleet): spans executed, streams hosted, detections, loss deltas
+    attributed to the device that drained them, and migrations IN
+    (device-label change points per stream).  Records without a
+    ``device`` label (v1-v10, or a solo run) are skipped; empty dict
+    when none qualify.  Feed it one lane's journal or several lanes'
+    merged — the per-stream change-point walk is order-tolerant
+    because each stream's records are tracked independently."""
+    by_dev: dict[str, dict] = {}
+    last_dev: dict[str, str] = {}      # stream -> previous device
+    last_dropped: dict[str, int] = {}  # stream -> previous cumulative
+    any_v11 = False
+    for r in records:
+        dev = r.get("device")
+        if not dev:
+            continue
+        any_v11 = True
+        dev = str(dev)
+        stream = str(r.get("stream") or "")
+        cur = by_dev.setdefault(dev, {
+            "spans": 0, "streams": set(), "detections": 0,
+            "segments_dropped": 0, "migrations_in": 0})
+        cur["spans"] += 1
+        cur["streams"].add(stream)
+        cur["detections"] += int(r.get("detections", 0))
+        # loss is a cumulative per-stream counter (named spans carry
+        # the stream's OWN series): the delta since the stream's
+        # previous record belongs to the device draining NOW
+        dropped = r.get("segments_dropped")
+        if dropped is not None:
+            prev = last_dropped.get(stream)
+            if prev is not None:
+                cur["segments_dropped"] += max(0, int(dropped) - prev)
+            last_dropped[stream] = int(dropped)
+        prev_dev = last_dev.get(stream)
+        if prev_dev is not None and prev_dev != dev:
+            cur["migrations_in"] += 1
+        last_dev[stream] = dev
+    if not any_v11:
+        return {}
+    return {dev: {**st, "streams": len(st["streams"])}
+            for dev, st in sorted(by_dev.items())}
+
+
 def device_stats(records: list[dict]) -> dict:
     """Device-time accounting from v8 spans (performance
     observatory).  ``device_ms`` is per-segment (an upper bound on
@@ -439,6 +475,7 @@ def report(path: str, bin_s: float = 10.0) -> dict:
         "compute": compute_stats(records),
         "durability": durability_stats(records),
         "fleet": fleet_stats(records),
+        "fleet_devices": fleet_device_stats(records),
         "device": device_stats(records),
         "timeline": timeline(records, bin_s),
     }
@@ -511,6 +548,16 @@ def _md(rep: dict) -> str:
                 f"{st['plan_demotions']} | {st['device_reinits']} | "
                 f"{st['degrade_level_max']} | "
                 f"{st['plan_ladder_level_last']} |")
+    fd = rep.get("fleet_devices") or {}
+    if fd:
+        lines += ["", "## Fleet devices (per pool member)", "",
+                  "| device | spans | streams | detections | loss | "
+                  "migrations in |", "|---|---|---|---|---|---|"]
+        for dev, st in fd.items():
+            lines.append(
+                f"| {dev} | {st['spans']} | {st['streams']} | "
+                f"{st['detections']} | {st['segments_dropped']} | "
+                f"{st['migrations_in']} |")
     dv = rep.get("device") or {}
     if dv:
         lines += ["", "## Device time (performance observatory)", ""]

@@ -270,6 +270,18 @@ class Metrics:
             if total_w > 0:
                 out["packet_loss_rate_window"] = (
                     by_name["packets_lost"].sum() / total_w)
+        # pool-wide aggregates: any family with device-labeled series
+        # grows flat _pool_sum/_pool_max twins (sum/max across pool
+        # members) — the control tower's "whole fleet" view, rendered
+        # as ordinary families with their own contiguous HELP/TYPE
+        # pairs so strict expfmt parsers stay happy
+        pool: dict[str, list] = {}
+        for (n, lk), v in labeled.items():
+            if any(k == "device" for k, _v in lk):
+                pool.setdefault(n, []).append(v)
+        for n, vals in pool.items():
+            out[n + "_pool_sum"] = float(sum(vals))
+            out[n + "_pool_max"] = float(max(vals))
         return out, labeled, windows, hists
 
     def snapshot(self) -> dict:
@@ -433,6 +445,10 @@ class Metrics:
             text = f"Supervised restarts of component {bare[16:]}"
         elif text is None and bare.endswith("_per_sec"):
             text = f"Windowed rate of {bare[:-8]} per second"
+        elif text is None and bare.endswith("_pool_sum"):
+            text = f"Sum of {bare[:-9]} across pool members"
+        elif text is None and bare.endswith("_pool_max"):
+            text = f"Max of {bare[:-9]} across pool members"
         if text is None:
             text = "srtb_tpu runtime metric"
         return f"# HELP {prom_name} {text}"

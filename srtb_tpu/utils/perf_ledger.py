@@ -166,6 +166,28 @@ def load(path: str) -> list[dict]:
     return records
 
 
+def history(records: list[dict], plan: str, host_fp: str | None = None,
+            shape: dict | None = None, max_records: int = 3) -> list:
+    """Concatenated per-rep samples from the NEWEST records matching
+    ``(plan, host_fp, shape)`` — the baseline side of a mid-run
+    regression check (srtb_tpu/obs/regression.py).  Records without
+    ``samples_s`` carry no statistical weight and are skipped; pass
+    ``host_fp=None``/``shape=None`` to not filter on that key."""
+    matches = []
+    for rec in records:
+        if rec.get("plan") != plan or not rec.get("samples_s"):
+            continue
+        if host_fp is not None and rec.get("host_fp") != host_fp:
+            continue
+        if shape is not None and rec.get("shape") != dict(shape):
+            continue
+        matches.append(rec)
+    out: list[float] = []
+    for rec in matches[-max(1, int(max_records)):]:
+        out.extend(float(s) for s in rec["samples_s"])
+    return out
+
+
 def import_keys(records: list[dict]) -> set:
     """The idempotency keys already in the ledger: a re-run of
     ``--import`` must not duplicate history."""

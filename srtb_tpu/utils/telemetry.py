@@ -417,6 +417,33 @@ def segment_span(segment: int, stages_s: dict, queue_depth: int,
     return rec
 
 
+def rotated_generation(path: str) -> str | None:
+    """The journal's previous on-disk generation — ``<path>.1.gz``,
+    or the legacy plaintext ``<path>.1`` — or None when the journal
+    has never rotated.  When BOTH exist (a failed compress left a
+    newer plaintext generation next to an older .gz) the NEWER one is
+    the previous generation (single-generation semantics).  Shared by
+    every journal reader (tools/telemetry_report.load, the obs
+    aggregator) so generation-pick policy lives in one place.  The
+    mtime read races with a live journal's rotation (compress unlinks
+    the .1 it just gzipped): a vanished candidate sorts oldest and
+    drops out."""
+    cands = [p for p in (path + ".1.gz", path + ".1")
+             if os.path.exists(p)]
+    if not cands:
+        return None
+    if len(cands) == 1:
+        return cands[0]
+
+    def _mtime(p: str) -> float:
+        try:
+            return os.path.getmtime(p)
+        except OSError:
+            return -1.0
+
+    return max(cands, key=_mtime)
+
+
 # admitted fleet streams whose liveness /healthz must track: name ->
 # registration time.  Registered by StreamFleet when a lane starts,
 # released when it finishes/fails — a finished stream is legitimately
